@@ -1,0 +1,29 @@
+"""attention-tpu: a TPU-native scaled-dot-product-attention framework.
+
+A brand-new JAX/XLA/Pallas/pjit framework with the capabilities of the
+MPI/AVX-512 reference (`attention.c` / `attention-mpi.c`):
+
+- ``core``      — problem definition, fp64 serial oracle, binary testcase
+                  format + generator + verifier (reference `attention.c:84-162`).
+- ``ops``       — compute kernels: XLA reference implementation and a fused
+                  Pallas flash-attention kernel (replaces the reference's
+                  AVX-512 kernels, `attention-mpi.c:103-189`).
+- ``parallel``  — device-mesh distribution: KV-sharded attention with the
+                  two-phase max/sum softmax normalization
+                  (`attention-mpi.c:340-362`), ring attention for long
+                  context, and Ulysses all-to-all head/sequence parallelism.
+- ``models``    — multi-head / grouped-query attention modules and a small
+                  transformer stack used for end-to-end training tests.
+- ``utils``     — timing, FLOPs accounting, config.
+- ``cli``       — `attention-tpu <testcase.bin> --backend=...`, preserving
+                  the reference's CLI harness contract
+                  (`attention.c:164-196`).
+
+The public API mirrors the reference's single entry point
+``attention(Q, K, V) -> result`` (`attention.c:20-21`) with a backend
+registry replacing the serial/MPI source-file split.
+"""
+
+__version__ = "0.1.0"
+
+from attention_tpu.api import attention, available_backends  # noqa: F401

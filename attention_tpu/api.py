@@ -1,0 +1,95 @@
+"""Public attention API and backend registry.
+
+The reference exposes one function compiled two ways — serial
+(`attention.c:20-21`) vs MPI-distributed (`attention-mpi.c:191-192`),
+selected by which binary you build.  Here the same split is a runtime
+backend registry:
+
+  * ``oracle``     — fp64 NumPy serial oracle (the `attention.c` role).
+  * ``xla``        — un-fused JAX implementation, XLA-scheduled.
+  * ``flash``      — fused single-device Pallas flash kernel.
+  * ``kv-sharded`` — KV rows sharded over a device mesh, two-phase
+                     pmax/psum softmax (the `attention-mpi.c` role).
+  * ``ring``       — ring attention (Q and KV both sharded; KV rotates
+                     over the ICI ring) for long context.
+  * ``ulysses``    — all-to-all head/sequence reshard for multi-head runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+_BACKENDS: dict[str, Callable[..., Any]] = {}
+_BUILTINS_LOADED = False
+
+
+def register_backend(name: str):
+    def deco(fn):
+        _BACKENDS[name] = fn
+        return fn
+
+    return deco
+
+
+def available_backends() -> list[str]:
+    _ensure_registered()
+    return sorted(_BACKENDS)
+
+
+def _ensure_registered() -> None:
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    from attention_tpu.core.oracle import attention_oracle
+    from attention_tpu.ops.flash import flash_attention
+    from attention_tpu.ops.reference import attention_xla
+
+    _BACKENDS["oracle"] = lambda q, k, v, **kw: attention_oracle(q, k, v, **kw)
+    _BACKENDS["xla"] = attention_xla
+    _BACKENDS["flash"] = flash_attention
+
+    def _kv_sharded(q, k, v, **kw):
+        from attention_tpu.parallel.kv_sharded import kv_sharded_attention
+
+        return kv_sharded_attention(q, k, v, **kw)
+
+    def _ring(q, k, v, **kw):
+        from attention_tpu.parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, **kw)
+
+    def _ulysses(q, k, v, **kw):
+        from attention_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, **kw)
+
+    _BACKENDS["kv-sharded"] = _kv_sharded
+    _BACKENDS["ring"] = _ring
+    _BACKENDS["ulysses"] = _ulysses
+
+
+def attention(
+    q,
+    k,
+    v,
+    *,
+    backend: str = "flash",
+    **kwargs,
+) -> np.ndarray:
+    """Compute softmax(Q K^T / sqrt(dk)) V with the named backend.
+
+    Mirrors the reference's `attention(Q, K, V, result, m, n, dk, dv)`
+    entry point (`attention.c:20-21`) — shapes are carried by the arrays,
+    and the output is returned rather than written into a caller buffer.
+    """
+    _ensure_registered()
+    try:
+        fn = _BACKENDS[backend]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {backend!r}; available: {available_backends()}"
+        ) from None
+    return fn(q, k, v, **kwargs)

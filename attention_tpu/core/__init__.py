@@ -1,0 +1,8 @@
+from attention_tpu.core.oracle import attention_oracle  # noqa: F401
+from attention_tpu.core.testcase import (  # noqa: F401
+    TestCase,
+    generate_testcase,
+    read_testcase,
+    verify,
+    write_testcase,
+)

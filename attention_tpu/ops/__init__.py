@@ -1,0 +1,2 @@
+from attention_tpu.ops.reference import attention_xla  # noqa: F401
+from attention_tpu.ops.flash import flash_attention, flash_attention_partials  # noqa: F401

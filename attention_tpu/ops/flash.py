@@ -1,0 +1,404 @@
+"""Fused Pallas flash-attention kernel for TPU.
+
+This is the TPU-native rebuild of the reference's entire AVX-512 kernel
+stack (`attention-mpi.c:103-189`):
+
+  * ``dot_avx512`` (QK^T inner loop)      → tiled `jax.lax.dot_general` on
+    the 128x128 MXU;
+  * ``axpy_avx512`` (softmax-weighted V)  → the P·V tile matmul, also MXU;
+  * ``memset_zero_scale``                 → vectorized scratch init /
+    rescale on the VPU;
+  * ``online_softmax_attention`` (running rmax/rsum, rescale by
+    exp(old-new), `attention-mpi.c:168-189`) → the in-kernel online
+    softmax carried in VMEM scratch across the KV grid dimension;
+  * ``_mm_prefetch`` of the next K/V rows → Pallas' automatic grid
+    double-buffering of the next K/V block's HBM→VMEM DMA;
+  * ``cvt_d2f_avx512`` mixed precision    → bf16/fp32 inputs with fp32
+    accumulation (``preferred_element_type``).
+
+Two entry points share one kernel:
+
+  * :func:`flash_attention` — normalized output, the single-chip fused op.
+  * :func:`flash_attention_partials` — returns ``(out_unnorm, row_max,
+    row_sumexp)`` per KV shard, the exact contract of the reference's
+    local pass (each rank's (contrib, lmax, lsum), `attention-mpi.c:333-338`)
+    that the distributed two-phase normalization
+    (`attention_tpu.parallel`) merges across devices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+_STAT_LANES = 128  # stats are carried lane-replicated: min f32 tile is (8, 128)
+
+
+class BlockSizes(NamedTuple):
+    """Tile sizes for the flash kernel grid.
+
+    Defaults target v5e: 128-aligned so QK^T and P·V tiles map directly to
+    the MXU, sized so q/k/v/acc blocks fit comfortably in ~16 MB VMEM with
+    double buffering (the compiler pipelines the next K/V block while the
+    current one computes — the `_mm_prefetch` analog).
+    """
+
+    block_q: int = 256
+    block_k: int = 512
+
+
+def _ceil_to(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _flash_kernel(
+    offsets_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_out_ref,
+    l_out_ref,
+    acc_scr,
+    m_scr,
+    l_scr,
+    *,
+    scale: float,
+    n_true: int,
+    block_k: int,
+    causal: bool,
+    block_q: int,
+    normalize: bool,
+    out_dtype,
+):
+    """One (head, q-block, kv-block) grid step of online-softmax attention.
+
+    ``offsets_ref`` holds (q_offset, kv_offset): the global positions of
+    this call's Q/KV rows, so causal masking stays correct when the caller
+    holds only a shard (ring attention rotates KV shards and computes the
+    rotating offset from its device index; Q may be sequence-sharded).
+    They are dynamic scalars in SMEM — ``None`` when ``causal=False``.
+    """
+    kv_idx = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # (block_q, d)
+    k = k_ref[0]  # (block_k, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale  # (block_q, block_k)
+
+    needs_tail_mask = n_true % block_k != 0
+    if needs_tail_mask or causal:
+        col = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        mask = col < n_true
+        if causal:
+            row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=0
+            )
+            mask = jnp.logical_and(
+                mask, col + offsets_ref[1] <= row + offsets_ref[0]
+            )
+        s = jnp.where(mask, s, NEG_INF)
+
+    # Online-softmax update (the rmax/rsum recurrence of
+    # `online_softmax_attention`, attention-mpi.c:175-182).  Stats live
+    # lane-replicated in (block_q, 128) VMEM scratch; reduce them back to
+    # (block_q, 1) instead of lane-slicing.
+    m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)  # (bq, 1)
+    l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    # exp(old_max - new_max) rescale of the running accumulator
+    # (attention-mpi.c:179-181); the where-guards keep fully masked
+    # blocks/rows from producing NaN via exp(-inf - -inf).
+    corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_next))
+    p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp(s - m_next))
+    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype),
+        v_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[...] = acc_scr[...] * corr + pv
+    m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        acc = acc_scr[...]
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        if normalize:
+            # 1/gsum normalization with the divide-by-zero guard the
+            # reference applies (attention-mpi.c:358-362).
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc / l_safe).astype(out_dtype)
+        else:
+            o_ref[0] = acc.astype(out_dtype)
+        if m_out_ref is not None:
+            m_out_ref[0] = m_scr[...]
+            l_out_ref[0] = l_scr[...]
+
+
+def _flash_call(
+    q: jax.Array,  # (H, m, d)
+    k: jax.Array,  # (Hkv, n, d)
+    v: jax.Array,  # (Hkv, n, dv)
+    *,
+    scale: float,
+    causal: bool,
+    normalize: bool,
+    block_sizes: BlockSizes,
+    return_stats: bool,
+    interpret: bool,
+    out_dtype,
+    q_offset=None,
+    kv_offset=None,
+):
+    h, m, d = q.shape
+    hkv, n, dv = v.shape
+    if h % hkv != 0:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    group = h // hkv
+
+    block_q = min(block_sizes.block_q, _ceil_to(m, 128))
+    block_k = min(block_sizes.block_k, _ceil_to(n, 128))
+    m_pad = _ceil_to(m, block_q)
+    n_pad = _ceil_to(n, block_k)
+    if m_pad != m:
+        q = jnp.pad(q, ((0, 0), (0, m_pad - m), (0, 0)))
+    if n_pad != n:
+        k = jnp.pad(k, ((0, 0), (0, n_pad - n), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, n_pad - n), (0, 0)))
+
+    grid = (h, m_pad // block_q, n_pad // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        n_true=n,
+        block_k=block_k,
+        causal=causal,
+        block_q=block_q,
+        normalize=normalize,
+        out_dtype=out_dtype,
+    )
+
+    offsets = jnp.array(
+        [0 if q_offset is None else q_offset, 0 if kv_offset is None else kv_offset],
+        dtype=jnp.int32,
+    )
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, d), lambda hh, i, j: (hh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda hh, i, j: (hh // group, j, 0)),
+        pl.BlockSpec((1, block_k, dv), lambda hh, i, j: (hh // group, j, 0)),
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((h, m_pad, dv), out_dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, dv), lambda hh, i, j: (hh, i, 0))]
+    if return_stats:
+        stat_shape = jax.ShapeDtypeStruct((h, m_pad, _STAT_LANES), jnp.float32)
+        stat_spec = pl.BlockSpec((1, block_q, _STAT_LANES), lambda hh, i, j: (hh, i, 0))
+        out_shapes += [stat_shape, stat_shape]
+        out_specs += [stat_spec, stat_spec]
+    else:
+        kernel = functools.partial(_no_stat_kernel, kernel)
+
+    scratch_shapes = [
+        pltpu.VMEM((block_q, dv), jnp.float32),
+        pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+        pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+    ]
+
+    try:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    except TypeError:  # older/newer param spelling
+        compiler_params = None
+
+    flops = 2 * h * m_pad * n_pad * (d + dv)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=scratch_shapes,
+        compiler_params=compiler_params,
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize
+            + h * m_pad * dv * 4,
+            transcendentals=h * m_pad * n_pad,
+        ),
+        interpret=interpret,
+    )(offsets, q, k, v)
+
+    out = outs[0][:, :m]
+    if return_stats:
+        row_max = outs[1][:, :m, 0]
+        row_sum = outs[2][:, :m, 0]
+        return out, row_max, row_sum
+    return out
+
+
+def _no_stat_kernel(kernel, off_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr):
+    kernel(off_ref, q_ref, k_ref, v_ref, o_ref, None, None, acc, m_scr, l_scr)
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _canon(q, k, v):
+    """Canonicalize (m, d) / (h, m, d) inputs to (h, m, d); return unbatcher."""
+    if q.ndim != k.ndim or q.ndim != v.ndim:
+        raise ValueError(f"rank mismatch: Q{q.shape} K{k.shape} V{v.shape}")
+    if q.shape[-1] != k.shape[-1] or k.shape[-2] != v.shape[-2]:
+        raise ValueError(f"shape mismatch: Q{q.shape} K{k.shape} V{v.shape}")
+    if k.shape[:-2] != v.shape[:-2]:
+        raise ValueError(f"K/V head dims differ: K{k.shape} V{v.shape}")
+    if q.ndim == 4 and q.shape[0] != k.shape[0]:
+        raise ValueError(f"batch mismatch: Q{q.shape} K{k.shape}")
+    if q.ndim >= 3 and q.shape[-3] % k.shape[-3] != 0:
+        raise ValueError(
+            f"q heads {q.shape[-3]} not a multiple of kv heads {k.shape[-3]}"
+        )
+    if q.ndim == 2:
+        return q[None], k[None], v[None], lambda o: o[0]
+    if q.ndim == 3:
+        return q, k, v, lambda o: o
+    if q.ndim == 4:  # (B, H, m, d): fold batch into heads
+        b, h, m_len, d = q.shape
+        bk, hkv, n_len, dkk = k.shape
+        qf = q.reshape(b * h, m_len, d)
+        kf = k.reshape(bk * hkv, n_len, dkk)
+        vf = v.reshape(bk * hkv, n_len, v.shape[-1])
+        # Folding batch outside heads keeps q-head→kv-head grouping contiguous
+        # only within a batch element; regroup so index h//group is right:
+        # q heads of batch b occupy [b*h, (b+1)*h) and kv heads [b*hkv, ...).
+        return qf, kf, vf, lambda o: o.reshape(b, h, m_len, -1)
+    raise ValueError(f"unsupported rank {q.ndim} for flash attention")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "scale",
+        "causal",
+        "block_sizes",
+        "interpret",
+    ),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+    causal: bool = False,
+    block_sizes: BlockSizes | None = None,
+    interpret: bool | None = None,
+    q_offset=None,
+    kv_offset=None,
+) -> jax.Array:
+    """Fused single-device attention: softmax(q k^T * scale) v.
+
+    Accepts (m, d), (h, m, d) or (b, h, m, d) inputs; for 3D/4D inputs the
+    number of KV heads may divide the number of Q heads (GQA — BASELINE
+    config 5: 32 Q heads sharing 4 KV heads).  ``q_offset``/``kv_offset``
+    (dynamic scalars) give the global sequence positions of the local Q/KV
+    rows for causal masking over shards.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    qh, kh, vh, unbatch = _canon(q, k, v)
+    out = _flash_call(
+        qh,
+        kh,
+        vh,
+        scale=scale,
+        causal=causal,
+        normalize=True,
+        block_sizes=block_sizes or BlockSizes(),
+        return_stats=False,
+        interpret=interpret,
+        out_dtype=v.dtype,
+        q_offset=q_offset,
+        kv_offset=kv_offset,
+    )
+    return unbatch(out)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "causal", "block_sizes", "interpret"),
+)
+def flash_attention_partials(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+    causal: bool = False,
+    block_sizes: BlockSizes | None = None,
+    interpret: bool | None = None,
+    q_offset=None,
+    kv_offset=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Unnormalized attention over a local KV shard.
+
+    Returns ``(out_unnorm, row_max, row_sumexp)`` in float32 — the
+    per-shard (contrib, lmax, lsum) triple of the reference's local online
+    softmax pass (`attention-mpi.c:168-189`), ready for the global
+    two-phase pmax/psum merge.  Shapes: out (..., m, dv), stats (..., m).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    qh, kh, vh, unbatch = _canon(q, k, v)
+    out, row_max, row_sum = _flash_call(
+        qh,
+        kh,
+        vh,
+        scale=scale,
+        causal=causal,
+        normalize=False,
+        block_sizes=block_sizes or BlockSizes(),
+        return_stats=True,
+        interpret=interpret,
+        out_dtype=jnp.float32,
+        q_offset=q_offset,
+        kv_offset=kv_offset,
+    )
+    if q.ndim == 2:
+        return out[0], row_max[0], row_sum[0]
+    if q.ndim == 4:
+        b, h = q.shape[:2]
+        return (
+            out.reshape(b, h, *out.shape[1:]),
+            row_max.reshape(b, h, -1),
+            row_sum.reshape(b, h, -1),
+        )
+    return out, row_max, row_sum

@@ -1,0 +1,81 @@
+"""XLA reference attention: the un-fused, compiler-scheduled implementation.
+
+This is the JAX analog of the reference's serial path (`attention.c:20-75`)
+— plain QK^T → softmax → V with no manual tiling — but expressed so XLA can
+fuse and tile it for the MXU.  It serves three roles:
+
+  1. a second correctness reference (vs the fp64 NumPy oracle) that runs
+     on-device;
+  2. the differentiable fallback used in training when a custom-VJP flash
+     path is not wanted;
+  3. the baseline the Pallas flash kernel's speedup is measured against
+     (the "MPI baseline" role in the reference's ablation tables,
+     README.md:95-102).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "precision"))
+def attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+    precision: str | None = None,
+) -> jax.Array:
+    """softmax(q k^T * scale) v over the last two axes.
+
+    Shapes: q (..., m, dk), k (..., n, dk), v (..., n, dv).  Leading axes
+    broadcast (batch/heads).  Scores and softmax run in float32 regardless
+    of input dtype — the mixed-precision boundary the reference implements
+    with its d2f/f2d converters (`attention-mpi.c:31-101`): narrow compute
+    inside, wider type at the edges.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum(
+        "...md,...nd->...mn", q, k, precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+    weights = jax.nn.softmax(scores * scale, axis=-1)
+    return jnp.einsum(
+        "...mn,...nd->...md", weights.astype(v.dtype), v, precision=precision,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+
+
+def attention_xla_partials(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Unnormalized attention partials over a local KV shard.
+
+    Returns ``(out_unnorm, row_max, row_sumexp)`` — the same per-shard
+    contract as the reference's local flash pass, which leaves each rank
+    holding (contrib, lmax, lsum) before the global two-phase normalization
+    (`attention-mpi.c:168-189`).  Used by the distributed paths when the
+    Pallas kernel is unavailable; all stats in float32.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum(
+        "...md,...nd->...mn", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    row_max = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - row_max[..., None])
+    row_sum = jnp.sum(p, axis=-1)
+    out_unnorm = jnp.einsum(
+        "...mn,...nd->...md", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out_unnorm.astype(jnp.float32), row_max, row_sum

@@ -1,0 +1,38 @@
+"""Test environment: a virtual 8-device CPU mesh.
+
+The reference tests its distributed path by launching the same binary at
+varying `mpirun -np` counts on a real cluster (README.md:136-142); it has
+no fake backend.  We do have one: XLA's forced host-device count gives
+eight CPU "chips", so every mesh/collective path (kv-sharded, ring,
+ulysses) runs in CI without TPU hardware.  Pallas kernels run in
+interpreter mode on CPU (selected automatically in ops.flash).
+
+These env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU even if the outer environment points JAX at a TPU: unit tests
+# must be hermetic and exercise the 8-device virtual mesh.  Set
+# ATTN_TPU_TEST_PLATFORM to override (e.g. to smoke-test on real TPU).
+# Note: a sitecustomize may have imported jax before this file runs, so the
+# env vars alone are not enough — jax.config must be updated too.
+_platform = os.environ.get("ATTN_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

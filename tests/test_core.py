@@ -1,0 +1,142 @@
+"""Tests for the fp64 oracle and the binary testcase format.
+
+The oracle is validated against a literal scalar-loop transcription of the
+reference's 3-phase algorithm (`attention.c:28-72`) on small shapes, and
+the file format round-trips through the same byte layout the reference's
+frozen harness reads (`attention.c:100-121`)."""
+
+import numpy as np
+import pytest
+
+from attention_tpu.core import (
+    attention_oracle,
+    generate_testcase,
+    read_testcase,
+    verify,
+    write_testcase,
+)
+from attention_tpu.core.oracle import attention_oracle_mha
+from attention_tpu.core.testcase import verify_file
+
+
+def _scalar_reference(q, k, v):
+    """Direct scalar-loop port of attention.c:28-72 semantics (fp64)."""
+    m, dk = q.shape
+    n, dv = v.shape
+    scale = 1.0 / np.sqrt(dk)
+    out = np.zeros((m, dv))
+    for i in range(m):
+        scores = np.array([np.dot(q[i], k[j]) * scale for j in range(n)])
+        scores = np.exp(scores - scores.max())
+        scores /= scores.sum()
+        for d in range(dv):
+            out[i, d] = np.dot(scores, v[:, d])
+    return out
+
+
+def test_oracle_matches_scalar_loops(rng):
+    q = rng.standard_normal((7, 5))
+    k = rng.standard_normal((11, 5))
+    v = rng.standard_normal((11, 3))
+    np.testing.assert_allclose(
+        attention_oracle(q, k, v), _scalar_reference(q, k, v), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_oracle_row_blocking_invariant(rng):
+    q = rng.standard_normal((33, 8))
+    k = rng.standard_normal((17, 8))
+    v = rng.standard_normal((17, 6))
+    full = attention_oracle(q, k, v, row_block=1024)
+    blocked = attention_oracle(q, k, v, row_block=4)
+    np.testing.assert_allclose(full, blocked, rtol=1e-12, atol=1e-14)
+
+
+def test_oracle_softmax_rows_sum_to_one(rng):
+    # output of attention with V=identity-ish: rows are convex combinations
+    q = rng.standard_normal((5, 4))
+    k = rng.standard_normal((6, 4))
+    v = np.ones((6, 2))
+    out = attention_oracle(q, k, v)
+    np.testing.assert_allclose(out, np.ones((5, 2)), rtol=1e-12)
+
+
+def test_oracle_mha_gqa_matches_per_head(rng):
+    hq, hkv, m, n, d = 4, 2, 6, 9, 8
+    q = rng.standard_normal((hq, m, d))
+    k = rng.standard_normal((hkv, n, d))
+    v = rng.standard_normal((hkv, n, d))
+    out = attention_oracle_mha(q, k, v)
+    for h in range(hq):
+        expected = attention_oracle(q[h], k[h // 2], v[h // 2])
+        np.testing.assert_allclose(out[h], expected, rtol=1e-12)
+
+
+def test_testcase_roundtrip(tmp_path, rng):
+    case = generate_testcase(10, 12, 4, 6, seed=7)
+    path = tmp_path / "case.bin"
+    write_testcase(path, case)
+    loaded = read_testcase(path)
+    np.testing.assert_array_equal(loaded.q, case.q)
+    np.testing.assert_array_equal(loaded.k, case.k)
+    np.testing.assert_array_equal(loaded.v, case.v)
+    np.testing.assert_array_equal(loaded.expected, case.expected)
+
+
+def test_testcase_binary_layout(tmp_path, rng):
+    """Byte-for-byte check of the reference file format (attention.c:92-99)."""
+    m, n, dk, dv = 3, 4, 2, 5
+    case = generate_testcase(m, n, dk, dv, seed=3)
+    path = tmp_path / "layout.bin"
+    write_testcase(path, case)
+    raw = path.read_bytes()
+    header = np.frombuffer(raw[:16], dtype="<i4")
+    np.testing.assert_array_equal(header, [m, n, dk, dv])
+    body = np.frombuffer(raw[16:], dtype="<f8")
+    assert body.size == m * dk + n * dk + n * dv + m * dv
+    np.testing.assert_array_equal(body[: m * dk].reshape(m, dk), case.q)
+    off = m * dk + n * dk + n * dv
+    np.testing.assert_array_equal(body[off:].reshape(m, dv), case.expected)
+
+
+def test_verify_tolerance():
+    expected = np.zeros((2, 3))
+    ok, msg = verify(expected, expected + 0.019)
+    assert ok, msg
+    ok, msg = verify(expected, expected + 0.021)
+    assert not ok
+    assert "Expect result[0][0]" in msg
+
+
+def test_verify_rejects_nan_everywhere():
+    """The reference NaN-checks only column 1 (attention.c:150); we check all."""
+    expected = np.zeros((2, 3))
+    result = expected.copy()
+    result[1, 2] = np.nan  # a position the reference's quirky check would miss
+    ok, _ = verify(expected, result)
+    assert not ok
+
+
+def test_verify_file(tmp_path):
+    case = generate_testcase(6, 8, 4, 4, seed=11)
+    path = tmp_path / "v.bin"
+    write_testcase(path, case)
+    ok, msg = verify_file(path, case.expected)
+    assert ok, msg
+    ok, _ = verify_file(path, case.expected + 0.05)
+    assert not ok
+
+
+def test_read_testcase_without_expected(tmp_path):
+    case = generate_testcase(4, 4, 2, 2, seed=0, compute_expected=False)
+    path = tmp_path / "noexp.bin"
+    write_testcase(path, case)
+    loaded = read_testcase(path)
+    assert loaded.expected is None
+
+
+def test_read_testcase_rejects_garbage(tmp_path):
+    path = tmp_path / "bad.bin"
+    path.write_bytes(b"\x01\x02")
+    with pytest.raises(ValueError):
+        read_testcase(path)

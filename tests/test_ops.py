@@ -1,0 +1,207 @@
+"""Kernel-level tests: XLA reference and Pallas flash vs the fp64 oracle.
+
+Tolerance model: the framework promises elementwise ±0.02 vs the fp64
+oracle (`attention.c:143`); unit tests assert much tighter bounds in f32
+and the contract bound for bf16."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from attention_tpu.core.oracle import attention_oracle, attention_oracle_mha
+from attention_tpu.ops.flash import (
+    BlockSizes,
+    flash_attention,
+    flash_attention_partials,
+)
+from attention_tpu.ops.reference import attention_xla, attention_xla_partials
+
+TOL_CONTRACT = 0.02
+
+
+def _rand_qkv(rng, m, n, dk, dv, dtype=np.float32):
+    q = rng.standard_normal((m, dk)).astype(dtype)
+    k = rng.standard_normal((n, dk)).astype(dtype)
+    v = rng.standard_normal((n, dv)).astype(dtype)
+    return q, k, v
+
+
+def test_xla_matches_oracle(rng):
+    q, k, v = _rand_qkv(rng, 64, 96, 32, 48)
+    out = np.asarray(attention_xla(q, k, v))
+    exp = attention_oracle(q, k, v)
+    np.testing.assert_allclose(out, exp, atol=1e-4)
+
+
+def test_xla_partials_merge_to_full(rng):
+    """Two KV shards' (contrib, lmax, lsum) merge to the full answer via the
+    two-phase max/sum scheme (attention-mpi.c:340-362, SURVEY §3.3)."""
+    q, k, v = _rand_qkv(rng, 16, 64, 8, 8)
+    halves = [(k[:32], v[:32]), (k[32:], v[32:])]
+    outs, maxes, sums = zip(
+        *[attention_xla_partials(q, kk, vv) for kk, vv in halves]
+    )
+    gmax = np.maximum(maxes[0], maxes[1])
+    total = np.zeros_like(np.asarray(outs[0]))
+    gsum = np.zeros_like(np.asarray(sums[0]))
+    for o, mx, s in zip(outs, maxes, sums):
+        corr = np.exp(np.asarray(mx) - gmax)
+        gsum += np.asarray(s) * corr
+        total += np.asarray(o) * corr[..., None]
+    merged = total / gsum[..., None]
+    np.testing.assert_allclose(merged, attention_oracle(q, k, v), atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "m,n,dk,dv",
+    [
+        (128, 128, 64, 64),
+        (256, 512, 128, 128),
+        (100, 130, 24, 40),  # ragged: exercises padding + tail masking
+        (8, 1024, 64, 64),
+    ],
+)
+def test_flash_matches_oracle_f32(rng, m, n, dk, dv):
+    q, k, v = _rand_qkv(rng, m, n, dk, dv)
+    out = np.asarray(flash_attention(q, k, v, block_sizes=BlockSizes(128, 128)))
+    exp = attention_oracle(q, k, v)
+    np.testing.assert_allclose(out, exp, atol=2e-3)
+
+
+def test_flash_bf16_within_contract(rng):
+    q, k, v = _rand_qkv(rng, 128, 256, 64, 64)
+    qb, kb, vb = (jnp.asarray(x, dtype=jnp.bfloat16) for x in (q, k, v))
+    out = np.asarray(flash_attention(qb, kb, vb)).astype(np.float64)
+    exp = attention_oracle(q, k, v)
+    assert np.max(np.abs(out - exp)) < TOL_CONTRACT
+
+
+def test_flash_block_size_invariance(rng):
+    q, k, v = _rand_qkv(rng, 192, 320, 32, 32)
+    a = flash_attention(q, k, v, block_sizes=BlockSizes(64, 64))
+    b = flash_attention(q, k, v, block_sizes=BlockSizes(256, 512))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_flash_causal(rng):
+    m = n = 64
+    q, k, v = _rand_qkv(rng, m, n, 16, 16)
+    out = np.asarray(
+        flash_attention(q, k, v, causal=True, block_sizes=BlockSizes(32, 32))
+    )
+    # dense causal reference
+    scores = (q @ k.T) / np.sqrt(16)
+    mask = np.tril(np.ones((m, n), dtype=bool))
+    scores = np.where(mask, scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, p @ v, atol=2e-3)
+
+
+def test_flash_causal_sharded_offsets(rng):
+    """Causal masking stays globally correct when KV (and Q) are shards:
+    partials over two KV halves with kv_offset merge to the dense causal
+    answer (the contract ring attention relies on)."""
+    m = n = 64
+    q, k, v = _rand_qkv(rng, m, n, 16, 16)
+    parts = []
+    for i in range(2):
+        parts.append(
+            flash_attention_partials(
+                q,
+                k[i * 32 : (i + 1) * 32],
+                v[i * 32 : (i + 1) * 32],
+                causal=True,
+                kv_offset=i * 32,
+                q_offset=0,
+                block_sizes=BlockSizes(32, 32),
+            )
+        )
+    gmax = np.maximum(np.asarray(parts[0][1]), np.asarray(parts[1][1]))
+    total = np.zeros((m, 16))
+    gsum = np.zeros((m,))
+    for o, mx, s in parts:
+        corr = np.where(np.isneginf(gmax), 0.0, np.exp(np.asarray(mx) - gmax))
+        gsum += np.asarray(s) * corr
+        total += np.asarray(o) * corr[:, None]
+    merged = total / gsum[:, None]
+    scores = (q @ k.T) / np.sqrt(16)
+    scores = np.where(np.tril(np.ones((m, n), dtype=bool)), scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(merged, p @ v, atol=2e-3)
+
+
+def test_flash_rejects_bad_gqa_heads(rng):
+    q = rng.standard_normal((3, 16, 8)).astype(np.float32)
+    k = rng.standard_normal((2, 16, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 16, 8)).astype(np.float32)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v)
+    with pytest.raises(ValueError):
+        flash_attention_partials(q, k, v)
+
+
+def test_flash_partials_merge_to_full(rng):
+    q, k, v = _rand_qkv(rng, 64, 256, 32, 32)
+    shards = [(k[i * 64 : (i + 1) * 64], v[i * 64 : (i + 1) * 64]) for i in range(4)]
+    parts = [
+        flash_attention_partials(q, kk, vv, block_sizes=BlockSizes(64, 64))
+        for kk, vv in shards
+    ]
+    gmax = np.max([np.asarray(p[1]) for p in parts], axis=0)
+    total = np.zeros((64, 32))
+    gsum = np.zeros((64,))
+    for o, mx, s in parts:
+        corr = np.exp(np.asarray(mx) - gmax)
+        gsum += np.asarray(s) * corr
+        total += np.asarray(o) * corr[:, None]
+    merged = total / gsum[:, None]
+    np.testing.assert_allclose(merged, attention_oracle(q, k, v), atol=2e-3)
+
+
+def test_flash_partials_match_normalized(rng):
+    q, k, v = _rand_qkv(rng, 96, 160, 32, 32)
+    out, mx, s = flash_attention_partials(q, k, v, block_sizes=BlockSizes(64, 64))
+    normalized = np.asarray(out) / np.asarray(s)[:, None]
+    np.testing.assert_allclose(
+        normalized, np.asarray(flash_attention(q, k, v)), atol=1e-5
+    )
+
+
+def test_flash_mha_gqa(rng):
+    hq, hkv = 4, 2
+    q = rng.standard_normal((hq, 64, 32)).astype(np.float32)
+    k = rng.standard_normal((hkv, 96, 32)).astype(np.float32)
+    v = rng.standard_normal((hkv, 96, 32)).astype(np.float32)
+    out = np.asarray(flash_attention(q, k, v, block_sizes=BlockSizes(64, 64)))
+    exp = attention_oracle_mha(q, k, v)
+    np.testing.assert_allclose(out, exp, atol=2e-3)
+
+
+def test_flash_batched_4d(rng):
+    b, hq, hkv = 2, 4, 2
+    q = rng.standard_normal((b, hq, 32, 16)).astype(np.float32)
+    k = rng.standard_normal((b, hkv, 48, 16)).astype(np.float32)
+    v = rng.standard_normal((b, hkv, 48, 16)).astype(np.float32)
+    out = np.asarray(flash_attention(q, k, v, block_sizes=BlockSizes(32, 32)))
+    assert out.shape == (b, hq, 32, 16)
+    for bi in range(b):
+        exp = attention_oracle_mha(q[bi], k[bi], v[bi])
+        np.testing.assert_allclose(out[bi], exp, atol=2e-3)
+
+
+def test_api_dispatch(rng):
+    from attention_tpu import attention, available_backends
+
+    assert {"oracle", "xla", "flash", "kv-sharded", "ring"} <= set(
+        available_backends()
+    )
+    q, k, v = _rand_qkv(rng, 32, 32, 16, 16)
+    exp = attention_oracle(q, k, v)
+    for backend in ("oracle", "xla", "flash"):
+        out = np.asarray(attention(q, k, v, backend=backend))
+        np.testing.assert_allclose(out, exp, atol=1e-3)
+    with pytest.raises(ValueError):
+        attention(q, k, v, backend="nope")
