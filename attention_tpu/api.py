@@ -10,9 +10,14 @@ backend registry:
   * ``flash``      — fused single-device Pallas flash kernel.
   * ``kv-sharded`` — KV rows sharded over a device mesh, two-phase
                      pmax/psum softmax (the `attention-mpi.c` role).
+  * ``q-sharded``  — Q rows sharded, KV replicated (the zero-collective
+                     small-KV arm of the adaptive placement policy).
   * ``ring``       — ring attention (Q and KV both sharded; KV rotates
                      over the ICI ring) for long context.
   * ``ulysses``    — all-to-all head/sequence reshard for multi-head runs.
+  * ``auto``       — picks q-sharded vs kv-sharded by KV size, the
+                     reference's adaptive 64 MB Bcast/Scatterv policy
+                     (`attention-mpi.c:210-266`).
 """
 
 from __future__ import annotations
@@ -66,9 +71,51 @@ def _ensure_registered() -> None:
 
         return ulysses_attention(q, k, v, **kw)
 
+    def _q_sharded(q, k, v, **kw):
+        from attention_tpu.parallel.kv_sharded import q_sharded_attention
+
+        return q_sharded_attention(q, k, v, **kw)
+
+    def _auto(q, k, v, threshold_bytes=None, **kw):
+        # The adaptive distribution policy (attention-mpi.c:210-266): small
+        # KV -> replicate KV / shard Q (zero per-batch collectives); large
+        # KV -> shard KV rows + two-phase softmax collectives.
+        from attention_tpu.parallel.kv_sharded import (
+            kv_sharded_attention,
+            q_sharded_attention,
+        )
+        from attention_tpu.parallel.mesh import (
+            KV_REPLICATE_THRESHOLD_BYTES,
+            choose_kv_placement,
+        )
+
+        n, dk = k.shape[-2], k.shape[-1]
+        dv = v.shape[-1]
+        kv_heads = 1
+        for dim in k.shape[:-2]:
+            kv_heads *= dim
+        placement = choose_kv_placement(
+            n,
+            dk,
+            dv,
+            itemsize=k.dtype.itemsize,
+            kv_heads=kv_heads,
+            threshold_bytes=(
+                KV_REPLICATE_THRESHOLD_BYTES
+                if threshold_bytes is None
+                else threshold_bytes
+            ),
+        )
+        if placement == "replicate":
+            kw.pop("impl", None)  # q-sharded is always the fused kernel
+            return q_sharded_attention(q, k, v, **kw)
+        return kv_sharded_attention(q, k, v, **kw)
+
     _BACKENDS["kv-sharded"] = _kv_sharded
+    _BACKENDS["q-sharded"] = _q_sharded
     _BACKENDS["ring"] = _ring
     _BACKENDS["ulysses"] = _ulysses
+    _BACKENDS["auto"] = _auto
 
 
 def attention(

@@ -76,14 +76,16 @@ def _flash_kernel(
     block_q: int,
     normalize: bool,
     out_dtype,
+    dynamic_valid: bool,
 ):
     """One (head, q-block, kv-block) grid step of online-softmax attention.
 
-    ``offsets_ref`` holds (q_offset, kv_offset): the global positions of
-    this call's Q/KV rows, so causal masking stays correct when the caller
-    holds only a shard (ring attention rotates KV shards and computes the
-    rotating offset from its device index; Q may be sequence-sharded).
-    They are dynamic scalars in SMEM — ``None`` when ``causal=False``.
+    ``offsets_ref`` holds (q_offset, kv_offset, kv_valid) as dynamic SMEM
+    scalars: the global positions of this call's Q/KV rows (causal masking
+    stays correct when the caller holds only a shard — ring attention
+    rotates KV shards and computes the rotating offset from its device
+    index) and the number of valid local KV rows (< n when the caller's
+    shard includes padding from an indivisible global sequence).
     """
     kv_idx = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -102,11 +104,11 @@ def _flash_kernel(
     s = s * scale  # (block_q, block_k)
 
     needs_tail_mask = n_true % block_k != 0
-    if needs_tail_mask or causal:
+    if needs_tail_mask or causal or dynamic_valid:
         col = kv_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
-        mask = col < n_true
+        mask = col < (offsets_ref[2] if dynamic_valid else n_true)
         if causal:
             row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, dimension=0
@@ -171,6 +173,7 @@ def _flash_call(
     out_dtype,
     q_offset=None,
     kv_offset=None,
+    kv_valid=None,
 ):
     h, m, d = q.shape
     hkv, n, dv = v.shape
@@ -199,11 +202,15 @@ def _flash_call(
         block_q=block_q,
         normalize=normalize,
         out_dtype=out_dtype,
+        dynamic_valid=kv_valid is not None,
     )
 
-    offsets = jnp.array(
-        [0 if q_offset is None else q_offset, 0 if kv_offset is None else kv_offset],
-        dtype=jnp.int32,
+    offsets = jnp.stack(
+        [
+            jnp.asarray(0 if q_offset is None else q_offset, dtype=jnp.int32),
+            jnp.asarray(0 if kv_offset is None else kv_offset, dtype=jnp.int32),
+            jnp.asarray(n if kv_valid is None else kv_valid, dtype=jnp.int32),
+        ]
     )
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -319,6 +326,7 @@ def flash_attention(
     interpret: bool | None = None,
     q_offset=None,
     kv_offset=None,
+    kv_valid=None,
 ) -> jax.Array:
     """Fused single-device attention: softmax(q k^T * scale) v.
 
@@ -346,6 +354,7 @@ def flash_attention(
         out_dtype=v.dtype,
         q_offset=q_offset,
         kv_offset=kv_offset,
+        kv_valid=kv_valid,
     )
     return unbatch(out)
 
@@ -365,6 +374,7 @@ def flash_attention_partials(
     interpret: bool | None = None,
     q_offset=None,
     kv_offset=None,
+    kv_valid=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention over a local KV shard.
 
@@ -391,6 +401,7 @@ def flash_attention_partials(
         out_dtype=jnp.float32,
         q_offset=q_offset,
         kv_offset=kv_offset,
+        kv_valid=kv_valid,
     )
     if q.ndim == 2:
         return out[0], row_max[0], row_sum[0]

@@ -57,6 +57,10 @@ def attention_xla_partials(
     v: jax.Array,
     *,
     scale: float | None = None,
+    kv_valid=None,
+    causal: bool = False,
+    q_offset=0,
+    kv_offset=0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention partials over a local KV shard.
 
@@ -65,14 +69,30 @@ def attention_xla_partials(
     holding (contrib, lmax, lsum) before the global two-phase normalization
     (`attention-mpi.c:168-189`).  Used by the distributed paths when the
     Pallas kernel is unavailable; all stats in float32.
+
+    ``kv_valid`` (optional dynamic scalar) masks trailing padded KV rows;
+    ``causal`` with ``q_offset``/``kv_offset`` applies the global causal
+    triangle over shards — both mirror the flash kernel's masking.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     scores = jnp.einsum(
         "...md,...nd->...mn", q, k, preferred_element_type=jnp.float32
     ) * scale
+    masked = False
+    if kv_valid is not None:
+        col = jnp.arange(k.shape[-2])
+        scores = jnp.where(col < kv_valid, scores, -jnp.inf)
+        masked = True
+    if causal:
+        col = jnp.arange(k.shape[-2]) + kv_offset
+        row = jnp.arange(q.shape[-2]) + q_offset
+        scores = jnp.where(col[None, :] <= row[:, None], scores, -jnp.inf)
+        masked = True
     row_max = jnp.max(scores, axis=-1)
     p = jnp.exp(scores - row_max[..., None])
+    if masked:
+        p = jnp.where(jnp.isneginf(row_max)[..., None], 0.0, p)
     row_sum = jnp.sum(p, axis=-1)
     out_unnorm = jnp.einsum(
         "...mn,...nd->...md", p.astype(v.dtype), v,

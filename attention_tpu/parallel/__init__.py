@@ -1,0 +1,8 @@
+from attention_tpu.parallel.mesh import (  # noqa: F401
+    KV_REPLICATE_THRESHOLD_BYTES,
+    choose_kv_placement,
+    default_mesh,
+)
+from attention_tpu.parallel.kv_sharded import kv_sharded_attention  # noqa: F401
+from attention_tpu.parallel.ring import ring_attention  # noqa: F401
+from attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,141 @@
+"""Ring attention: blockwise context parallelism over the ICI ring.
+
+The reference scales long sequences by sharding KV once and all-reducing
+softmax stats per Q batch (`attention-mpi.c:340-362`).  Ring attention is
+the stronger long-context schedule the reference lacks (SURVEY §2
+"parallelism-strategy inventory"): Q *and* KV are sequence-sharded, and KV
+shards rotate around the ring with ``lax.ppermute`` while each device
+accumulates online-softmax partials for its own Q shard.  After R steps
+every device has attended its queries to the full sequence with only
+nearest-neighbor ICI traffic and O(n/R) memory per chip — this is what
+makes the seq=131072 BASELINE config fit.
+
+The reference's ping-pong discipline lives on in two forms:
+
+  * the per-step online merge of (contrib, lmax, lsum) partials is the same
+    rmax/rsum rescale as `attention-mpi.c:179-181`, applied across ring
+    steps instead of KV rows;
+  * the next KV shard's ``ppermute`` is issued before the current step's
+    compute, so XLA's latency-hiding scheduler overlaps transfer with the
+    flash kernel — the `MPI_Ibcast`/compute overlap of
+    `attention-mpi.c:319-330` expressed as a data dependency instead of
+    explicit waits.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
+from attention_tpu.parallel.mesh import default_mesh
+
+NEG_INF = float("-inf")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal"),
+)
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "sp",
+    scale: float | None = None,
+    block_sizes: BlockSizes | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention over a 1D mesh axis; output is Q-sharded like Q.
+
+    Accepts the same 2D/3D/4D shapes as :func:`flash_attention`.  The
+    sequence axes of Q and K/V are sharded over ``axis_name``; both are
+    padded to a multiple of the ring size, with padded KV rows masked via
+    the kernel's dynamic ``kv_valid`` scalar and padded Q rows sliced off.
+    """
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    m = q.shape[-2]
+    n = k.shape[-2]
+    m_pad = -(-m // n_dev) * n_dev
+    n_pad = -(-n // n_dev) * n_dev
+    if m_pad != m:
+        q = jnp.pad(q, [(0, 0)] * (q.ndim - 2) + [(0, m_pad - m), (0, 0)])
+    if n_pad != n:
+        pad = [(0, 0)] * (k.ndim - 2) + [(0, n_pad - n), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    m_local = m_pad // n_dev
+    n_local = n_pad // n_dev
+
+    seq_axis = q.ndim - 2
+    seq_spec = P(*([None] * seq_axis), axis_name, None)
+    # ring neighbors: shard s moves from device j to device j+1 each step,
+    # so after step t device j holds shard (j - t) mod R
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+    )
+    def run(q_local, k_local, v_local):
+        idx = lax.axis_index(axis_name)
+        out_shape = q_local.shape[:-1] + (v_local.shape[-1],)
+        acc = jnp.zeros(out_shape, jnp.float32)
+        m_run = jnp.full(q_local.shape[:-1], NEG_INF, jnp.float32)
+        l_run = jnp.zeros(q_local.shape[:-1], jnp.float32)
+
+        def step(carry, t):
+            acc, m_run, l_run, k_cur, v_cur = carry
+            # issue the rotation for the next step first; XLA overlaps the
+            # collective-permute with the flash call below (no data dep)
+            k_next = lax.ppermute(k_cur, axis_name, perm)
+            v_next = lax.ppermute(v_cur, axis_name, perm)
+
+            shard = (idx - t) % n_dev  # which global KV shard we hold now
+            kv_valid = jnp.clip(n - shard * n_local, 0, n_local)
+            out_un, lmax, lsum = flash_attention_partials(
+                q_local,
+                k_cur,
+                v_cur,
+                scale=scale,
+                block_sizes=block_sizes,
+                causal=causal,
+                q_offset=idx * m_local,
+                kv_offset=shard * n_local,
+                kv_valid=kv_valid,
+            )
+            # online merge across ring steps (rmax/rsum recurrence,
+            # attention-mpi.c:179-181)
+            m_new = jnp.maximum(m_run, lmax)
+            c_old = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
+            c_new = jnp.where(lmax == NEG_INF, 0.0, jnp.exp(lmax - m_new))
+            acc = acc * c_old[..., None] + out_un * c_new[..., None]
+            l_new = l_run * c_old + lsum * c_new
+            return (acc, m_new, l_new, k_next, v_next), None
+
+        (acc, m_run, l_run, _, _), _ = lax.scan(
+            step,
+            (acc, m_run, l_run, k_local, v_local),
+            jnp.arange(n_dev),
+        )
+        l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+        return (acc / l_safe[..., None]).astype(q_local.dtype)
+
+    out = run(q, k, v)
+    if m_pad != m:
+        out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
+    return out

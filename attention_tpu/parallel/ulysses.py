@@ -1,0 +1,101 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The alternative context-parallel mode from SURVEY §2's strategy inventory
+(not present in the reference, which is allreduce-based): instead of
+rotating KV shards, a single ``lax.all_to_all`` converts
+sequence-sharding into head-sharding, each device runs *complete*
+attention for its subset of heads (no softmax collectives at all), and a
+second all-to-all converts back.  Two collectives total per call — cheaper
+than a ring when the head count divides the mesh and sequences are only
+moderately long.
+
+GQA handling: KV heads are repeated up to the Q head count before the
+all-to-all when the KV head count does not divide the mesh size (the
+32Q/4KV BASELINE config on an 8-chip mesh).  That spends HBM to keep the
+reshard uniform; a grouped all-to-all is a future optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from attention_tpu.ops.flash import BlockSizes, flash_attention
+from attention_tpu.parallel.mesh import default_mesh
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal"),
+)
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "sp",
+    scale: float | None = None,
+    block_sizes: BlockSizes | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all sequence-parallel attention for multi-head inputs.
+
+    Shapes: (h, m, d) or (b, h, m, d); the sequence axes are sharded over
+    ``axis_name`` on the way in and out.  Requires the Q head count to be
+    a multiple of the mesh size and sequence lengths to be multiples of
+    the mesh size.
+    """
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    if q.ndim not in (3, 4):
+        raise ValueError(f"ulysses needs (h, m, d) or (b, h, m, d); got {q.shape}")
+    hq = q.shape[-3]
+    hkv = k.shape[-3]
+    if hq % n_dev != 0:
+        raise ValueError(f"q heads {hq} not divisible by mesh size {n_dev}")
+    if q.shape[-2] % n_dev != 0 or k.shape[-2] % n_dev != 0:
+        raise ValueError(
+            f"sequence lengths {q.shape[-2]}/{k.shape[-2]} not divisible by "
+            f"mesh size {n_dev}"
+        )
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    # GQA survives the all-to-all untouched iff the KV head count divides
+    # the mesh size (contiguous head chunks keep q-head -> kv-head groups
+    # aligned per device); otherwise repeat KV heads up to the Q head count.
+    if hkv != hq and hkv % n_dev != 0:
+        if hq % hkv != 0:
+            raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+        k = jnp.repeat(k, hq // hkv, axis=-3)
+        v = jnp.repeat(v, hq // hkv, axis=-3)
+
+    head_axis = q.ndim - 3
+    seq_axis = q.ndim - 2
+    seq_spec = P(*([None] * seq_axis), axis_name, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+    )
+    def run(q_local, k_local, v_local):
+        # seq-sharded -> head-sharded: split heads across devices, gather seq
+        qh = lax.all_to_all(q_local, axis_name, head_axis, seq_axis, tiled=True)
+        kh = lax.all_to_all(k_local, axis_name, head_axis, seq_axis, tiled=True)
+        vh = lax.all_to_all(v_local, axis_name, head_axis, seq_axis, tiled=True)
+        out = flash_attention(
+            qh, kh, vh, scale=scale, block_sizes=block_sizes, causal=causal
+        )
+        # head-sharded -> seq-sharded
+        return lax.all_to_all(out, axis_name, seq_axis, head_axis, tiled=True)
+
+    return run(q, k, v)
