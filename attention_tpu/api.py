@@ -6,6 +6,8 @@ selected by which binary you build.  Here the same split is a runtime
 backend registry:
 
   * ``oracle``     — fp64 NumPy serial oracle (the `attention.c` role).
+  * ``native``     — compiled-C fp64 serial oracle (ctypes, csrc/) — the
+                     native CPU baseline.
   * ``xla``        — un-fused JAX implementation, XLA-scheduled.
   * ``flash``      — fused single-device Pallas flash kernel.
   * ``kv-sharded`` — KV rows sharded over a device mesh, two-phase
@@ -55,6 +57,13 @@ def _ensure_registered() -> None:
     _BACKENDS["oracle"] = lambda q, k, v, **kw: attention_oracle(q, k, v, **kw)
     _BACKENDS["xla"] = attention_xla
     _BACKENDS["flash"] = flash_attention
+
+    def _native(q, k, v, **kw):
+        from attention_tpu.core.native import attention_native
+
+        return attention_native(q, k, v, **kw)
+
+    _BACKENDS["native"] = _native
 
     def _kv_sharded(q, k, v, **kw):
         from attention_tpu.parallel.kv_sharded import kv_sharded_attention

@@ -1,0 +1,144 @@
+"""CLI harness: the reference's frozen main() contract, generalized.
+
+The reference binary is ``./attention <testcase.bin>`` → load, compute,
+verify, print "Correct!/Wrong!" + elapsed µs (`attention.c:164-196`,
+`attention-mpi.c:497-541`).  This CLI preserves that exact interaction —
+same output lines, same exit semantics — and adds what the course grader
+provided externally: testcase generation and backend/precision selection
+(the serial-vs-MPI binary split becomes ``--backend``).
+
+Usage:
+  python -m attention_tpu.cli run <testcase.bin> [--backend flash]
+      [--dtype bf16|f32|f64] [--repeats 1] [--no-verify]
+  python -m attention_tpu.cli generate <out.bin> --m 1024 --n 1024
+      --dk 128 --dv 128 [--seed 0]
+  python -m attention_tpu.cli suite <out_dir>     # simple..scale5 ladder
+  python -m attention_tpu.cli backends
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    from attention_tpu import attention
+    from attention_tpu.core.testcase import read_testcase, verify
+
+    try:
+        case = read_testcase(args.testcase)
+    except FileNotFoundError:
+        # reference diagnostic (attention.c:103-106)
+        print(f"Cannot open file: {args.testcase}", file=sys.stderr)
+        return 1
+    except ValueError:
+        print("Invalid testing data.", file=sys.stderr)  # attention.c:112
+        return 1
+    m, n, dk, dv = case.dims
+
+    dtype = {"bf16": "bfloat16", "f32": "float32", "f64": "float64"}[args.dtype]
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        q, k, v = (jnp.asarray(x, dtype=jnp.bfloat16) for x in (case.q, case.k, case.v))
+    else:
+        q, k, v = (x.astype(dtype) for x in (case.q, case.k, case.v))
+
+    # One untimed warmup for jit'd backends so jit compilation stays out of
+    # the timed region (the reference's timed region is pure compute,
+    # attention.c:180-182; its "compile" happened at build time).
+    if args.backend not in ("oracle", "native"):
+        warm = attention(q, k, v, backend=args.backend)
+        if hasattr(warm, "block_until_ready"):
+            warm.block_until_ready()
+    best_us = None
+    result = None
+    for _ in range(max(1, args.repeats)):
+        t0 = time.perf_counter()
+        result = attention(q, k, v, backend=args.backend)
+        if hasattr(result, "block_until_ready"):
+            result.block_until_ready()
+        elapsed = (time.perf_counter() - t0) * 1e6
+        best_us = elapsed if best_us is None else min(best_us, elapsed)
+    result = np.asarray(result, dtype=np.float64)
+
+    if args.no_verify or case.expected is None:
+        print(f"Elapsed time: {best_us:.2f} us")
+        return 0
+    ok, msg = verify(case.expected, result)
+    if ok:
+        # exact output contract of the reference (attention.c:186-187)
+        print("Correct!")
+        print(f"Elapsed time: {best_us:.2f} us")
+        return 0
+    print(msg, file=sys.stderr)
+    print("Wrong!")
+    return 1
+
+
+def _cmd_generate(args: argparse.Namespace) -> int:
+    from attention_tpu.core.testcase import generate_testcase, write_testcase
+
+    case = generate_testcase(args.m, args.n, args.dk, args.dv, seed=args.seed)
+    write_testcase(args.out, case)
+    print(f"wrote {args.out}: m={args.m} n={args.n} dk={args.dk} dv={args.dv} "
+          f"({case.nbytes()} bytes)")
+    return 0
+
+
+def _cmd_suite(args: argparse.Namespace) -> int:
+    from attention_tpu.core.testcase import generate_suite
+
+    for path in generate_suite(args.out_dir, seed=args.seed):
+        print(f"wrote {path}")
+    return 0
+
+
+def _cmd_backends(_args: argparse.Namespace) -> int:
+    from attention_tpu import available_backends
+
+    for name in available_backends():
+        print(name)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="attention-tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run a testcase and verify (reference main())")
+    run.add_argument("testcase")
+    run.add_argument("--backend", default="flash")
+    run.add_argument("--dtype", choices=["bf16", "f32", "f64"], default="f32")
+    run.add_argument("--repeats", type=int, default=1,
+                     help="min-over-repeats timing (reference methodology)")
+    run.add_argument("--no-verify", action="store_true")
+    run.set_defaults(fn=_cmd_run)
+
+    gen = sub.add_parser("generate", help="write a random testcase + oracle output")
+    gen.add_argument("out")
+    gen.add_argument("--m", type=int, required=True)
+    gen.add_argument("--n", type=int, required=True)
+    gen.add_argument("--dk", type=int, required=True)
+    gen.add_argument("--dv", type=int, required=True)
+    gen.add_argument("--seed", type=int, default=0)
+    gen.set_defaults(fn=_cmd_generate)
+
+    suite = sub.add_parser("suite", help="write the simple..scale5 ladder")
+    suite.add_argument("out_dir")
+    suite.add_argument("--seed", type=int, default=0)
+    suite.set_defaults(fn=_cmd_suite)
+
+    be = sub.add_parser("backends", help="list available backends")
+    be.set_defaults(fn=_cmd_backends)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,16 @@ def attention_xla_partials(
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if q.ndim >= 3 and q.shape[-3] != k.shape[-3]:
+        # GQA: repeat KV heads to the Q head count (the flash kernel does
+        # this implicitly via its head-group BlockSpec index map)
+        if q.shape[-3] % k.shape[-3] != 0:
+            raise ValueError(
+                f"q heads {q.shape[-3]} not a multiple of kv heads {k.shape[-3]}"
+            )
+        group = q.shape[-3] // k.shape[-3]
+        k = jnp.repeat(k, group, axis=-3)
+        v = jnp.repeat(v, group, axis=-3)
     scores = jnp.einsum(
         "...md,...nd->...mn", q, k, preferred_element_type=jnp.float32
     ) * scale

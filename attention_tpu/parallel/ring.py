@@ -98,13 +98,16 @@ def ring_attention(
         m_run = jnp.full(q_local.shape[:-1], NEG_INF, jnp.float32)
         l_run = jnp.zeros(q_local.shape[:-1], jnp.float32)
 
-        def step(carry, t):
-            acc, m_run, l_run, k_cur, v_cur = carry
-            # issue the rotation for the next step first; XLA overlaps the
-            # collective-permute with the flash call below (no data dep)
-            k_next = lax.ppermute(k_cur, axis_name, perm)
-            v_next = lax.ppermute(v_cur, axis_name, perm)
-
+        # Unrolled ring schedule (n_dev is static and small): step t computes
+        # on the shard currently held and — except on the last step, which
+        # needs no further rotation — first issues the ppermute for step
+        # t+1 so XLA overlaps the collective with the flash call (no data
+        # dependency between them).
+        k_cur, v_cur = k_local, v_local
+        for t in range(n_dev):
+            if t + 1 < n_dev:
+                k_next = lax.ppermute(k_cur, axis_name, perm)
+                v_next = lax.ppermute(v_cur, axis_name, perm)
             shard = (idx - t) % n_dev  # which global KV shard we hold now
             kv_valid = jnp.clip(n - shard * n_local, 0, n_local)
             out_un, lmax, lsum = flash_attention_partials(
@@ -124,14 +127,10 @@ def ring_attention(
             c_old = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
             c_new = jnp.where(lmax == NEG_INF, 0.0, jnp.exp(lmax - m_new))
             acc = acc * c_old[..., None] + out_un * c_new[..., None]
-            l_new = l_run * c_old + lsum * c_new
-            return (acc, m_new, l_new, k_next, v_next), None
-
-        (acc, m_run, l_run, _, _), _ = lax.scan(
-            step,
-            (acc, m_run, l_run, k_local, v_local),
-            jnp.arange(n_dev),
-        )
+            l_run = l_run * c_old + lsum * c_new
+            m_run = m_new
+            if t + 1 < n_dev:
+                k_cur, v_cur = k_next, v_next
         l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
         return (acc / l_safe[..., None]).astype(q_local.dtype)
 

@@ -1,0 +1,2 @@
+from attention_tpu.utils.flops import attention_flops, peak_flops, utilization  # noqa: F401
+from attention_tpu.utils.timing import benchmark, Timing  # noqa: F401

@@ -1,0 +1,50 @@
+"""FLOPs accounting and MXU-utilization math.
+
+The reference publishes only relative speedups (BASELINE.md); this repo's
+north-star metric is absolute — attention GFLOPs/chip and % of peak
+matmul FLOPs (BASELINE.json).  These helpers define that accounting in
+one place so bench and tests agree.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Peak dense matmul TFLOP/s per chip by TPU generation (bf16).
+# v5e: 394 TFLOP/s bf16 / 197 fp32-ish via bf16x3 (we quote bf16 peak).
+_PEAK_TFLOPS_BF16 = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 394.0,
+    "TPU v5e": 394.0,
+    "TPU v5": 459.0,  # v5p
+    "TPU v6 lite": 918.0,
+}
+
+
+def attention_flops(m: int, n: int, dk: int, dv: int, *, causal: bool = False,
+                    heads: int = 1) -> int:
+    """Matmul FLOPs for one attention: QK^T (2·m·n·dk) + P·V (2·m·n·dv).
+
+    Softmax exp/add FLOPs are excluded — the metric is *matmul-FLOPs*
+    utilization (BASELINE.json).  ``causal`` halves the score matrix.
+    """
+    total = 2 * m * n * (dk + dv) * heads
+    return total // 2 if causal else total
+
+
+def peak_flops(device=None) -> float:
+    """Peak bf16 matmul FLOP/s for the given (default: first) device."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for prefix, tflops in _PEAK_TFLOPS_BF16.items():
+        if kind.startswith(prefix):
+            return tflops * 1e12
+    # unknown hardware (e.g. CPU test runs): nominal 1 TFLOP to keep
+    # utilization numbers finite but obviously non-physical
+    return 1e12
+
+
+def utilization(flops: int, seconds: float, device=None) -> float:
+    """Fraction of peak matmul FLOPs achieved."""
+    return flops / seconds / peak_flops(device)

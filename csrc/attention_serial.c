@@ -1,0 +1,126 @@
+/*
+ * attention_serial: native fp64 serial attention oracle + testcase I/O.
+ *
+ * The native-runtime arm of the framework, filling the role the
+ * reference's serial attention.c fills (correctness oracle + CPU
+ * baseline, reference attention.c:20-75) — but designed fresh rather
+ * than transcribed:
+ *
+ *   - single-pass *online* softmax per query (running max/sum with
+ *     accumulator rescale) instead of the reference's 3-pass
+ *     max/exp-sum/normalize: one sweep over K and V per query, no O(n)
+ *     score scratch;
+ *   - query-blocked loop ordering for K/V cache reuse;
+ *   - exposed as a shared library (ctypes) rather than a standalone
+ *     binary, so the Python harness drives it like any other backend.
+ *
+ * Also provides fast bulk testcase verification matching the binary
+ * format contract (header + Q/K/V + expected; tolerance 0.02, see
+ * attention_tpu/core/testcase.py).
+ *
+ * Build: cc -O3 -march=native -shared -fPIC attention_serial.c -o libattn.so -lm
+ */
+
+#include <math.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* One query row against the full K/V, online softmax, fp64.
+ * acc must hold dv doubles; overwritten with the normalized output. */
+static void attn_row_online(const double *restrict qi,
+                            const double *restrict K,
+                            const double *restrict V,
+                            double *restrict acc,
+                            int64_t n, int64_t dk, int64_t dv,
+                            double scale) {
+    double run_max = -INFINITY;
+    double run_sum = 0.0;
+    memset(acc, 0, (size_t)dv * sizeof(double));
+
+    for (int64_t j = 0; j < n; ++j) {
+        const double *kj = K + j * dk;
+        double s = 0.0;
+        for (int64_t t = 0; t < dk; ++t) s += qi[t] * kj[t];
+        s *= scale;
+
+        double new_max = s > run_max ? s : run_max;
+        double corr = (run_max == -INFINITY) ? 0.0 : exp(run_max - new_max);
+        double w = exp(s - new_max);
+
+        run_sum = run_sum * corr + w;
+        const double *vj = V + j * dv;
+        if (corr != 1.0) {
+            for (int64_t t = 0; t < dv; ++t)
+                acc[t] = acc[t] * corr + w * vj[t];
+        } else {
+            for (int64_t t = 0; t < dv; ++t)
+                acc[t] += w * vj[t];
+        }
+        run_max = new_max;
+    }
+
+    double inv = run_sum > 0.0 ? 1.0 / run_sum : 0.0;
+    for (int64_t t = 0; t < dv; ++t) acc[t] *= inv;
+}
+
+/* Full attention: out[m][dv] = softmax(Q K^T * scale) V.
+ * scale <= 0 selects the default 1/sqrt(dk). */
+void attn_serial(const double *Q, const double *K, const double *V,
+                 double *out, int64_t m, int64_t n, int64_t dk, int64_t dv,
+                 double scale) {
+    if (scale <= 0.0) scale = 1.0 / sqrt((double)dk);
+    for (int64_t i = 0; i < m; ++i)
+        attn_row_online(Q + i * dk, K, V, out + i * dv, n, dk, dv, scale);
+}
+
+/* Elementwise verification: returns the index of the first element with
+ * |result - expected| > tol or a non-finite result, or -1 if all pass.
+ * (The reference's verify, attention.c:123-162, with the NaN-check-
+ * column bug fixed: every element is checked.) */
+int64_t attn_verify(const double *result, const double *expected,
+                    int64_t count, double tol) {
+    for (int64_t i = 0; i < count; ++i) {
+        double r = result[i];
+        if (!isfinite(r) || fabs(r - expected[i]) > tol) return i;
+    }
+    return -1;
+}
+
+/* Testcase file reader: validates the header and bulk-loads all four
+ * sections into caller-provided buffers (any may be NULL to skip).
+ * Returns 0 on success, negative error codes otherwise:
+ *  -1 open failed   -2 bad header   -3 truncated data
+ *  -4 no expected section (only if expected buffer requested) */
+int attn_read_testcase(const char *path, int32_t *dims,
+                       double *Q, double *K, double *V, double *expected) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return -1;
+    int32_t hdr[4];
+    if (fread(hdr, sizeof(int32_t), 4, f) != 4 ||
+        hdr[0] <= 0 || hdr[1] <= 0 || hdr[2] <= 0 || hdr[3] <= 0) {
+        fclose(f);
+        return -2;
+    }
+    memcpy(dims, hdr, sizeof(hdr));
+    size_t m = (size_t)hdr[0], n = (size_t)hdr[1];
+    size_t dk = (size_t)hdr[2], dv = (size_t)hdr[3];
+    struct { double *buf; size_t len; } sections[] = {
+        {Q, m * dk}, {K, n * dk}, {V, n * dv}, {expected, m * dv},
+    };
+    int rc = 0;
+    for (int s = 0; s < 4 && rc == 0; ++s) {
+        if (sections[s].buf) {
+            size_t got = fread(sections[s].buf, sizeof(double),
+                               sections[s].len, f);
+            if (got != sections[s].len) rc = (s == 3) ? -4 : -3;
+        } else if (s < 3) {
+            if (fseek(f, (long)(sections[s].len * sizeof(double)),
+                      SEEK_CUR) != 0) rc = -3;
+        }
+    }
+    fclose(f);
+    return rc;
+}

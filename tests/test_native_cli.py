@@ -1,0 +1,139 @@
+"""Native C oracle, ctypes bridge, utils, and CLI harness tests."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from attention_tpu.core.native import (
+    attention_native,
+    native_available,
+    read_testcase_native,
+    verify_native,
+)
+from attention_tpu.core.oracle import attention_oracle
+from attention_tpu.core.testcase import generate_testcase, write_testcase
+from attention_tpu.utils.flops import attention_flops
+from attention_tpu.utils.timing import benchmark
+
+
+def test_native_builds():
+    assert native_available(), "C toolchain present in image; build must work"
+
+
+def test_native_matches_numpy_oracle(rng):
+    q = rng.standard_normal((37, 19))
+    k = rng.standard_normal((53, 19))
+    v = rng.standard_normal((53, 23))
+    out = attention_native(q, k, v)
+    # online-softmax (C) vs 3-pass (NumPy): same math, fp64 — tiny drift only
+    np.testing.assert_allclose(out, attention_oracle(q, k, v), atol=1e-12)
+
+
+def test_native_scale_override(rng):
+    q = rng.standard_normal((8, 4))
+    k = rng.standard_normal((8, 4))
+    v = rng.standard_normal((8, 4))
+    np.testing.assert_allclose(
+        attention_native(q, k, v, scale=0.5),
+        attention_oracle(q, k, v, scale=0.5),
+        atol=1e-12,
+    )
+
+
+def test_verify_native():
+    expected = np.zeros((4, 4))
+    assert verify_native(expected + 0.01, expected) == -1
+    bad = expected.copy()
+    bad[2, 3] = 0.05
+    assert verify_native(bad, expected) == 2 * 4 + 3
+    nan = expected.copy()
+    nan[1, 1] = np.nan
+    assert verify_native(nan, expected) == 1 * 4 + 1
+
+
+def test_native_testcase_reader(tmp_path):
+    case = generate_testcase(6, 9, 4, 5, seed=2)
+    path = tmp_path / "n.bin"
+    write_testcase(path, case)
+    loaded = read_testcase_native(str(path))
+    np.testing.assert_array_equal(loaded.q, case.q)
+    np.testing.assert_array_equal(loaded.expected, case.expected)
+
+
+def test_native_reader_no_expected(tmp_path):
+    case = generate_testcase(4, 4, 2, 2, compute_expected=False)
+    path = tmp_path / "ne.bin"
+    write_testcase(path, case)
+    loaded = read_testcase_native(str(path))
+    assert loaded.expected is None
+
+
+def test_native_reader_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        read_testcase_native(str(tmp_path / "missing.bin"))
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"xx")
+    with pytest.raises(ValueError):
+        read_testcase_native(str(bad))
+
+
+def test_attention_flops():
+    assert attention_flops(4, 8, 2, 3) == 2 * 4 * 8 * 5
+    assert attention_flops(4, 8, 2, 3, causal=True) == 4 * 8 * 5
+    assert attention_flops(4, 8, 2, 3, heads=2) == 4 * 4 * 8 * 5
+
+
+def test_benchmark_smoke():
+    t = benchmark(lambda: np.ones(4), repeats=3, warmup=1)
+    assert len(t.times_s) == 3
+    assert t.best_s <= t.median_s
+
+
+CLI_ENV_PRELUDE = (
+    "import jax; jax.config.update('jax_platforms', 'cpu'); "
+    "import attention_tpu.cli as c, sys; sys.exit(c.main(sys.argv[1:]))"
+)
+
+
+def _run_cli(*args, cwd="/root/repo"):
+    return subprocess.run(
+        [sys.executable, "-c", CLI_ENV_PRELUDE, *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=300,
+    )
+
+
+def test_cli_end_to_end(tmp_path):
+    case_path = str(tmp_path / "cli.bin")
+    r = _run_cli("generate", case_path, "--m", "64", "--n", "64", "--dk", "16",
+                 "--dv", "16")
+    assert r.returncode == 0, r.stderr
+    r = _run_cli("run", case_path, "--backend", "flash")
+    assert r.returncode == 0, r.stderr
+    assert "Correct!" in r.stdout
+    assert "Elapsed time:" in r.stdout
+    r = _run_cli("run", case_path, "--backend", "native")
+    assert r.returncode == 0, r.stderr
+    assert "Correct!" in r.stdout
+
+
+def test_cli_wrong_detection(tmp_path):
+    # corrupt the expected section -> harness must print Wrong! and exit 1
+    case = generate_testcase(8, 8, 4, 4, seed=1)
+    case.expected = case.expected + 1.0
+    path = tmp_path / "wrong.bin"
+    write_testcase(path, case)
+    r = _run_cli("run", str(path), "--backend", "oracle")
+    assert r.returncode == 1
+    assert "Wrong!" in r.stdout
+    assert "Expect result[0][0]" in r.stderr
+
+
+def test_cli_backends_list():
+    r = _run_cli("backends")
+    assert r.returncode == 0
+    assert "flash" in r.stdout and "kv-sharded" in r.stdout

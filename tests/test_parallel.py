@@ -67,11 +67,12 @@ def test_kv_sharded_single_device_mesh(rng):
     np.testing.assert_allclose(out, attention_oracle(q, k, v), atol=2e-3)
 
 
-def test_kv_sharded_gqa_3d(rng):
+@pytest.mark.parametrize("impl", ["flash", "xla"])
+def test_kv_sharded_gqa_3d(rng, impl):
     q = rng.standard_normal((4, 32, 16)).astype(np.float32)
     k = rng.standard_normal((2, 128, 16)).astype(np.float32)
     v = rng.standard_normal((2, 128, 16)).astype(np.float32)
-    out = np.asarray(kv_sharded_attention(q, k, v, block_sizes=BS))
+    out = np.asarray(kv_sharded_attention(q, k, v, block_sizes=BS, impl=impl))
     np.testing.assert_allclose(out, attention_oracle_mha(q, k, v), atol=2e-3)
 
 
