@@ -48,22 +48,19 @@ def _cmd_run(args: argparse.Namespace) -> int:
     else:
         q, k, v = (x.astype(dtype) for x in (case.q, case.k, case.v))
 
-    # One untimed warmup for jit'd backends so jit compilation stays out of
-    # the timed region (the reference's timed region is pure compute,
-    # attention.c:180-182; its "compile" happened at build time).
-    if args.backend not in ("oracle", "native"):
-        warm = attention(q, k, v, backend=args.backend)
-        if hasattr(warm, "block_until_ready"):
-            warm.block_until_ready()
-    best_us = None
-    result = None
-    for _ in range(max(1, args.repeats)):
-        t0 = time.perf_counter()
-        result = attention(q, k, v, backend=args.backend)
-        if hasattr(result, "block_until_ready"):
-            result.block_until_ready()
-        elapsed = (time.perf_counter() - t0) * 1e6
-        best_us = elapsed if best_us is None else min(best_us, elapsed)
+    from attention_tpu.utils.timing import benchmark
+
+    # One untimed run produces the result and doubles as warmup, keeping
+    # one-time costs (jit compilation; the native backend's first-use C
+    # build) out of the timed region — the reference's timed region is
+    # pure compute (attention.c:180-182), its compile happened at build
+    # time.  Timing then follows the shared min-over-repeats discipline.
+    result = attention(q, k, v, backend=args.backend)
+    timing = benchmark(
+        attention, q, k, v, backend=args.backend,
+        repeats=max(1, args.repeats), warmup=0,
+    )
+    best_us = timing.best_us
     result = np.asarray(result, dtype=np.float64)
 
     if args.no_verify or case.expected is None:

@@ -37,17 +37,21 @@ def _build_and_load() -> ctypes.CDLL | None:
             if not os.path.exists(lib_path) or os.path.getmtime(
                 lib_path
             ) < os.path.getmtime(src):
+                # build to a process-private temp path, then atomically
+                # rename: concurrent builders never load a half-written .so
+                tmp_path = f"{lib_path}.{os.getpid()}.tmp"
                 for cc in ("cc", "gcc", "clang"):
                     try:
                         subprocess.run(
                             [
                                 cc, "-O3", "-march=native", "-shared", "-fPIC",
-                                src, "-o", lib_path, "-lm",
+                                src, "-o", tmp_path, "-lm",
                             ],
                             check=True,
                             capture_output=True,
                             timeout=120,
                         )
+                        os.replace(tmp_path, lib_path)
                         break
                     except (FileNotFoundError, subprocess.CalledProcessError):
                         continue

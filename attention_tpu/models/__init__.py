@@ -1,0 +1,2 @@
+from attention_tpu.models.attention_layer import GQASelfAttention  # noqa: F401
+from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401

@@ -1,0 +1,82 @@
+"""Flax attention modules built on the framework's kernels.
+
+The reference is a bare kernel with no model around it; these modules are
+the "model family" surface a framework user needs: a grouped-query
+self-attention layer (BASELINE config 5: 32 Q heads / 4 KV heads) whose
+inner op is selectable between the differentiable fused flash path and
+the auto-SPMD XLA path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from attention_tpu.ops.flash_vjp import flash_attention_diff
+from attention_tpu.ops.reference import attention_xla
+
+
+def _xla_mha(q, k, v, *, causal):
+    """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
+    and auto-partitionable by XLA under pjit shardings."""
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=1)
+        v = jnp.repeat(v, hq // hkv, axis=1)
+    if not causal:
+        return attention_xla(q, k, v)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhmd,bhnd->bhmn", q, k, preferred_element_type=jnp.float32)
+    mask = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
+    s = jnp.where(mask, s * scale, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhmn,bhnd->bhmd", p, v)
+
+
+def _flash_mha(q, k, v, *, causal):
+    return flash_attention_diff(q, k, v, causal=causal)
+
+
+ATTN_IMPLS: dict[str, Callable] = {"xla": _xla_mha, "flash": _flash_mha}
+
+
+class GQASelfAttention(nn.Module):
+    """Grouped-query self-attention: (B, S, D) -> (B, S, D).
+
+    ``impl='flash'`` uses the fused Pallas kernel (custom VJP);
+    ``impl='xla'`` uses dense einsums that XLA partitions automatically
+    under dp/sp/tp shardings (the training default on a mesh).
+    """
+
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    impl: str = "flash"
+    causal: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.num_q_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"q heads {self.num_q_heads} not a multiple of kv heads "
+                f"{self.num_kv_heads}"
+            )
+        dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
+            features=(heads, self.head_dim),
+            use_bias=False,
+            dtype=self.dtype,
+            name=name,
+        )
+        q = dense("q_proj", self.num_q_heads)(x)  # (B, S, Hq, dh)
+        k = dense("k_proj", self.num_kv_heads)(x)
+        v = dense("v_proj", self.num_kv_heads)(x)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B, H, S, dh)
+        out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal)
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
+        return nn.DenseGeneral(
+            features=x.shape[-1], use_bias=False, dtype=self.dtype, name="o_proj"
+        )(out.astype(self.dtype))

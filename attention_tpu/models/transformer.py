@@ -1,0 +1,77 @@
+"""Transformer block and tiny decoder LM around the attention kernels.
+
+The flagship end-to-end model: pre-norm decoder blocks whose attention is
+this framework's GQA layer.  Exists so the framework has a real model
+family to (a) run the fused kernel inside, (b) train under dp/sp/tp mesh
+shardings, and (c) serve as the `__graft_entry__` forward step.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from attention_tpu.models.attention_layer import GQASelfAttention
+
+
+class MLP(nn.Module):
+    hidden_mult: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.Dense(d * self.hidden_mult, use_bias=False, dtype=self.dtype)(x)
+        h = nn.gelu(h)
+        return nn.Dense(d, use_bias=False, dtype=self.dtype)(h)
+
+
+class TransformerBlock(nn.Module):
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    impl: str = "flash"
+    causal: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.RMSNorm(dtype=self.dtype)(x)
+        x = x + GQASelfAttention(
+            num_q_heads=self.num_q_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            impl=self.impl,
+            causal=self.causal,
+            dtype=self.dtype,
+        )(y)
+        y = nn.RMSNorm(dtype=self.dtype)(x)
+        return x + MLP(dtype=self.dtype)(y)
+
+
+class TinyDecoder(nn.Module):
+    """Decoder-only LM: embed -> N blocks -> norm -> logits."""
+
+    vocab: int = 256
+    dim: int = 256
+    depth: int = 2
+    num_q_heads: int = 8
+    num_kv_heads: int = 2
+    impl: str = "flash"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:  # (B, S) int32
+        head_dim = self.dim // self.num_q_heads
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                num_q_heads=self.num_q_heads,
+                num_kv_heads=self.num_kv_heads,
+                head_dim=head_dim,
+                impl=self.impl,
+                dtype=self.dtype,
+            )(x)
+        x = nn.RMSNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32)(x)

@@ -1,0 +1,155 @@
+"""Differentiable flash attention: custom VJP around the Pallas kernel.
+
+The reference is a forward-only inference kernel (no backward pass exists
+anywhere in `attention.c`/`attention-mpi.c`); training support is new
+surface this framework adds so the attention op can sit inside a model.
+
+Design: the forward pass runs the fused Pallas kernel and saves only
+(q, k, v, out, lse) — the flash-attention residual contract — instead of
+the O(m·n) probability matrix.  The backward pass recomputes P tile-wise
+from the saved log-sum-exp and contracts with standard flash-backward
+algebra:
+
+    P  = exp(S - lse)            D  = rowsum(dO ∘ O)
+    dV = Pᵀ dO                   dS = P ∘ (dO Vᵀ - D)
+    dQ = scale · dS K            dK = scale · dSᵀ Q
+
+Backward is expressed in blocked XLA einsums (``lax.map`` over Q chunks)
+rather than a hand-written Pallas kernel for now: XLA fuses the chunked
+contractions onto the MXU, and memory stays O(m·chunk + chunk·n).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
+
+NEG_INF = float("-inf")
+
+
+def _gqa_expand(k, group):
+    return jnp.repeat(k, group, axis=0) if group > 1 else k
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, scale, causal, block_sizes, bwd_chunk):
+    out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, scale, causal, block_sizes):
+    out_un, row_max, row_sum = flash_attention_partials(
+        q, k, v, scale=scale, causal=causal, block_sizes=block_sizes
+    )
+    l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
+    out = (out_un / l_safe[..., None]).astype(q.dtype)
+    lse = jnp.where(
+        row_max == NEG_INF, NEG_INF, row_max + jnp.log(l_safe)
+    )
+    return out, lse
+
+
+def _flash_diff_fwd(q, k, v, scale, causal, block_sizes, bwd_chunk):
+    out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, res, dout):
+    q, k, v, out, lse = res
+    h, m, dk = q.shape
+    hkv, n, dv = v.shape
+    group = h // hkv
+    kx = _gqa_expand(k, group)  # (h, n, dk)
+    vx = _gqa_expand(v, group)
+
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, kx, vx))
+    dout32 = dout.astype(jnp.float32)
+    out32 = out.astype(jnp.float32)
+
+    # D_i = sum_d dO_id * O_id  (the softmax-jacobian diagonal term)
+    delta = jnp.sum(dout32 * out32, axis=-1)  # (h, m)
+
+    chunk = min(bwd_chunk, m)
+    pad = (-m) % chunk
+    if pad:
+        qp = jnp.pad(q32, ((0, 0), (0, pad), (0, 0)))
+        dop = jnp.pad(dout32, ((0, 0), (0, pad), (0, 0)))
+        lsep = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=NEG_INF)
+        deltap = jnp.pad(delta, ((0, 0), (0, pad)))
+    else:
+        qp, dop, lsep, deltap = q32, dout32, lse, delta
+    n_chunks = qp.shape[1] // chunk
+    qc = qp.reshape(h, n_chunks, chunk, dk).transpose(1, 0, 2, 3)
+    doc = dop.reshape(h, n_chunks, chunk, dv).transpose(1, 0, 2, 3)
+    lsec = lsep.reshape(h, n_chunks, chunk).transpose(1, 0, 2)
+    deltac = deltap.reshape(h, n_chunks, chunk).transpose(1, 0, 2)
+
+    row_base = jnp.arange(n_chunks) * chunk
+
+    def one_chunk(args):
+        qi, doi, lsei, di, base = args  # (h, chunk, dk) etc.
+        s = jnp.einsum("hqd,hnd->hqn", qi, k32) * scale
+        if causal:
+            rows = base + jnp.arange(chunk)
+            mask = jnp.arange(n)[None, :] <= rows[:, None]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(lsei[..., None] == NEG_INF, 0.0, jnp.exp(s - lsei[..., None]))
+        dp = jnp.einsum("hqe,hne->hqn", doi, v32)
+        ds = p * (dp - di[..., None])  # (h, chunk, n)
+        dq_i = jnp.einsum("hqn,hnd->hqd", ds, k32) * scale
+        dk_i = jnp.einsum("hqn,hqd->hnd", ds, qi) * scale
+        dv_i = jnp.einsum("hqn,hqe->hne", p, doi)
+        return dq_i, dk_i, dv_i
+
+    dq_chunks, dk_parts, dv_parts = lax.map(
+        one_chunk, (qc, doc, lsec, deltac, row_base)
+    )
+    dq = dq_chunks.transpose(1, 0, 2, 3).reshape(h, m + pad, dk)[:, :m]
+    dk_full = jnp.sum(dk_parts, axis=0)  # (h, n, dk)
+    dv_full = jnp.sum(dv_parts, axis=0)  # (h, n, dv)
+    if group > 1:
+        dk_full = dk_full.reshape(hkv, group, n, dk).sum(axis=1)
+        dv_full = dv_full.reshape(hkv, group, n, dv).sum(axis=1)
+    return dq.astype(q.dtype), dk_full.astype(k.dtype), dv_full.astype(v.dtype)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention_diff(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+    causal: bool = False,
+    block_sizes: BlockSizes | None = None,
+    bwd_chunk: int = 512,
+) -> jax.Array:
+    """Differentiable fused attention; same shape contract as
+    :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
+
+    Forward = Pallas flash kernel; backward = blocked recompute from the
+    saved log-sum-exp.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    bs = block_sizes or BlockSizes()
+    if q.ndim == 2:
+        return _flash_diff(q[None], k[None], v[None], scale, causal, bs, bwd_chunk)[0]
+    if q.ndim == 3:
+        return _flash_diff(q, k, v, scale, causal, bs, bwd_chunk)
+    if q.ndim == 4:
+        b, hq, m, d = q.shape
+        kf = k.reshape(b * k.shape[1], *k.shape[2:])
+        vf = v.reshape(b * v.shape[1], *v.shape[2:])
+        out = _flash_diff(
+            q.reshape(b * hq, m, d), kf, vf, scale, causal, bs, bwd_chunk
+        )
+        return out.reshape(b, hq, m, -1)
+    raise ValueError(f"unsupported rank {q.ndim}")

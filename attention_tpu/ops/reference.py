@@ -76,19 +76,29 @@ def attention_xla_partials(
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if q.ndim >= 3 and q.shape[-3] != k.shape[-3]:
-        # GQA: repeat KV heads to the Q head count (the flash kernel does
-        # this implicitly via its head-group BlockSpec index map)
-        if q.shape[-3] % k.shape[-3] != 0:
+    grouped = (
+        q.ndim >= 3 and k.ndim >= 3 and q.shape[-3] != k.shape[-3]
+    )
+    if grouped:
+        # GQA: fold Q heads into (kv_heads, group) and contract against the
+        # unexpanded K/V — no repeated-KV materialization (the flash kernel
+        # achieves the same via its head-group BlockSpec index map)
+        hq, hkv = q.shape[-3], k.shape[-3]
+        if hq % hkv != 0:
             raise ValueError(
-                f"q heads {q.shape[-3]} not a multiple of kv heads {k.shape[-3]}"
+                f"q heads {hq} not a multiple of kv heads {hkv}"
             )
-        group = q.shape[-3] // k.shape[-3]
-        k = jnp.repeat(k, group, axis=-3)
-        v = jnp.repeat(v, group, axis=-3)
-    scores = jnp.einsum(
-        "...md,...nd->...mn", q, k, preferred_element_type=jnp.float32
-    ) * scale
+        group = hq // hkv
+        qg = q.reshape(*q.shape[:-3], hkv, group, *q.shape[-2:])
+        scores = jnp.einsum(
+            "...hgmd,...hnd->...hgmn", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        scores = scores.reshape(*scores.shape[:-4], hq, *scores.shape[-2:])
+    else:
+        scores = jnp.einsum(
+            "...md,...nd->...mn", q, k, preferred_element_type=jnp.float32
+        ) * scale
     masked = False
     if kv_valid is not None:
         col = jnp.arange(k.shape[-2])
@@ -104,8 +114,18 @@ def attention_xla_partials(
     if masked:
         p = jnp.where(jnp.isneginf(row_max)[..., None], 0.0, p)
     row_sum = jnp.sum(p, axis=-1)
-    out_unnorm = jnp.einsum(
-        "...mn,...nd->...md", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
+    if grouped:
+        pg = p.reshape(*p.shape[:-3], hkv, group, *p.shape[-2:])
+        out_unnorm = jnp.einsum(
+            "...hgmn,...hnd->...hgmd", pg.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        out_unnorm = out_unnorm.reshape(
+            *out_unnorm.shape[:-4], hq, *out_unnorm.shape[-2:]
+        )
+    else:
+        out_unnorm = jnp.einsum(
+            "...mn,...nd->...md", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
     return out_unnorm.astype(jnp.float32), row_max, row_sum

@@ -1,0 +1,91 @@
+"""Benchmark harness: attention GFLOPs/chip on real TPU.
+
+North-star metric (BASELINE.json): attention matmul GFLOPs/chip
+(QK^T + softmax + V) at seq=32k, m=n=32768, d_k=d_v=128, bf16 compute /
+fp32 accumulation, fused Pallas flash kernel, single v5e chip.
+``vs_baseline`` is measured utilization against the >=50%-of-peak target
+(1.0 = target met; >1.0 = beaten).  The reference publishes only relative
+speedups (BASELINE.md), so the absolute bar is this repo's own target.
+
+Default: prints ONE JSON line for the headline config.
+``--all`` benchmarks the full BASELINE.json config ladder.
+``--repeats/--seq/--dim`` override the headline shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _bench_flash(seq: int, dim: int, repeats: int, block_q: int, block_k: int):
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.flash import BlockSizes, flash_attention
+    from attention_tpu.utils.flops import attention_flops, peak_flops
+    from attention_tpu.utils.timing import benchmark
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (seq, dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (seq, dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (seq, dim), jnp.bfloat16)
+    bs = BlockSizes(block_q, block_k)
+    t = benchmark(
+        flash_attention, q, k, v, block_sizes=bs, repeats=repeats, warmup=2
+    )
+    flops = attention_flops(seq, seq, dim, dim)
+    gflops = flops / t.best_s / 1e9
+    util = flops / t.best_s / peak_flops()
+    return {
+        "gflops_per_chip": gflops,
+        "utilization": util,
+        "best_us": t.best_us,
+        "median_us": t.median_s * 1e6,
+        "seq": seq,
+        "dim": dim,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=32768)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--block-q", type=int, default=256)
+    p.add_argument("--block-k", type=int, default=512)
+    p.add_argument("--all", action="store_true", help="full config ladder")
+    args = p.parse_args(argv)
+
+    r = _bench_flash(args.seq, args.dim, args.repeats, args.block_q, args.block_k)
+    result = {
+        "metric": f"attention GFLOPs/chip (QKT+softmax+V), seq={args.seq}, "
+        f"d={args.dim}, bf16 flash",
+        "value": round(r["gflops_per_chip"], 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(r["utilization"] / 0.50, 4),
+        "detail": {
+            "utilization_of_peak": round(r["utilization"], 4),
+            "best_us": round(r["best_us"], 1),
+            "median_us": round(r["median_us"], 1),
+        },
+    }
+
+    if args.all:
+        ladder = {}
+        for name, (seq, dim) in {
+            "single_chip_8k": (8192, 128),
+            "seq_32k": (32768, 128),
+        }.items():
+            ladder[name] = _bench_flash(seq, dim, args.repeats, args.block_q,
+                                        args.block_k)
+        result["detail"]["ladder"] = ladder
+
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

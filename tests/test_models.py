@@ -1,0 +1,90 @@
+"""Model-layer tests: attention module, decoder forward, sharded training."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from attention_tpu.models.attention_layer import GQASelfAttention
+from attention_tpu.models.train import (
+    init_sharded,
+    loss_fn,
+    make_mesh_3d,
+    make_train_step,
+)
+from attention_tpu.models.transformer import TinyDecoder, TransformerBlock
+
+
+def test_gqa_attention_impls_agree(rng):
+    x = jnp.asarray(rng.standard_normal((2, 64, 64)), jnp.float32)
+    outs = {}
+    for impl in ("flash", "xla"):
+        layer = GQASelfAttention(
+            num_q_heads=4, num_kv_heads=2, head_dim=16, impl=impl,
+            dtype=jnp.float32,
+        )
+        params = layer.init(jax.random.PRNGKey(0), x)
+        outs[impl] = np.asarray(layer.apply(params, x))
+    np.testing.assert_allclose(outs["flash"], outs["xla"], atol=2e-3)
+
+
+def test_transformer_block_forward(rng):
+    x = jnp.asarray(rng.standard_normal((2, 32, 64)), jnp.bfloat16)
+    block = TransformerBlock(num_q_heads=4, num_kv_heads=2, head_dim=16)
+    params = block.init(jax.random.PRNGKey(0), x)
+    y = block.apply(params, x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y, dtype=np.float32)).all()
+
+
+def test_decoder_forward_and_loss(rng):
+    model = TinyDecoder(vocab=64, dim=64, depth=1, num_q_heads=4, num_kv_heads=2)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 33)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:, :-1])["params"]
+    logits = model.apply({"params": params}, tokens[:, :-1])
+    assert logits.shape == (2, 32, 64)
+    loss = loss_fn(params, model, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_mesh_factorization():
+    mesh = make_mesh_3d(8)
+    assert mesh.devices.size == 8
+    assert set(mesh.axis_names) == {"dp", "sp", "tp"}
+    assert make_mesh_3d(1).devices.size == 1
+
+
+def test_sharded_training_step_decreases_loss(rng):
+    """Full dp/sp/tp-sharded train step on the 8-device CPU mesh: loss
+    must move and params must stay finite over a few steps."""
+    mesh = make_mesh_3d(8)
+    model = TinyDecoder(
+        vocab=64, dim=64, depth=1, num_q_heads=4, num_kv_heads=2, impl="xla",
+        dtype=jnp.float32,
+    )
+    params, optimizer, opt_state = init_sharded(model, mesh, batch=4, seq=32)
+    step = make_train_step(model, optimizer, mesh)
+    tokens = jnp.asarray(rng.integers(0, 64, (4, 33)), jnp.int32)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_training_step_flash_impl_single_dp(rng):
+    """The flash custom-VJP path trains too (dp-only sharding so the
+    Pallas op sees full sequences per device)."""
+    mesh = make_mesh_3d(1)
+    model = TinyDecoder(
+        vocab=32, dim=32, depth=1, num_q_heads=2, num_kv_heads=1, impl="flash",
+        dtype=jnp.float32,
+    )
+    params, optimizer, opt_state = init_sharded(model, mesh, batch=2, seq=16)
+    step = make_train_step(model, optimizer, mesh)
+    tokens = jnp.asarray(rng.integers(0, 32, (2, 17)), jnp.int32)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
