@@ -1,0 +1,68 @@
+"""Profiling and structured metrics (SURVEY §5 observability plan).
+
+The reference's observability is a printf of wall time and correctness
+(`attention.c:186-188`), and its per-phase analysis was done by ablation
+builds rather than instrumentation (report Q2).  Here:
+
+  * :func:`trace` wraps ``jax.profiler.trace`` so any benchmark or test
+    can capture an XLA/TPU trace (xplane) for the profiler UI;
+  * :func:`annotate` names a phase so it shows up on the trace timeline
+    (the instrumentation the reference lacked);
+  * :class:`RunRecord` is the structured per-run JSON record
+    (config, timing, GFLOPs, utilization, device) that replaces printf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace of the enclosed block."""
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (and in HLO op names)."""
+    return jax.named_scope(name)
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """One benchmark run, JSON-serializable."""
+
+    config: str
+    backend: str
+    m: int
+    n: int
+    dk: int
+    dv: int
+    dtype: str
+    best_us: float
+    median_us: float
+    gflops_per_chip: float
+    utilization: float
+    device_kind: str
+    n_devices: int
+    mesh_axes: dict[str, int] | None = None
+    extra: dict[str, Any] | None = None
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def append_jsonl(path: str, record: RunRecord) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(record.to_json() + "\n")

@@ -1,0 +1,74 @@
+"""Smoke tests for the benchmark suite + profiling on the CPU mesh.
+
+These assert structure/consistency, not absolute performance (CPU timing
+is meaningless); real numbers come from bench.py on TPU."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attention_tpu.benchmarks import ablation_table, strong_scaling, weak_scaling
+from attention_tpu.ops.flash import BlockSizes
+from attention_tpu.parallel.mesh import default_mesh
+from attention_tpu.utils.profiling import RunRecord, append_jsonl, annotate, trace
+
+BS = BlockSizes(64, 64)
+
+
+def test_ablation_table_structure():
+    table = ablation_table(128, 128, 32, 32, repeats=1, block_sizes=BS)
+    assert {"baseline", "fused", "mixed", "full"} <= set(table)
+    for rec in table.values():
+        assert rec.best_us > 0
+        assert np.isfinite(rec.gflops_per_chip)
+        assert rec.extra["speedup_vs_baseline"] > 0
+    assert table["baseline"].extra["speedup_vs_baseline"] == 1.0
+
+
+def test_ablation_with_mesh():
+    mesh = default_mesh("kv", devices=jax.devices()[:2])
+    table = ablation_table(64, 128, 16, 16, repeats=1, block_sizes=BS, mesh=mesh)
+    assert "overlap" in table
+    assert table["overlap"].n_devices == 2
+    assert table["overlap"].mesh_axes == {"kv": 2}
+
+
+def test_strong_scaling_records():
+    recs = strong_scaling(64, 256, 16, 16, device_counts=(1, 2, 4), repeats=1,
+                          block_sizes=BS, dtype=jnp.float32)
+    assert [r.n_devices for r in recs] == [1, 2, 4]
+    assert recs[0].extra["speedup_vs_smallest"] == 1.0
+
+
+def test_weak_scaling_records():
+    recs = weak_scaling(64, m=64, dk=16, dv=16, device_counts=(1, 2), repeats=1,
+                        block_sizes=BS, dtype=jnp.float32)
+    assert [r.n for r in recs] == [64, 128]
+
+
+def test_run_record_jsonl(tmp_path):
+    rec = RunRecord(
+        config="t", backend="b", m=1, n=2, dk=3, dv=4, dtype="f32",
+        best_us=1.0, median_us=2.0, gflops_per_chip=3.0, utilization=0.1,
+        device_kind="cpu", n_devices=1,
+    )
+    path = str(tmp_path / "runs.jsonl")
+    append_jsonl(path, rec)
+    append_jsonl(path, rec)
+    lines = open(path).read().strip().split("\n")
+    assert len(lines) == 2
+    parsed = json.loads(lines[0])
+    assert parsed["backend"] == "b" and parsed["utilization"] == 0.1
+
+
+def test_trace_and_annotate(tmp_path):
+    logdir = str(tmp_path / "trace")
+    with trace(logdir):
+        with annotate("phase1"):
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    # a trace produces at least one file under the log dir
+    found = [f for _, _, fs in os.walk(logdir) for f in fs]
+    assert found, "no trace output written"
