@@ -1,0 +1,50 @@
+"""Checkpoint / resume for training state (orbax).
+
+The reference has no checkpointing (single-shot kernel, SURVEY §5); a
+training framework needs it.  Thin orbax wrappers: save/restore the
+(params, opt_state, step) triple; restored arrays are placed back onto
+the caller's mesh sharding by orbax when ``template`` state is provided.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(ckpt_dir: str | os.PathLike, step: int, params: Any,
+                    opt_state: Any) -> str:
+    """Write an atomic checkpoint for ``step``; returns its path."""
+    ckpt_dir = os.path.abspath(os.fspath(ckpt_dir))
+    path = os.path.join(ckpt_dir, str(step))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, {"params": params, "opt_state": opt_state}, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_step(ckpt_dir: str | os.PathLike) -> int | None:
+    ckpt_dir = os.fspath(ckpt_dir)
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str | os.PathLike, params_template: Any,
+                       opt_state_template: Any, *, step: int | None = None):
+    """Restore (params, opt_state, step); templates carry shape/dtype/
+    sharding so arrays land back on the mesh."""
+    ckpt_dir = os.path.abspath(os.fspath(ckpt_dir))
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, str(step))
+    ckptr = ocp.StandardCheckpointer()
+    template = {"params": params_template, "opt_state": opt_state_template}
+    restored = ckptr.restore(path, template)
+    return restored["params"], restored["opt_state"], step

@@ -82,10 +82,21 @@ def _param_spec(path: tuple, value: Any) -> P:
     return P()
 
 
+def _legal_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Drop sharding on any dim the mesh axis doesn't divide (e.g. a
+    single shared KV head can't be split over tp) — replicate instead."""
+    fixed = []
+    for dim, axis in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axis is not None and dim % mesh.shape[axis] != 0:
+            axis = None
+        fixed.append(axis)
+    return P(*fixed)
+
+
 def shard_params(params, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(
         lambda path, x: jax.device_put(
-            x, NamedSharding(mesh, _param_spec(path, x))
+            x, NamedSharding(mesh, _legal_spec(_param_spec(path, x), x.shape, mesh))
         ),
         params,
     )
@@ -133,4 +144,14 @@ def init_sharded(
     params = shard_params(params, mesh)
     optimizer = optax.adamw(lr)
     opt_state = optimizer.init(params)
+    # moment buffers (zeros_like(params)) inherit the params shardings;
+    # scalar leaves (step counts) need an explicit replicated sharding so
+    # checkpoint templates and jit arguments agree across the mesh
+    replicated = NamedSharding(mesh, P())
+    opt_state = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, replicated)
+        if getattr(x, "ndim", None) == 0
+        else x,
+        opt_state,
+    )
     return params, optimizer, opt_state

@@ -20,10 +20,10 @@ The TPU-native rebuild of the reference's core distributed algorithm
     it to the same ICI reduction tree).
 
 The reference's Q ping-pong broadcast pipeline (`attention-mpi.c:268-330`)
-has no hand-written analog: Q is replicated by sharding annotation, and
-XLA's latency-hiding scheduler overlaps collectives with compute.  The
-``q_chunk`` option reproduces the B=512-row batching (`attention-mpi.c:200`)
-for memory control on very large m.
+has no hand-written analog: Q is replicated by sharding annotation, XLA's
+latency-hiding scheduler overlaps collectives with compute, and the flash
+kernel's Q-block grid dimension already streams queries through VMEM in
+tiles (the B=512-row batching of `attention-mpi.c:200`, done on-chip).
 """
 
 from __future__ import annotations
