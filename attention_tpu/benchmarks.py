@@ -32,7 +32,7 @@ from attention_tpu.parallel.mesh import default_mesh
 from attention_tpu.parallel.ring import ring_attention
 from attention_tpu.utils.flops import attention_flops, utilization
 from attention_tpu.utils.profiling import RunRecord
-from attention_tpu.utils.timing import benchmark
+from attention_tpu.utils.timing import benchmark_attention
 
 
 def _record(config, backend, m, n, dk, dv, dtype, timing, *, n_devices=1,
@@ -86,17 +86,17 @@ def ablation_table(
     qf, kf, vf = _qkv(m, n, dk, dv, jnp.float32)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
 
-    t = benchmark(attention_xla, qf, kf, vf, repeats=repeats)
+    t = benchmark_attention(attention_xla, qf, kf, vf, repeats=repeats)
     variants["baseline"] = _record("ablation", "xla-f32", m, n, dk, dv,
                                    "float32", t)
-    t = benchmark(flash_attention, qf, kf, vf, block_sizes=bs, repeats=repeats)
+    t = benchmark_attention(flash_attention, qf, kf, vf, block_sizes=bs, repeats=repeats)
     variants["fused"] = _record("ablation", "flash-f32", m, n, dk, dv,
                                 "float32", t)
-    t = benchmark(attention_xla, qb, kb, vb, repeats=repeats)
+    t = benchmark_attention(attention_xla, qb, kb, vb, repeats=repeats)
     variants["mixed"] = _record("ablation", "xla-bf16", m, n, dk, dv,
                                 "bfloat16", t)
     if mesh is not None:
-        t = benchmark(
+        t = benchmark_attention(
             kv_sharded_attention, qf, kf, vf, mesh=mesh, block_sizes=bs,
             repeats=repeats,
         )
@@ -104,7 +104,7 @@ def ablation_table(
             "ablation", "kv-sharded-f32", m, n, dk, dv, "float32", t,
             n_devices=mesh.devices.size, mesh_axes=mesh.shape,
         )
-        t = benchmark(
+        t = benchmark_attention(
             kv_sharded_attention, qb, kb, vb, mesh=mesh, block_sizes=bs,
             repeats=repeats,
         )
@@ -113,7 +113,7 @@ def ablation_table(
             n_devices=mesh.devices.size, mesh_axes=mesh.shape,
         )
     else:
-        t = benchmark(flash_attention, qb, kb, vb, block_sizes=bs,
+        t = benchmark_attention(flash_attention, qb, kb, vb, block_sizes=bs,
                       repeats=repeats)
         variants["full"] = _record("ablation", "flash-bf16", m, n, dk, dv,
                                    "bfloat16", t)
@@ -145,7 +145,7 @@ def strong_scaling(
             continue
         mesh = default_mesh("kv" if backend == "kv-sharded" else "sp",
                             devices=jax.devices()[:r])
-        t = benchmark(fn, q, k, v, mesh=mesh, block_sizes=bs, repeats=repeats)
+        t = benchmark_attention(fn, q, k, v, mesh=mesh, block_sizes=bs, repeats=repeats)
         out.append(
             _record("strong_scaling", backend, m, n, dk, dv, dtype, t,
                     n_devices=r, mesh_axes=mesh.shape)
@@ -185,7 +185,7 @@ def weak_scaling(
         q, k, v = _qkv(m, n, dk, dv, dtype)
         mesh = default_mesh("kv" if backend == "kv-sharded" else "sp",
                             devices=jax.devices()[:r])
-        t = benchmark(fn, q, k, v, mesh=mesh, block_sizes=bs, repeats=repeats)
+        t = benchmark_attention(fn, q, k, v, mesh=mesh, block_sizes=bs, repeats=repeats)
         out.append(
             _record("weak_scaling", backend, m, n, dk, dv, dtype, t,
                     n_devices=r, mesh_axes=mesh.shape,

@@ -48,18 +48,26 @@ def _cmd_run(args: argparse.Namespace) -> int:
     else:
         q, k, v = (x.astype(dtype) for x in (case.q, case.k, case.v))
 
-    from attention_tpu.utils.timing import benchmark
+    from attention_tpu.utils.timing import benchmark, benchmark_attention
 
     # One untimed run produces the result and doubles as warmup, keeping
     # one-time costs (jit compilation; the native backend's first-use C
     # build) out of the timed region — the reference's timed region is
     # pure compute (attention.c:180-182), its compile happened at build
     # time.  Timing then follows the shared min-over-repeats discipline.
+    # Host backends (numpy/C) get plain fence timing — it is honest for
+    # them; device backends go through the tunnel-aware clock.
     result = attention(q, k, v, backend=args.backend)
-    timing = benchmark(
-        attention, q, k, v, backend=args.backend,
-        repeats=max(1, args.repeats), warmup=0,
-    )
+    if args.backend in ("oracle", "native"):
+        timing = benchmark(
+            attention, q, k, v, backend=args.backend,
+            repeats=max(1, args.repeats), warmup=0,
+        )
+    else:
+        timing = benchmark_attention(
+            attention, q, k, v, backend=args.backend,
+            repeats=max(1, args.repeats), warmup=0,
+        )
     best_us = timing.best_us
     result = np.asarray(result, dtype=np.float64)
 

@@ -38,6 +38,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 _STAT_LANES = 128  # stats are carried lane-replicated: min f32 tile is (8, 128)
+_LOG2E = 1.4426950408889634  # log2(e)
+_LN2 = 0.6931471805599453  # 1/log2(e)
 
 
 class BlockSizes(NamedTuple):
@@ -69,7 +71,6 @@ def _flash_kernel(
     m_scr,
     l_scr,
     *,
-    scale: float,
     n_true: int,
     block_k: int,
     causal: bool,
@@ -96,15 +97,21 @@ def _flash_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
+    # Q arrives pre-scaled by scale*log2(e) (`_flash_call`), so `s` is the
+    # scores in the log2 domain: exp(s_nat - m_nat) == exp2(s - m).  This
+    # removes the per-score scale multiply AND turns every exp into a raw
+    # exp2 (TPU's native transcendental) — the kernel is VPU-bound, so
+    # each elementwise op on the (block_q, block_k) tile is ~10% of step
+    # time.  Stats are converted back to the natural domain at finalize.
     q = q_ref[0]  # (block_q, d)
     k = k_ref[0]  # (block_k, d)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    s = s * scale  # (block_q, block_k)
+    )  # (block_q, block_k), log2-domain
 
     needs_tail_mask = n_true % block_k != 0
-    if needs_tail_mask or causal or dynamic_valid:
+    masked = needs_tail_mask or causal or dynamic_valid
+    if masked:
         col = kv_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
@@ -126,11 +133,17 @@ def _flash_kernel(
     l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_next = jnp.maximum(m_prev, m_cur)
-    # exp(old_max - new_max) rescale of the running accumulator
-    # (attention-mpi.c:179-181); the where-guards keep fully masked
-    # blocks/rows from producing NaN via exp(-inf - -inf).
-    corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_next))
-    p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp(s - m_next))
+    if masked:
+        # exp(old_max - new_max) rescale of the running accumulator
+        # (attention-mpi.c:179-181); the where-guards keep fully masked
+        # blocks/rows from producing NaN via exp2(-inf - -inf).
+        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp2(m_prev - m_next))
+        p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp2(s - m_next))
+    else:
+        # Unmasked: m_next is finite (a real row max), so exp2(-inf - m)
+        # underflows to 0 on its own — skip the two per-element selects.
+        corr = jnp.exp2(m_prev - m_next)
+        p = jnp.exp2(s - m_next)
     l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
 
     pv = jax.lax.dot_general(
@@ -155,7 +168,9 @@ def _flash_kernel(
         else:
             o_ref[0] = acc.astype(out_dtype)
         if m_out_ref is not None:
-            m_out_ref[0] = m_scr[...]
+            # Stats leave the kernel in the natural-log domain (the
+            # distributed pmax/psum merge computes exp(lmax - gmax)).
+            m_out_ref[0] = m_scr[...] * _LN2
             l_out_ref[0] = l_scr[...]
 
 
@@ -181,6 +196,16 @@ def _flash_call(
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     group = h // hkv
 
+    # Fold softmax scale * log2(e) into Q once (an (m, d) multiply in
+    # fp32) so the kernel never scales the (m, n) score matrix and all
+    # exponentials are raw exp2 — see the log2-domain note in
+    # `_flash_kernel`.  Casting back to q.dtype re-rounds bf16 inputs
+    # (~2^-8 relative), which the old score-domain scaling avoided;
+    # keeping the kernel input bf16 is what keeps QK^T on the fast MXU
+    # path, and measured end-to-end error at seq=32k stays ~2e-4 — two
+    # orders under the ±0.02 contract.
+    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+
     block_q = min(block_sizes.block_q, _ceil_to(m, 128))
     block_k = min(block_sizes.block_k, _ceil_to(n, 128))
     m_pad = _ceil_to(m, block_q)
@@ -195,7 +220,6 @@ def _flash_call(
 
     kernel = functools.partial(
         _flash_kernel,
-        scale=scale,
         n_true=n,
         block_k=block_k,
         causal=causal,

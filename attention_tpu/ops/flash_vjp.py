@@ -27,7 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
+from attention_tpu.ops.flash import (
+    _LN2,
+    _LOG2E,
+    BlockSizes,
+    flash_attention_partials,
+)
 
 NEG_INF = float("-inf")
 
@@ -70,6 +75,7 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, res, dout):
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, kx, vx))
     dout32 = dout.astype(jnp.float32)
     out32 = out.astype(jnp.float32)
+    q_dtype = q.dtype
 
     # D_i = sum_d dO_id * O_id  (the softmax-jacobian diagonal term)
     delta = jnp.sum(dout32 * out32, axis=-1)  # (h, m)
@@ -93,7 +99,16 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, res, dout):
 
     def one_chunk(args):
         qi, doi, lsei, di, base = args  # (h, chunk, dk) etc.
-        s = jnp.einsum("hqd,hnd->hqn", qi, k32) * scale
+        # Recompute P with the EXACT forward scores: the kernel folds
+        # scale*log2(e) into Q and re-rounds to q.dtype
+        # (flash.py::_flash_call), so the backward round-trips this
+        # chunk's Q identically or p = exp(s - lse) drifts from the
+        # forward probabilities on bf16 inputs (padded zeros round-trip
+        # to zero).  Gradients still flow through the true
+        # s = scale·q·k (rounding treated as identity), so dq/dk keep
+        # the plain `scale` factor with the original q.
+        qsi = (qi * (scale * _LOG2E)).astype(q_dtype).astype(jnp.float32)
+        s = jnp.einsum("hqd,hnd->hqn", qsi, k32) * _LN2
         if causal:
             rows = base + jnp.arange(chunk)
             mask = jnp.arange(n)[None, :] <= rows[:, None]

@@ -56,3 +56,106 @@ def benchmark(
         jax.block_until_ready(fn(*args, **kwargs))
         times.append(time.perf_counter() - t0)
     return Timing(times_s=times)
+
+
+def _tunnel_transport() -> bool:
+    """True when devices sit behind a remote tunnel (axon) whose
+    ``block_until_ready`` completes before pallas kernels finish.
+
+    Positive detection only: the axon plugin registers itself as platform
+    'tpu', so we sniff its PJRT version string (and the JAX_PLATFORMS
+    env as a fallback) rather than exclude known-direct platforms.
+    """
+    import os
+
+    try:
+        version = getattr(jax.devices()[0].client, "platform_version", "")
+    except Exception:  # noqa: BLE001 - no devices -> no tunnel
+        return False
+    return "axon" in (version or "").lower() or "axon" in os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
+
+
+def benchmark_attention(fn, q, k, v, *, repeats: int = 5, warmup: int = 2,
+                        **kwargs) -> Timing:
+    """Time an attention call with the honest clock for the transport.
+
+    On direct backends (cpu/gpu/tpu) this is plain fence timing
+    (:func:`benchmark`).  On tunnel transports the fence lies, so the call
+    is timed by amortized scan slope instead, chaining each iteration's
+    output back into the next Q (sliced/zero-padded when dv != dk — the
+    iterated values are garbage, but the per-iteration work is identical);
+    the returned ``Timing`` then holds the single per-iteration estimate.
+    """
+    if not _tunnel_transport():
+        return benchmark(fn, q, k, v, repeats=repeats, warmup=warmup, **kwargs)
+
+    import jax.numpy as jnp
+
+    dk = q.shape[-1]
+
+    def step(x):
+        out = fn(x, k, v, **kwargs)
+        dv = out.shape[-1]
+        if dv > dk:
+            out = out[..., :dk]
+        elif dv < dk:
+            out = jnp.pad(out, [(0, 0)] * (out.ndim - 1) + [(0, dk - dv)])
+        return out
+
+    per = benchmark_amortized(step, q, repeats=max(2, repeats // 2))
+    return Timing(times_s=[per])
+
+
+def benchmark_amortized(
+    fn: Callable,
+    x,
+    *,
+    repeats: int = 3,
+    n_short: int = 4,
+    n_long: int = 20,
+) -> float:
+    """Per-iteration seconds of ``fn`` via scan-chained slope timing.
+
+    Remote-tunnel device transports (axon) may complete a
+    ``block_until_ready`` fence before a pallas call has actually run, and
+    fetching the full output is dominated by tunnel transfer time.  This
+    measures honestly: chain ``n`` applications of ``fn`` inside one jit
+    with a data dependency (each iteration consumes the previous output),
+    fetch ONE scalar, and take the slope (t_long - t_short)/(n_long -
+    n_short) — fixed tunnel latency cancels.
+
+    ``fn`` must map an array to an array of the same shape; its output is
+    cast back to ``x.dtype`` between iterations.
+    """
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chained(x0, n):
+        def body(carry, _):
+            return fn(carry).astype(x0.dtype), None
+
+        out, _ = lax.scan(body, x0, None, length=n)
+        return jnp.sum(out.astype(jnp.float32))
+
+    jax.device_get(chained(x, n_short))  # compile both lengths
+    jax.device_get(chained(x, n_long))
+    shorts, longs = [], []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.device_get(chained(x, n_short))
+        shorts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.device_get(chained(x, n_long))
+        longs.append(time.perf_counter() - t0)
+    slope = (min(longs) - min(shorts)) / (n_long - n_short)
+    if slope <= 0:
+        # Timer noise swamped the slope (per-iteration cost << dispatch
+        # jitter).  Fall back to the amortized upper bound — still honest,
+        # just conservative: fixed overhead is charged to the iterations.
+        slope = min(longs) / n_long
+    return slope

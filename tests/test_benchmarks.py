@@ -72,3 +72,28 @@ def test_trace_and_annotate(tmp_path):
     # a trace produces at least one file under the log dir
     found = [f for _, _, fs in os.walk(logdir) for f in fs]
     assert found, "no trace output written"
+
+
+def test_benchmark_amortized_positive():
+    """Amortized slope timing returns a sane positive per-iteration time."""
+    from attention_tpu.utils.timing import benchmark_amortized
+
+    x = jnp.ones((256, 256), jnp.float32)
+    per = benchmark_amortized(lambda a: a @ a / 256.0, x, repeats=2,
+                              n_short=2, n_long=6)
+    assert per > 0
+
+
+def test_bench_cli_smoke():
+    """bench.py end-to-end on tiny shapes (CPU interpret mode)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--seq", "256", "--dim", "64", "--repeats", "1",
+                   "--serial-seq", "256"])
+    assert rc == 0
