@@ -42,6 +42,16 @@ _LOG2E = 1.4426950408889634  # log2(e)
 _LN2 = 0.6931471805599453  # 1/log2(e)
 
 
+def _compiler_params(semantics):
+    """CompilerParams with dimension semantics, tolerant of API spelling
+    drift across pallas versions (shared by the forward and backward
+    kernels)."""
+    try:
+        return pltpu.CompilerParams(dimension_semantics=semantics)
+    except TypeError:  # older/newer param spelling
+        return None
+
+
 class BlockSizes(NamedTuple):
     """Tile sizes for the flash kernel grid.
 
@@ -96,6 +106,58 @@ def _flash_kernel(
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Skip tiles that masking zeroes entirely: under causal, KV blocks
+    # strictly above the diagonal (first column already past the last
+    # row); under dynamic kv_valid, blocks wholly past the valid prefix.
+    # The running (m, l, acc) state is untouched for skipped tiles —
+    # exactly what computing them would produce — so init/finalize stay
+    # outside the guard.  This halves causal FLOPs (the score rectangle
+    # becomes a triangle).
+    compute_tile = True
+    if causal:
+        compute_tile = jnp.logical_and(
+            compute_tile,
+            kv_idx * block_k + offsets_ref[1]
+            <= pl.program_id(1) * block_q + block_q - 1 + offsets_ref[0],
+        )
+    if dynamic_valid:
+        compute_tile = jnp.logical_and(
+            compute_tile, kv_idx * block_k < offsets_ref[2]
+        )
+
+    @pl.when(compute_tile)
+    def _compute():
+        _flash_tile(
+            offsets_ref, q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+            n_true=n_true, block_k=block_k, causal=causal,
+            block_q=block_q, dynamic_valid=dynamic_valid,
+        )
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        acc = acc_scr[...]
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        if normalize:
+            # 1/gsum normalization with the divide-by-zero guard the
+            # reference applies (attention-mpi.c:358-362).
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc / l_safe).astype(out_dtype)
+        else:
+            o_ref[0] = acc.astype(out_dtype)
+        if m_out_ref is not None:
+            # Stats leave the kernel in the natural-log domain (the
+            # distributed pmax/psum merge computes exp(lmax - gmax)).
+            m_out_ref[0] = m_scr[...] * _LN2
+            l_out_ref[0] = l_scr[...]
+
+
+def _flash_tile(
+    offsets_ref, q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+    *, n_true, block_k, causal, block_q, dynamic_valid,
+):
+    """The per-tile online-softmax update (body of `_flash_kernel`)."""
+    kv_idx = pl.program_id(2)
 
     # Q arrives pre-scaled by scale*log2(e) (`_flash_call`), so `s` is the
     # scores in the log2 domain: exp(s_nat - m_nat) == exp2(s - m).  This
@@ -155,23 +217,6 @@ def _flash_kernel(
     acc_scr[...] = acc_scr[...] * corr + pv
     m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
     l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
-
-    @pl.when(kv_idx == num_kv - 1)
-    def _finalize():
-        acc = acc_scr[...]
-        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
-        if normalize:
-            # 1/gsum normalization with the divide-by-zero guard the
-            # reference applies (attention-mpi.c:358-362).
-            l_safe = jnp.where(l == 0.0, 1.0, l)
-            o_ref[0] = (acc / l_safe).astype(out_dtype)
-        else:
-            o_ref[0] = acc.astype(out_dtype)
-        if m_out_ref is not None:
-            # Stats leave the kernel in the natural-log domain (the
-            # distributed pmax/psum merge computes exp(lmax - gmax)).
-            m_out_ref[0] = m_scr[...] * _LN2
-            l_out_ref[0] = l_scr[...]
 
 
 def _flash_call(
@@ -258,12 +303,7 @@ def _flash_call(
         pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
     ]
 
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        )
-    except TypeError:  # older/newer param spelling
-        compiler_params = None
+    compiler_params = _compiler_params(("parallel", "parallel", "arbitrary"))
 
     flops = 2 * h * m_pad * n_pad * (d + dv)
     outs = pl.pallas_call(

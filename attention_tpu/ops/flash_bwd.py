@@ -1,0 +1,290 @@
+"""Pallas flash-attention backward kernels for TPU.
+
+The reference is forward-only (no backward exists in `attention.c` /
+`attention-mpi.c`); this is new training surface.  The math is the
+standard flash backward — recompute P tile-wise from the saved
+log-sum-exp, then
+
+    P  = exp(S - lse)            D  = rowsum(dO ∘ O)   (precomputed)
+    dV = Pᵀ dO                   dS = P ∘ (dO Vᵀ - D)
+    dQ = scale · dS K            dK = scale · dSᵀ Q
+
+— executed as two Pallas kernels instead of blocked XLA einsums:
+
+  * **dQ kernel**: grid (head, q-block, kv-block), kv innermost; dQ
+    accumulates in VMEM scratch across the KV sweep (the mirror of the
+    forward's online accumulator).
+  * **dK/dV kernel**: grid (kv-block, q-head, q-block) with the q-head
+    dimension ordered so all Q heads sharing one KV head (GQA) form a
+    contiguous run — dK/dV accumulate across the whole run in VMEM
+    scratch and are written once per KV head.  The grouped reduction
+    never materializes `jnp.repeat`-expanded gradients in HBM.
+
+Everything runs **KV-major** (tiles are (block_k, block_q)): the per-row
+stats lse/D then broadcast along lanes as natural (1, block_q) row
+vectors, so no in-kernel transposes of narrow tiles are needed; the MXU
+does not care about the orientation of the contractions.
+
+Domain bookkeeping matches the forward (`flash.py::_flash_call`): Q is
+pre-scaled by scale·log2(e) and re-rounded to the input dtype, so scores
+are log2-domain and P = exp2(S₂ - lse₂) reproduces the forward's exact
+probabilities; dK picks up a ln2 factor (dK = ln2 · dSᵀ Q_scaled) and dQ
+the plain `scale` (contraction against unscaled K).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu.ops.flash import (
+    _LN2,
+    _LOG2E,
+    NEG_INF,
+    BlockSizes,
+    _ceil_to,
+    _compiler_params,
+)
+
+
+def _recompute_p_t(qs, k, lse_row, *, causal, q_base, k_base):
+    """(block_k, block_q) probability tile, KV-major.
+
+    ``qs`` is the forward's pre-scaled Q (scores come out log2-domain),
+    ``lse_row`` a (1, block_q) log2-domain log-sum-exp row vector.
+    """
+    s2t = jax.lax.dot_general(
+        k, qs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (block_k, block_q)
+    p_t = jnp.exp2(s2t - lse_row)
+    if causal:
+        col = k_base + jax.lax.broadcasted_iota(jnp.int32, p_t.shape, 0)
+        row = q_base + jax.lax.broadcasted_iota(jnp.int32, p_t.shape, 1)
+        # also guards rows the forward fully masked (lse == -inf)
+        p_t = jnp.where(jnp.logical_and(col <= row, lse_row != NEG_INF),
+                        p_t, 0.0)
+    return p_t
+
+
+def _dq_kernel(
+    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, dq_ref, acc_scr,
+    *, causal, block_q, block_k, scale, out_dtype, compute_dtype,
+):
+    j = pl.program_id(2)
+    q_base = pl.program_id(1) * block_q
+    k_base = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p_t = _recompute_p_t(
+            qs, k, lse_ref[...], causal=causal, q_base=q_base, k_base=k_base
+        )
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, block_q) = (dO Vᵀ)ᵀ
+        ds_t = p_t * (dp_t - delta_ref[...])
+        acc_scr[...] += jax.lax.dot_general(
+            ds_t.astype(compute_dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, d) = dS K
+
+    if causal:
+        # KV tiles strictly above the diagonal are all zeros under the
+        # causal mask — skip them (halves causal backward FLOPs).
+        # Init/finalize stay outside the guard.
+        pl.when(k_base <= q_base + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = (acc_scr[...] * scale).astype(out_dtype)
+
+
+def _dkv_kernel(
+    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, causal, block_q, block_k, group, compute_dtype,
+):
+    h = pl.program_id(1)
+    i = pl.program_id(2)
+    h_in_group = jax.lax.rem(h, group)
+    q_base = i * block_q
+    k_base = pl.program_id(0) * block_k
+
+    @pl.when(jnp.logical_and(h_in_group == 0, i == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p_t = _recompute_p_t(
+            qs, k, lse_ref[...], causal=causal, q_base=q_base, k_base=k_base
+        )
+        dv_scr[...] += jax.lax.dot_general(
+            p_t.astype(compute_dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, dv) = Pᵀ dO
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta_ref[...])
+        dk_scr[...] += jax.lax.dot_general(
+            ds_t.astype(compute_dtype), qs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, d) = dSᵀ Q_scaled
+
+    if causal:
+        # Q tiles wholly above the diagonal contribute nothing to this
+        # KV block — skip them (halves causal backward FLOPs).
+        pl.when(k_base <= q_base + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(
+        jnp.logical_and(
+            h_in_group == group - 1, i == pl.num_programs(2) - 1
+        )
+    )
+    def _finalize():
+        # Q_scaled carries scale·log2(e); ln2 restores the plain `scale`.
+        dk_ref[0] = dk_scr[...] * _LN2
+        dv_ref[0] = dv_scr[...]
+
+
+def flash_backward(
+    q: jax.Array,  # (h, m, d)
+    k: jax.Array,  # (hkv, n, d)
+    v: jax.Array,  # (hkv, n, dv)
+    out: jax.Array,  # (h, m, dv)
+    lse: jax.Array,  # (h, m), natural-log domain
+    dout: jax.Array,  # (h, m, dv)
+    *,
+    scale: float,
+    causal: bool = False,
+    block_sizes: BlockSizes | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """dQ, dK, dV via the two Pallas backward kernels."""
+    bs = block_sizes or BlockSizes()
+    h, m, d = q.shape
+    hkv, n, dv = v.shape
+    group = h // hkv
+
+    # Same pre-scaled (and re-rounded) Q the forward kernel saw, so the
+    # recomputed P matches the forward probabilities bit-for-bit modulo
+    # fp32 non-associativity.
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    lse2 = (lse.astype(jnp.float32) * _LOG2E)
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), -1)
+
+    block_q = min(bs.block_q, _ceil_to(m, 128))
+    block_k = min(bs.block_k, _ceil_to(n, 128))
+    m_pad = _ceil_to(m, block_q)
+    n_pad = _ceil_to(n, block_k)
+    do32 = dout.astype(jnp.float32)
+    if m_pad != m:
+        # Padded Q rows are zero ⇒ their scores are 0 and (with lse2
+        # padded to 0) P = 1, but dO = D = 0 zeroes every contribution.
+        qs = jnp.pad(qs, ((0, 0), (0, m_pad - m), (0, 0)))
+        do32 = jnp.pad(do32, ((0, 0), (0, m_pad - m), (0, 0)))
+        lse2 = jnp.pad(lse2, ((0, 0), (0, m_pad - m)))
+        delta = jnp.pad(delta, ((0, 0), (0, m_pad - m)))
+    if n_pad != n:
+        # Padded K/V rows are zero ⇒ they null dQ contributions (dS K
+        # hits zero K rows); their dK/dV rows are sliced away below.
+        k = jnp.pad(k, ((0, 0), (0, n_pad - n), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, n_pad - n), (0, 0)))
+    do = do32.astype(q.dtype)
+    compute_dtype = q.dtype
+
+    num_i = m_pad // block_q
+    num_j = n_pad // block_k
+
+    stat_spec_q_major = pl.BlockSpec((1, block_q), lambda hh, ii, jj: (hh, ii))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            scale=scale,
+            out_dtype=q.dtype,
+            compute_dtype=compute_dtype,
+        ),
+        grid=(h, num_i, num_j),
+        in_specs=[
+            stat_spec_q_major,
+            stat_spec_q_major,
+            pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, ii, jj: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, dv), lambda hh, ii, jj: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_q, dv), lambda hh, ii, jj: (hh, ii, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, m_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * h * m_pad * n_pad * d,
+            bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
+            + h * (k.size + v.size) // hkv * k.dtype.itemsize
+            + h * m_pad * d * qs.dtype.itemsize,
+            transcendentals=h * m_pad * n_pad,
+        ),
+        interpret=interpret,
+    )(lse2, delta, qs, k, v, do)[:, :m]
+
+    stat_spec_kv_major = pl.BlockSpec((1, block_q), lambda jj, hh, ii: (hh, ii))
+    dk, dvg = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            group=group,
+            compute_dtype=compute_dtype,
+        ),
+        grid=(num_j, h, num_i),
+        in_specs=[
+            stat_spec_kv_major,
+            stat_spec_kv_major,
+            pl.BlockSpec((1, block_q, d), lambda jj, hh, ii: (hh, ii, 0)),
+            pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_q, dv), lambda jj, hh, ii: (hh, ii, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((hkv, n_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, n_pad, dv), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, dv), jnp.float32),
+        ],
+        compiler_params=_compiler_params(("parallel", "arbitrary", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * h * m_pad * n_pad * d,
+            bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
+            + h * (k.size + v.size) // hkv * k.dtype.itemsize
+            + (n_pad * (d + dv)) * hkv * 4,
+            transcendentals=h * m_pad * n_pad,
+        ),
+        interpret=interpret,
+    )(lse2, delta, qs, k, v, do)
+    return dq, dk[:, :n].astype(k.dtype), dvg[:, :n].astype(v.dtype)

@@ -14,9 +14,14 @@ algebra:
     dV = Pᵀ dO                   dS = P ∘ (dO Vᵀ - D)
     dQ = scale · dS K            dK = scale · dSᵀ Q
 
-Backward is expressed in blocked XLA einsums (``lax.map`` over Q chunks)
-rather than a hand-written Pallas kernel for now: XLA fuses the chunked
-contractions onto the MXU, and memory stays O(m·chunk + chunk·n).
+Backward has two interchangeable implementations:
+
+  * ``bwd_impl="pallas"`` (default) — the two Pallas kernels in
+    :mod:`attention_tpu.ops.flash_bwd` (dQ kernel + grouped dK/dV
+    kernel), tiled for the MXU with VMEM scratch accumulators.
+  * ``bwd_impl="xla"`` — blocked XLA einsums (``lax.map`` over Q
+    chunks); memory stays O(m·chunk + chunk·n).  Kept as the
+    cross-check oracle for the Pallas kernels and as a fallback.
 """
 
 from __future__ import annotations
@@ -41,8 +46,8 @@ def _gqa_expand(k, group):
     return jnp.repeat(k, group, axis=0) if group > 1 else k
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_diff(q, k, v, scale, causal, block_sizes, bwd_chunk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, scale, causal, block_sizes, bwd_chunk, bwd_impl):
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes)
     return out
 
@@ -59,13 +64,22 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes):
     return out, lse
 
 
-def _flash_diff_fwd(q, k, v, scale, causal, block_sizes, bwd_chunk):
+def _flash_diff_fwd(q, k, v, scale, causal, block_sizes, bwd_chunk, bwd_impl):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes)
     return out, (q, k, v, out, lse)
 
 
-def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, res, dout):
+def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
     q, k, v, out, lse = res
+    if bwd_impl == "pallas":
+        from attention_tpu.ops.flash import _should_interpret
+        from attention_tpu.ops.flash_bwd import flash_backward
+
+        return flash_backward(
+            q, k, v, out, lse, dout,
+            scale=scale, causal=causal, block_sizes=block_sizes,
+            interpret=_should_interpret(),
+        )
     h, m, dk = q.shape
     hkv, n, dv = v.shape
     group = h // hkv
@@ -145,26 +159,33 @@ def flash_attention_diff(
     causal: bool = False,
     block_sizes: BlockSizes | None = None,
     bwd_chunk: int = 512,
+    bwd_impl: str = "pallas",
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
 
-    Forward = Pallas flash kernel; backward = blocked recompute from the
-    saved log-sum-exp.
+    Forward = Pallas flash kernel; backward = Pallas backward kernels
+    (``bwd_impl="pallas"``) or the blocked-XLA recompute
+    (``bwd_impl="xla"``), both from the saved log-sum-exp.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if bwd_impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     bs = block_sizes or BlockSizes()
     if q.ndim == 2:
-        return _flash_diff(q[None], k[None], v[None], scale, causal, bs, bwd_chunk)[0]
+        return _flash_diff(
+            q[None], k[None], v[None], scale, causal, bs, bwd_chunk, bwd_impl
+        )[0]
     if q.ndim == 3:
-        return _flash_diff(q, k, v, scale, causal, bs, bwd_chunk)
+        return _flash_diff(q, k, v, scale, causal, bs, bwd_chunk, bwd_impl)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
         vf = v.reshape(b * v.shape[1], *v.shape[2:])
         out = _flash_diff(
-            q.reshape(b * hq, m, d), kf, vf, scale, causal, bs, bwd_chunk
+            q.reshape(b * hq, m, d), kf, vf, scale, causal, bs, bwd_chunk,
+            bwd_impl,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")

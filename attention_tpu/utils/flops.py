@@ -11,11 +11,12 @@ from __future__ import annotations
 import jax
 
 # Peak dense matmul TFLOP/s per chip by TPU generation (bf16).
-# v5e: 394 TFLOP/s bf16 / 197 fp32-ish via bf16x3 (we quote bf16 peak).
+# v5e (reported as "TPU v5 lite"): 197 TFLOP/s bf16 — 394 is the int8
+# TOPS number, not the bf16 peak.
 _PEAK_TFLOPS_BF16 = {
     "TPU v4": 275.0,
-    "TPU v5 lite": 394.0,
-    "TPU v5e": 394.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
     "TPU v5": 459.0,  # v5p
     "TPU v6 lite": 918.0,
 }
