@@ -126,10 +126,16 @@ def _flash_kernel(
             compute_tile, kv_idx * block_k < offsets_ref[2]
         )
 
+    # program_id is read outside the pl.when body: interpret mode on CPU
+    # substitutes grid indices only at the top level of the kernel trace,
+    # and the values are loop-invariant anyway.
+    q_idx = pl.program_id(1)
+
     @pl.when(compute_tile)
     def _compute():
         _flash_tile(
             offsets_ref, q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+            kv_idx=kv_idx, q_idx=q_idx,
             n_true=n_true, block_k=block_k, causal=causal,
             block_q=block_q, dynamic_valid=dynamic_valid,
         )
@@ -154,10 +160,9 @@ def _flash_kernel(
 
 def _flash_tile(
     offsets_ref, q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
-    *, n_true, block_k, causal, block_q, dynamic_valid,
+    *, kv_idx, q_idx, n_true, block_k, causal, block_q, dynamic_valid,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`)."""
-    kv_idx = pl.program_id(2)
 
     # Q arrives pre-scaled by scale*log2(e) (`_flash_call`), so `s` is the
     # scores in the log2 domain: exp(s_nat - m_nat) == exp2(s - m).  This
@@ -179,7 +184,7 @@ def _flash_tile(
         )
         mask = col < (offsets_ref[2] if dynamic_valid else n_true)
         if causal:
-            row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+            row = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, dimension=0
             )
             mask = jnp.logical_and(
