@@ -9,35 +9,79 @@ the auto-SPMD XLA path.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, NamedTuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.flash import flash_attention
 from attention_tpu.ops.flash_vjp import flash_attention_diff
 from attention_tpu.ops.reference import attention_xla
+
+
+class KVCache(NamedTuple):
+    """Per-layer decode cache: K/V (B, Hkv, N, dh) + valid length.
+
+    ``length`` is a traced int32 scalar (uniform across the batch —
+    prefill is batched on equal-length prompts; `flash_decode` itself
+    also accepts per-sequence (B,) lengths for ragged serving).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @classmethod
+    def create(cls, batch: int, num_kv_heads: int, capacity: int,
+               head_dim: int, dtype=jnp.bfloat16) -> "KVCache":
+        shape = (batch, num_kv_heads, capacity, head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
 
 
 def _xla_mha(q, k, v, *, causal):
     """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
     and auto-partitionable by XLA under pjit shardings."""
-    hq, hkv = q.shape[1], k.shape[1]
-    if hq != hkv:
-        k = jnp.repeat(k, hq // hkv, axis=1)
-        v = jnp.repeat(v, hq // hkv, axis=1)
     if not causal:
+        hq, hkv = q.shape[1], k.shape[1]
+        if hq != hkv:
+            k = jnp.repeat(k, hq // hkv, axis=1)
+            v = jnp.repeat(v, hq // hkv, axis=1)
         return attention_xla(q, k, v)
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bhmd,bhnd->bhmn", q, k, preferred_element_type=jnp.float32)
-    mask = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
-    s = jnp.where(mask, s * scale, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhmn,bhnd->bhmd", p, v)
+    # causal = the start=0, fully-valid instance of the cached mask
+    return _xla_cached_attention(q, k, v, start=0, new_len=k.shape[2],
+                                 causal=True)
 
 
 def _flash_mha(q, k, v, *, causal):
     return flash_attention_diff(q, k, v, causal=causal)
+
+
+def _xla_cached_attention(q, kc, vc, *, start, new_len, causal):
+    """Dense cached attention over (B, H, S, dh) vs full-capacity caches
+    (B, Hkv, N, dh), masked to the valid prefix.  Pure einsums — XLA
+    auto-partitions it under pjit shardings, the serving analog of
+    `_xla_mha`."""
+    hq, hkv = q.shape[1], kc.shape[1]
+    if hq != hkv:
+        kc = jnp.repeat(kc, hq // hkv, axis=1)
+        vc = jnp.repeat(vc, hq // hkv, axis=1)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhmd,bhnd->bhmn", q, kc,
+                   preferred_element_type=jnp.float32)
+    col = jnp.arange(kc.shape[2])[None, :]
+    mask = col < new_len
+    if causal:
+        row = jnp.arange(q.shape[2])[:, None]
+        mask = jnp.logical_and(mask, col <= row + start)
+    s = jnp.where(mask, s * scale, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
+    return jnp.einsum("bhmn,bhnd->bhmd", p, vc)
 
 
 ATTN_IMPLS: dict[str, Callable] = {"xla": _xla_mha, "flash": _flash_mha}
@@ -59,7 +103,7 @@ class GQASelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, cache: KVCache | None = None):
         if self.num_q_heads % self.num_kv_heads != 0:
             raise ValueError(
                 f"q heads {self.num_q_heads} not a multiple of kv heads "
@@ -75,8 +119,50 @@ class GQASelfAttention(nn.Module):
         k = dense("k_proj", self.num_kv_heads)(x)
         v = dense("v_proj", self.num_kv_heads)(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B, H, S, dh)
-        out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal)
+        if cache is None:
+            out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal)
+        else:
+            out, cache = self._cached_attention(q, k, v, cache)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
-        return nn.DenseGeneral(
+        proj = nn.DenseGeneral(
             features=x.shape[-1], use_bias=False, dtype=self.dtype, name="o_proj"
         )(out.astype(self.dtype))
+        return proj if cache is None else (proj, cache)
+
+    def _cached_attention(self, q, k, v, cache: KVCache):
+        """Append S new KV rows at ``cache.length``, attend over the
+        valid prefix.  ``impl='flash'``: S == 1 -> fused flash-decode
+        kernel; S > 1 (prefill, or chunked prefill appending to history)
+        -> the flash kernel with a dynamic ``q_offset``/``kv_valid``
+        window.  ``impl='xla'``: masked dense einsums that XLA
+        auto-partitions under mesh shardings (sharded serving)."""
+        s_new = q.shape[2]
+        capacity = cache.k.shape[2]
+        kc = jax.lax.dynamic_update_slice(
+            cache.k, k.astype(cache.k.dtype), (0, 0, cache.length, 0)
+        )
+        vc = jax.lax.dynamic_update_slice(
+            cache.v, v.astype(cache.v.dtype), (0, 0, cache.length, 0)
+        )
+        new_len = cache.length + s_new
+        if self.impl not in ATTN_IMPLS:
+            raise KeyError(
+                f"unknown impl {self.impl!r}; available: {sorted(ATTN_IMPLS)}"
+            )
+        if self.impl == "xla":
+            out = _xla_cached_attention(
+                q, kc, vc, start=cache.length, new_len=new_len,
+                causal=self.causal,
+            )
+        elif s_new == 1:
+            out = flash_decode(q[:, :, 0, :], kc, vc, new_len)[:, :, None, :]
+        else:
+            out = flash_attention(
+                q, kc, vc, causal=self.causal,
+                q_offset=cache.length, kv_valid=new_len,
+            )
+        # Overflowing the cache would silently clamp the write index
+        # (dynamic_update_slice semantics) and corrupt attention; make it
+        # loud instead — poison the output with NaN.
+        out = jnp.where(new_len <= capacity, out, jnp.nan).astype(out.dtype)
+        return out, KVCache(kc, vc, new_len)

@@ -1,0 +1,77 @@
+"""Autoregressive generation: prefill + fused-decode token loop.
+
+The inference runtime the reference never had (its kernel is a one-shot
+batch op).  The decode step re-uses the reference's algorithmic core —
+the online-softmax scan over KV (`attention-mpi.c:168-189`) — as the
+`flash_decode` kernel against a fixed-capacity KV cache, so per-token
+cost scales with the *used* cache prefix.
+
+TPU-shaped control flow: the whole token loop is a single
+`lax.scan` under one jit — fixed-capacity caches keep every shape
+static, the cache write is an in-place `dynamic_update_slice`, and no
+host round-trip happens between tokens.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from attention_tpu.models.transformer import TinyDecoder
+
+
+def prefill(model: TinyDecoder, params, tokens: jax.Array, capacity: int,
+            cache_dtype=None):
+    """Run the prompt through the model once, filling fresh KV caches.
+
+    tokens: (B, S) int32 (equal-length prompts).  Returns
+    ``(last_logits (B, vocab), caches)`` ready for :func:`decode_step`.
+    """
+    caches = model.init_caches(tokens.shape[0], capacity, cache_dtype)
+    logits, caches = model.apply({"params": params}, tokens, caches)
+    return logits[:, -1], caches
+
+
+def decode_step(model: TinyDecoder, params, token: jax.Array, caches):
+    """One fused decode step.  token: (B,) int32 -> (logits (B, vocab),
+    caches)."""
+    logits, caches = model.apply({"params": params}, token[:, None], caches)
+    return logits[:, -1], caches
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "steps", "capacity")
+)
+def generate(
+    model: TinyDecoder,
+    params,
+    prompt: jax.Array,  # (B, S) int32
+    *,
+    steps: int,
+    capacity: int | None = None,
+) -> jax.Array:
+    """Greedy generation: (B, S) prompt -> (B, steps) continuation.
+
+    One jit: prefill, then a `lax.scan` of fused decode steps.
+    """
+    b, s = prompt.shape
+    if capacity is None:
+        capacity = -(-(s + steps) // 128) * 128
+    if capacity < s + steps:
+        raise ValueError(f"capacity {capacity} < prompt+steps {s + steps}")
+
+    last_logits, caches = prefill(model, params, prompt, capacity)
+    first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, caches = carry
+        logits, caches = decode_step(model, params, tok, caches)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, caches), tok
+
+    (_, _), toks = jax.lax.scan(
+        step, (first, caches), None, length=steps
+    )
+    return jnp.moveaxis(toks, 0, 1)  # (B, steps)

@@ -12,7 +12,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from attention_tpu.models.attention_layer import GQASelfAttention
+from attention_tpu.models.attention_layer import GQASelfAttention, KVCache
 
 
 class MLP(nn.Module):
@@ -36,18 +36,22 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None):
         y = nn.RMSNorm(dtype=self.dtype)(x)
-        x = x + GQASelfAttention(
+        attn_out = GQASelfAttention(
             num_q_heads=self.num_q_heads,
             num_kv_heads=self.num_kv_heads,
             head_dim=self.head_dim,
             impl=self.impl,
             causal=self.causal,
             dtype=self.dtype,
-        )(y)
+        )(y, cache)
+        if cache is not None:
+            attn_out, cache = attn_out
+        x = x + attn_out
         y = nn.RMSNorm(dtype=self.dtype)(x)
-        return x + MLP(dtype=self.dtype)(y)
+        x = x + MLP(dtype=self.dtype)(y)
+        return x if cache is None else (x, cache)
 
 
 class TinyDecoder(nn.Module):
@@ -62,16 +66,33 @@ class TinyDecoder(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:  # (B, S) int32
+    def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
         head_dim = self.dim // self.num_q_heads
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
-        for _ in range(self.depth):
-            x = TransformerBlock(
+        new_caches = []
+        for i in range(self.depth):
+            block = TransformerBlock(
                 num_q_heads=self.num_q_heads,
                 num_kv_heads=self.num_kv_heads,
                 head_dim=head_dim,
                 impl=self.impl,
                 dtype=self.dtype,
-            )(x)
+            )
+            if caches is None:
+                x = block(x)
+            else:
+                x, c = block(x, caches[i])
+                new_caches.append(c)
         x = nn.RMSNorm(dtype=self.dtype)(x)
-        return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32)(x)
+        return logits if caches is None else (logits, tuple(new_caches))
+
+    def init_caches(self, batch: int, capacity: int,
+                    cache_dtype=None) -> tuple:
+        """Fresh per-layer KV caches for autoregressive decoding."""
+        head_dim = self.dim // self.num_q_heads
+        return tuple(
+            KVCache.create(batch, self.num_kv_heads, capacity, head_dim,
+                           cache_dtype or self.dtype)
+            for _ in range(self.depth)
+        )

@@ -1,2 +1,3 @@
 from attention_tpu.ops.reference import attention_xla  # noqa: F401
 from attention_tpu.ops.flash import flash_attention, flash_attention_partials  # noqa: F401
+from attention_tpu.ops.decode import flash_decode  # noqa: F401

@@ -1,0 +1,186 @@
+"""Fused Pallas flash-decode kernel: one new token vs a KV cache.
+
+Inference surface the reference never had (it is a forward-only batch
+kernel, `attention-mpi.c:191-407`); this is the autoregressive-decoding
+analog of its online-softmax pass (`attention-mpi.c:168-189`): a single
+query row scans the cached KV rows with a running (max, sumexp)
+recurrence, fused in one kernel (the tile body is shared with the
+forward kernel, `flash.py::_flash_tile`).
+
+TPU-native design notes:
+  * Decode is HBM-bandwidth-bound (the used KV prefix streams through
+    VMEM once per step), so the kernel's job is to keep the DMA pipeline
+    full — the KV grid dimension gives Pallas' automatic double
+    buffering — and to spend nothing on the unused cache tail: the
+    per-sequence lengths are **scalar-prefetched** so the K/V BlockSpec
+    index maps clamp every out-of-range block index to the last valid
+    block.  Pallas elides the DMA when consecutive grid steps map to the
+    same block, and `@pl.when(j * block_k < valid)` skips the compute,
+    so both bandwidth and FLOPs scale with the *used* prefix, not the
+    cache capacity.
+  * All Q heads sharing one KV head (GQA) are processed together as the
+    row-block of a single (group, block_k) MXU matmul, so the KV cache
+    is read once per KV head, not once per Q head.
+  * Per-batch cache lengths make a ragged batch decode in one call with
+    no host-side bucketing.
+
+Layout: Q (B, H, d) — one token per sequence; caches (B, Hkv, N, d|dv)
+with static capacity N; lengths (B,) int32 (or a scalar, broadcast).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu.ops.flash import (
+    _LOG2E,
+    _STAT_LANES,
+    NEG_INF,
+    _ceil_to,
+    _compiler_params,
+    _flash_tile,
+    _should_interpret,
+)
+
+
+def _decode_kernel(
+    lens_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
+    *, hkv: int, block_k: int, block_q: int, n: int,
+):
+    """One (batch*kv-head, kv-block) grid step of cached decode."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    valid = lens_ref[bh // hkv]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * block_k < valid)
+    def _tile():
+        _flash_tile(
+            q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+            valid=valid, q_offset=0, kv_offset=0,
+            kv_idx=j, q_idx=0,
+            n_true=n, block_k=block_k, causal=False, block_q=block_q,
+        )
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        # empty-cache guard, the reference's 1/gsum div-by-zero guard
+        # (attention-mpi.c:358-362)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def _pick_block_k(n: int, want: int) -> int:
+    """Largest multiple of 128 that divides n and is <= want."""
+    if n % 128:
+        raise ValueError(f"cache capacity {n} must be a multiple of 128")
+    bk = min(_ceil_to(want, 128), n)
+    while n % bk:
+        bk -= 128
+    return bk
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def flash_decode(
+    q: jax.Array,        # (B, H, d)
+    k_cache: jax.Array,  # (B, Hkv, N, d)
+    v_cache: jax.Array,  # (B, Hkv, N, dv)
+    lengths: jax.Array,  # (B,) int32 valid rows per sequence, or scalar
+    *,
+    scale: float | None = None,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv)."""
+    if q.ndim != 3 or k_cache.ndim != 4 or v_cache.ndim != 4:
+        raise ValueError(
+            f"expected q (B,H,d), caches (B,Hkv,N,d): got "
+            f"Q{q.shape} K{k_cache.shape} V{v_cache.shape}"
+        )
+    b, h, d = q.shape
+    bk_, hkv, n, dk = k_cache.shape
+    dv = v_cache.shape[-1]
+    if bk_ != b or v_cache.shape[:3] != (b, hkv, n) or dk != d:
+        raise ValueError(
+            f"cache shapes inconsistent: Q{q.shape} K{k_cache.shape} "
+            f"V{v_cache.shape}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+
+    # Pre-scale Q by scale*log2(e) (flash.py's log2-domain trick) and lay
+    # the q-head group out as the row block of one matmul per KV head.
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    qs = qs.reshape(b * hkv, group, d)
+    group_pad = _ceil_to(group, 16)  # min sublane tile (bf16-safe)
+    if group_pad != group:
+        qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
+
+    block_k = _pick_block_k(n, block_k)
+    kc = k_cache.reshape(b * hkv, n, d)
+    vc = v_cache.reshape(b * hkv, n, dv)
+
+    def kv_index(bh, j, lens_ref):
+        # Clamp past-the-prefix block indices to the last valid block:
+        # the repeated index makes Pallas skip the HBM->VMEM DMA, so
+        # bandwidth scales with the used prefix (see module docstring).
+        valid = lens_ref[bh // hkv]
+        last = jnp.maximum((valid + block_k - 1) // block_k - 1, 0)
+        return (bh, jnp.minimum(j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n // block_k),
+        in_specs=[
+            pl.BlockSpec((1, group_pad, d), lambda bh, j, lens_ref: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, dv), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, group_pad, dv), lambda bh, j, lens_ref: (bh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, dv), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, hkv=hkv, block_k=block_k, block_q=group_pad, n=n
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, dv), v_cache.dtype),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * h * n * (d + dv),
+            bytes_accessed=(kc.size + vc.size) * kc.dtype.itemsize
+            + qs.size * qs.dtype.itemsize,
+            transcendentals=b * h * n,
+        ),
+        interpret=interpret,
+    )(lens, qs, kc, vc)
+
+    return out[:, :group].reshape(b, h, dv)

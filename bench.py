@@ -109,8 +109,8 @@ def main(argv=None) -> int:
     p.add_argument("--seq", type=int, default=32768)
     p.add_argument("--dim", type=int, default=128)
     p.add_argument("--repeats", type=int, default=3)
-    p.add_argument("--block-q", type=int, default=512)
-    p.add_argument("--block-k", type=int, default=512)
+    p.add_argument("--block-q", type=int, default=256)
+    p.add_argument("--block-k", type=int, default=1024)
     p.add_argument(
         "--serial-seq", type=int, default=4096,
         help="m=n at which the serial C oracle is timed (then extrapolated)",
