@@ -161,6 +161,45 @@ def strong_scaling(
     return out
 
 
+def placement_table(
+    m: int = 2048,
+    n: int = 8192,
+    dk: int = 128,
+    dv: int = 128,
+    *,
+    n_devices: int | None = None,
+    repeats: int = 3,
+    block_sizes: BlockSizes | None = None,
+    dtype=jnp.bfloat16,
+) -> dict[str, RunRecord]:
+    """Device-order study — the reference's process-placement experiment
+    (report Q5: 16 procs on 1/2/4 nodes, `images/process_placement.png`)
+    rebuilt for a TPU mesh: the same 1D kv mesh laid over the devices in
+    identity / reversed / strided order.  Device order decides which
+    pmax/psum hops ride adjacent ICI links, the analog of ranks sharing
+    a node vs crossing the fabric.  (On the virtual CPU mesh all orders
+    cost the same — the point there is methodology, not numbers.)
+    """
+    bs = block_sizes or BlockSizes()
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    r = len(devs)
+    orders = {"identity": devs, "reversed": devs[::-1]}
+    if r >= 4 and r % 2 == 0:
+        orders["strided"] = devs[0::2] + devs[1::2]
+    q, k, v = _qkv(m, n, dk, dv, dtype)
+    out: dict[str, RunRecord] = {}
+    for name, order in orders.items():
+        mesh = jax.sharding.Mesh(list(order), ("kv",))
+        t = benchmark_attention(kv_sharded_attention, q, k, v, mesh=mesh,
+                                block_sizes=bs, repeats=repeats)
+        out[name] = _record("placement", "kv-sharded", m, n, dk, dv, dtype,
+                            t, n_devices=r, mesh_axes=mesh.shape)
+    base = out["identity"].best_us
+    for rec in out.values():
+        rec.extra = {"relative_time_vs_identity": rec.best_us / base}
+    return out
+
+
 def weak_scaling(
     n_per_device: int = 2048,
     m: int = 2048,

@@ -145,9 +145,12 @@ class GQASelfAttention(nn.Module):
             cache.v, v.astype(cache.v.dtype), (0, 0, cache.length, 0)
         )
         new_len = cache.length + s_new
-        if self.impl not in ATTN_IMPLS:
+        # Cached dispatch is explicit per impl: a registry entry without a
+        # cached path must fail loudly, not silently take the flash one.
+        if self.impl not in ("xla", "flash"):
             raise KeyError(
-                f"unknown impl {self.impl!r}; available: {sorted(ATTN_IMPLS)}"
+                f"impl {self.impl!r} has no cached-attention path "
+                f"(supported: ['flash', 'xla'])"
             )
         if self.impl == "xla":
             out = _xla_cached_attention(

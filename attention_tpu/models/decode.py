@@ -61,6 +61,10 @@ def generate(
         capacity = -(-(s + steps) // 128) * 128
     if capacity < s + steps:
         raise ValueError(f"capacity {capacity} < prompt+steps {s + steps}")
+    if capacity % 128:
+        # flash_decode's cache-capacity contract, checked up front so the
+        # error doesn't surface from inside the jitted scan
+        raise ValueError(f"capacity {capacity} must be a multiple of 128")
 
     last_logits, caches = prefill(model, params, prompt, capacity)
     first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)

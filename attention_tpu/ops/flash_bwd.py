@@ -177,7 +177,11 @@ def flash_backward(
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """dQ, dK, dV via the two Pallas backward kernels."""
-    bs = block_sizes or BlockSizes()
+    # Backward default pinned independently of the forward's: the
+    # forward retune to (256, 1024) (scripts/kernel_sweep.py) measured
+    # only the forward kernel; the KV-major backward tiles have their
+    # own VMEM footprint (fp32 P/dS tiles, two accumulators).
+    bs = block_sizes or BlockSizes(256, 512)
     h, m, d = q.shape
     hkv, n, dv = v.shape
     group = h // hkv

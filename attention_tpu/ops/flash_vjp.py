@@ -172,7 +172,10 @@ def flash_attention_diff(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
-    bs = block_sizes or BlockSizes()
+    # None flows through: the forward resolves it to BlockSizes()'s
+    # (256, 1024) and flash_backward to its own (256, 512) default — the
+    # two kernels are tuned independently (see flash_bwd.py).
+    bs = block_sizes
     if q.ndim == 2:
         return _flash_diff(
             q[None], k[None], v[None], scale, causal, bs, bwd_chunk, bwd_impl

@@ -95,8 +95,8 @@ def benchmark_attention(fn, q, k, v, *, repeats: int = 5, warmup: int = 2,
 
     dk = q.shape[-1]
 
-    def step(x):
-        out = fn(x, k, v, **kwargs)
+    def step(x, kk, vv):
+        out = fn(x, kk, vv, **kwargs)
         dv = out.shape[-1]
         if dv > dk:
             out = out[..., :dk]
@@ -104,7 +104,8 @@ def benchmark_attention(fn, q, k, v, *, repeats: int = 5, warmup: int = 2,
             out = jnp.pad(out, [(0, 0)] * (out.ndim - 1) + [(0, dk - dv)])
         return out
 
-    per = benchmark_amortized(step, q, repeats=max(2, repeats // 2))
+    per = benchmark_amortized(step, q, repeats=max(2, repeats // 2),
+                              operands=(k, v))
     return Timing(times_s=[per])
 
 
@@ -115,6 +116,7 @@ def benchmark_amortized(
     repeats: int = 3,
     n_short: int = 4,
     n_long: int = 20,
+    operands: tuple = (),
 ) -> float:
     """Per-iteration seconds of ``fn`` via scan-chained slope timing.
 
@@ -126,31 +128,34 @@ def benchmark_amortized(
     fetch ONE scalar, and take the slope (t_long - t_short)/(n_long -
     n_short) — fixed tunnel latency cancels.
 
-    ``fn`` must map an array to an array of the same shape; its output is
-    cast back to ``x.dtype`` between iterations.
+    ``fn`` maps ``(x, *operands)`` to an array of x's shape; its output
+    is cast back to ``x.dtype`` between iterations.  Pass big side
+    inputs (K/V, caches) via ``operands``, NOT closure: closure-captured
+    arrays are flattened into the jaxpr as constants, and at
+    hundreds-of-MB that makes lowering/compilation take minutes.
     """
     import functools
 
     import jax.numpy as jnp
     from jax import lax
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def chained(x0, n):
+    @functools.partial(jax.jit, static_argnums=2)
+    def chained(x0, ops, n):
         def body(carry, _):
-            return fn(carry).astype(x0.dtype), None
+            return fn(carry, *ops).astype(x0.dtype), None
 
         out, _ = lax.scan(body, x0, None, length=n)
         return jnp.sum(out.astype(jnp.float32))
 
-    jax.device_get(chained(x, n_short))  # compile both lengths
-    jax.device_get(chained(x, n_long))
+    jax.device_get(chained(x, operands, n_short))  # compile both lengths
+    jax.device_get(chained(x, operands, n_long))
     shorts, longs = [], []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        jax.device_get(chained(x, n_short))
+        jax.device_get(chained(x, operands, n_short))
         shorts.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        jax.device_get(chained(x, n_long))
+        jax.device_get(chained(x, operands, n_long))
         longs.append(time.perf_counter() - t0)
     slope = (min(longs) - min(shorts)) / (n_long - n_short)
     if slope <= 0:

@@ -37,11 +37,15 @@ import time
 
 
 def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
-                   block_k: int, *, n_short: int = 4, n_long: int = 20):
+                   block_k: int, *, heads: int | None = None,
+                   kv_heads: int | None = None, n_short: int = 4,
+                   n_long: int = 20):
     """Per-call seconds of the fused flash kernel at (seq, dim), bf16.
 
-    Shared by bench.py (headline) and scripts/kernel_sweep.py so both use
-    one timing method and one input recipe.
+    ``heads``/``kv_heads`` switch to multi-head (h, seq, dim) inputs
+    (GQA when kv_heads < heads).  Shared by bench.py (headline) and
+    scripts/kernel_sweep.py so both use one timing method and one input
+    recipe.
     """
     import jax
     import jax.numpy as jnp
@@ -50,16 +54,39 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
     from attention_tpu.utils.timing import benchmark_amortized
 
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
-    q = jax.random.normal(kq, (seq, dim), jnp.bfloat16)
-    k = jax.random.normal(kk, (seq, dim), jnp.bfloat16)
-    v = jax.random.normal(kv, (seq, dim), jnp.bfloat16)
+    qshape = (seq, dim) if heads is None else (heads, seq, dim)
+    kvshape = (seq, dim) if heads is None else (kv_heads or heads, seq, dim)
+    q = jax.random.normal(kq, qshape, jnp.bfloat16)
+    k = jax.random.normal(kk, kvshape, jnp.bfloat16)
+    v = jax.random.normal(kv, kvshape, jnp.bfloat16)
     bs = BlockSizes(block_q, block_k)
     return benchmark_amortized(
-        lambda x: flash_attention(x, k, v, block_sizes=bs),
+        lambda x, kk, vv: flash_attention(x, kk, vv, block_sizes=bs),
         q,
         repeats=repeats,
         n_short=n_short,
         n_long=n_long,
+        operands=(k, v),
+    )
+
+
+def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
+                    dim: int, repeats: int):
+    """Per-step seconds of fused flash-decode at a full KV cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.decode import flash_decode
+    from attention_tpu.utils.timing import benchmark_amortized
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, heads, dim), jnp.bfloat16)
+    kc = jax.random.normal(kk, (batch, kv_heads, cache_len, dim), jnp.bfloat16)
+    vc = jax.random.normal(kv, (batch, kv_heads, cache_len, dim), jnp.bfloat16)
+    lens = jnp.full((batch,), cache_len, jnp.int32)
+    return benchmark_amortized(
+        lambda x, kcc, vcc, ll: flash_decode(x, kcc, vcc, ll),
+        q, repeats=repeats, operands=(kc, vc, lens),
     )
 
 
@@ -145,22 +172,43 @@ def main(argv=None) -> int:
     }
 
     if args.all:
+        # The BASELINE.md config ladder (serial config 1 is the
+        # denominator above; configs 2-5 measured here on one chip).
         ladder = {}
-        for name, (seq, dim) in {
-            "single_chip_8k": (8192, 128),
-            "seq_32k": (32768, 128),
+        for name, (seq, dim, h, hkv) in {
+            "single_chip_8k": (8192, 128, None, None),
+            "seq_32k": (32768, 128, None, None),
+            "long_131k": (131072, 128, None, None),
+            "gqa_32q4kv_16k": (16384, 128, 32, 4),
         }.items():
-            if (seq, dim) == (args.seq, args.dim):
+            if (seq, dim, h) == (args.seq, args.dim, None):
                 s = tpu_s  # headline already measured this config
             else:
+                # Scan-chain lengths scale inversely with per-call cost:
+                # small configs need long chains to rise above dispatch
+                # jitter; big configs keep chains short so compile+upload
+                # don't dominate wall time.
+                n_long = max(8, min(64, (32768 // seq) * 16))
                 s = _bench_flash_s(seq, dim, args.repeats, args.block_q,
-                                   args.block_k)
-            fl = attention_flops(seq, seq, dim, dim)
+                                   args.block_k, heads=h, kv_heads=hkv,
+                                   n_short=max(2, n_long // 8),
+                                   n_long=n_long)
+            fl = attention_flops(seq, seq, dim, dim) * (h or 1)
             ladder[name] = {
                 "ms": round(s * 1e3, 3),
                 "gflops": round(fl / s / 1e9, 1),
                 "util": round(fl / s / peak_flops(), 4),
             }
+        # fixed config (name encodes it) — independent of --dim/--seq
+        dec_b, dec_h, dec_hkv, dec_len, dec_d = 8, 32, 4, 32768, 128
+        dec_s = _bench_decode_s(dec_b, dec_h, dec_hkv, dec_len, dec_d,
+                                args.repeats)
+        cache_bytes = 2 * dec_b * dec_hkv * dec_len * dec_d * 2
+        ladder["decode_b8_32q4kv_cache32k"] = {
+            "ms": round(dec_s * 1e3, 3),
+            "tokens_per_s": round(dec_b / dec_s, 1),
+            "cache_read_gb_per_s": round(cache_bytes / dec_s / 1e9, 1),
+        }
         result["detail"]["ladder"] = ladder
 
     print(json.dumps(result))

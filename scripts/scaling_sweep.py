@@ -1,0 +1,106 @@
+"""CLI driver for the reference's performance-analysis sweeps.
+
+Reproduces the report's methodology end to end (ablation Q2, strong
+scaling Q4/Q7, weak scaling Q7, placement Q5) as one command emitting
+structured JSON lines — the counterpart of the reference's
+`mpirun -np ... / --map-by ppr:N:node` sweep recipes (README.md:136-142).
+
+Multi-device sweeps need a mesh: on a one-chip host run with
+``--platform cpu8`` to use the 8-device virtual CPU mesh (methodology
+check; absolute times are CPU-bound), or on a real multi-chip slice run
+as-is.
+
+Usage:
+  python scripts/scaling_sweep.py ablation  [--m 4096 --n 4096]
+  python scripts/scaling_sweep.py strong    [--platform cpu8]
+  python scripts/scaling_sweep.py weak      [--platform cpu8]
+  python scripts/scaling_sweep.py placement [--platform cpu8]
+  python scripts/scaling_sweep.py all       [--platform cpu8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _setup_platform(platform: str) -> None:
+    if platform == "cpu8":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _emit(config: str, key: str, rec) -> None:
+    row = {"sweep": config, "variant": key, **dataclasses.asdict(rec)}
+    print(json.dumps(row), flush=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("sweep", choices=["ablation", "strong", "weak",
+                                     "placement", "all"])
+    p.add_argument("--platform", choices=["default", "cpu8"],
+                   default="default")
+    p.add_argument("--m", type=int, default=None)
+    p.add_argument("--n", type=int, default=None)
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args()
+
+    _setup_platform(args.platform)
+
+    import jax
+
+    from attention_tpu import benchmarks
+
+    sweeps = ([args.sweep] if args.sweep != "all"
+              else ["ablation", "strong", "weak", "placement"])
+    multi = len(jax.devices()) > 1
+    for sweep in sweeps:
+        if sweep == "ablation":
+            mesh = None
+            if multi:
+                from attention_tpu.parallel.mesh import default_mesh
+
+                mesh = default_mesh("kv")
+            kw = {}
+            if args.m:
+                kw["m"] = args.m
+            if args.n:
+                kw["n"] = args.n
+            for key, rec in benchmarks.ablation_table(
+                repeats=args.repeats, mesh=mesh, **kw
+            ).items():
+                _emit(sweep, key, rec)
+        elif sweep in ("strong", "weak"):
+            if not multi:
+                print(json.dumps({"sweep": sweep, "skipped":
+                                  "needs >1 device; use --platform cpu8"}))
+                continue
+            fn = (benchmarks.strong_scaling if sweep == "strong"
+                  else benchmarks.weak_scaling)
+            for rec in fn(repeats=args.repeats):
+                _emit(sweep, f"{rec.n_devices}dev", rec)
+        elif sweep == "placement":
+            if not multi:
+                print(json.dumps({"sweep": sweep, "skipped":
+                                  "needs >1 device; use --platform cpu8"}))
+                continue
+            for key, rec in benchmarks.placement_table(
+                repeats=args.repeats
+            ).items():
+                _emit(sweep, key, rec)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

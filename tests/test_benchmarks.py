@@ -49,6 +49,16 @@ def test_weak_scaling_records():
     assert [r.n for r in recs] == [64, 128]
 
 
+def test_placement_table_orders():
+    from attention_tpu.benchmarks import placement_table
+
+    recs = placement_table(64, 256, 16, 16, repeats=1, block_sizes=BS,
+                           dtype=jnp.float32)
+    assert set(recs) == {"identity", "reversed", "strided"}
+    assert recs["identity"].extra["relative_time_vs_identity"] == 1.0
+    assert all(r.n_devices == 8 for r in recs.values())
+
+
 def test_run_record_jsonl(tmp_path):
     rec = RunRecord(
         config="t", backend="b", m=1, n=2, dk=3, dv=4, dtype="f32",
