@@ -20,10 +20,14 @@ log-sum-exp, then
     scratch and are written once per KV head.  The grouped reduction
     never materializes `jnp.repeat`-expanded gradients in HBM.
 
-Everything runs **KV-major** (tiles are (block_k, block_q)): the per-row
-stats lse/D then broadcast along lanes as natural (1, block_q) row
-vectors, so no in-kernel transposes of narrow tiles are needed; the MXU
-does not care about the orientation of the contractions.
+Tiles are **Q-major** ((block_q, block_k)), matching the forward
+kernel: the per-row stats lse/D enter lane-replicated as
+(block_q, _STAT_LANES) blocks — the same layout the forward emits —
+because Mosaic requires the last two block dims to be (8k, 128m), which
+a narrow (1, block_q) row-vector block violates.  Lane-replicated
+stats reduce to (block_q, 1) columns with no in-kernel transposes, and
+the MXU contracts over either operand dimension, so Pᵀ dO / dSᵀ Q are
+single dot_generals on the Q-major tiles.
 
 Domain bookkeeping matches the forward (`flash.py::_flash_call`): Q is
 pre-scaled by scale·log2(e) and re-rounded to the input dtype, so scores
@@ -44,6 +48,7 @@ from jax.experimental.pallas import tpu as pltpu
 from attention_tpu.ops.flash import (
     _LN2,
     _LOG2E,
+    _STAT_LANES,
     NEG_INF,
     BlockSizes,
     _ceil_to,
@@ -51,23 +56,28 @@ from attention_tpu.ops.flash import (
 )
 
 
-def _recompute_p_t(qs, k, lse_row, *, causal, q_base, k_base):
-    """(block_k, block_q) probability tile, KV-major.
+def _stat_col(ref):
+    """Lane-replicated (block_q, _STAT_LANES) stat block -> (block_q, 1)."""
+    return jnp.max(ref[0], axis=-1, keepdims=True)
+
+
+def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base):
+    """(block_q, block_k) probability tile, Q-major.
 
     ``qs`` is the forward's pre-scaled Q (scores come out log2-domain),
-    ``lse_row`` a (1, block_q) log2-domain log-sum-exp row vector.
+    ``lse_col`` a (block_q, 1) log2-domain log-sum-exp column.
     """
-    s2t = jax.lax.dot_general(
-        k, qs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (block_k, block_q)
-    p_t = jnp.exp2(s2t - lse_row)
+    s2 = jax.lax.dot_general(
+        qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (block_q, block_k)
+    p = jnp.exp2(s2 - lse_col)
     if causal:
-        col = k_base + jax.lax.broadcasted_iota(jnp.int32, p_t.shape, 0)
-        row = q_base + jax.lax.broadcasted_iota(jnp.int32, p_t.shape, 1)
+        row = q_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+        col = k_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
         # also guards rows the forward fully masked (lse == -inf)
-        p_t = jnp.where(jnp.logical_and(col <= row, lse_row != NEG_INF),
-                        p_t, 0.0)
-    return p_t
+        p = jnp.where(jnp.logical_and(col <= row, lse_col != NEG_INF),
+                      p, 0.0)
+    return p
 
 
 def _dq_kernel(
@@ -84,16 +94,17 @@ def _dq_kernel(
 
     def _compute():
         qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p_t = _recompute_p_t(
-            qs, k, lse_ref[...], causal=causal, q_base=q_base, k_base=k_base
+        p = _recompute_p(
+            qs, k, _stat_col(lse_ref), causal=causal,
+            q_base=q_base, k_base=k_base,
         )
-        dp_t = jax.lax.dot_general(
-            v, do, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_k, block_q) = (dO Vᵀ)ᵀ
-        ds_t = p_t * (dp_t - delta_ref[...])
+        )  # (block_q, block_k) = dO Vᵀ
+        ds = p * (dp - _stat_col(delta_ref))
         acc_scr[...] += jax.lax.dot_general(
-            ds_t.astype(compute_dtype), k, (((0,), (0,)), ((), ())),
+            ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, d) = dS K
 
@@ -128,23 +139,23 @@ def _dkv_kernel(
 
     def _compute():
         qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p_t = _recompute_p_t(
-            qs, k, lse_ref[...], causal=causal, q_base=q_base, k_base=k_base
+        p = _recompute_p(
+            qs, k, _stat_col(lse_ref), causal=causal,
+            q_base=q_base, k_base=k_base,
         )
         dv_scr[...] += jax.lax.dot_general(
-            p_t.astype(compute_dtype), do, (((1,), (0,)), ((), ())),
+            p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_k, dv) = Pᵀ dO
-        dp_t = jax.lax.dot_general(
-            v, do, (((1,), (1,)), ((), ())),
+        )  # (block_k, dv) = Pᵀ dO — contraction over the q dim
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
-        ds_t = p_t * (dp_t - delta_ref[...])
+        )  # (block_q, block_k)
+        ds = p * (dp - _stat_col(delta_ref))
         dk_scr[...] += jax.lax.dot_general(
-            ds_t.astype(compute_dtype), qs, (((1,), (0,)), ((), ())),
+            ds.astype(compute_dtype), qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_k, d) = dSᵀ Q_scaled
-
     if causal:
         # Q tiles wholly above the diagonal contribute nothing to this
         # KV block — skip them (halves causal backward FLOPs).
@@ -177,11 +188,12 @@ def flash_backward(
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """dQ, dK, dV via the two Pallas backward kernels."""
-    # Backward default pinned independently of the forward's: the
-    # forward retune to (256, 1024) (scripts/kernel_sweep.py) measured
-    # only the forward kernel; the KV-major backward tiles have their
-    # own VMEM footprint (fp32 P/dS tiles, two accumulators).
-    bs = block_sizes or BlockSizes(256, 512)
+    # Backward default pinned independently of the forward's (256, 1024):
+    # scripts/bwd_sweep.py on the real chip put block_q=512 clearly ahead
+    # of 256 for the combined dQ+dKdV pass (~2.2 ms vs ~4 ms at seq=8k,
+    # h=4, bf16), with 512x512 and 512x1024 within contention noise of
+    # each other; 512x512 keeps the smaller VMEM footprint.
+    bs = block_sizes or BlockSizes(512, 512)
     h, m, d = q.shape
     hkv, n, dv = v.shape
     group = h // hkv
@@ -190,7 +202,7 @@ def flash_backward(
     # recomputed P matches the forward probabilities bit-for-bit modulo
     # fp32 non-associativity.
     qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
-    lse2 = (lse.astype(jnp.float32) * _LOG2E)
+    lse2 = lse.astype(jnp.float32) * _LOG2E
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), -1)
 
     block_q = min(bs.block_q, _ceil_to(m, 128))
@@ -213,10 +225,17 @@ def flash_backward(
     do = do32.astype(q.dtype)
     compute_dtype = q.dtype
 
+    # Stats enter lane-replicated — Mosaic's block tiling needs the last
+    # two dims (8k, 128m), which a (1, block_q) row block violates.
+    lse_rep = jnp.broadcast_to(lse2[..., None], (h, m_pad, _STAT_LANES))
+    delta_rep = jnp.broadcast_to(delta[..., None], (h, m_pad, _STAT_LANES))
+
     num_i = m_pad // block_q
     num_j = n_pad // block_k
 
-    stat_spec_q_major = pl.BlockSpec((1, block_q), lambda hh, ii, jj: (hh, ii))
+    stat_spec_q = pl.BlockSpec(
+        (1, block_q, _STAT_LANES), lambda hh, ii, jj: (hh, ii, 0)
+    )
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel,
@@ -229,8 +248,8 @@ def flash_backward(
         ),
         grid=(h, num_i, num_j),
         in_specs=[
-            stat_spec_q_major,
-            stat_spec_q_major,
+            stat_spec_q,
+            stat_spec_q,
             pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
             pl.BlockSpec((1, block_k, d), lambda hh, ii, jj: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_k, dv), lambda hh, ii, jj: (hh // group, jj, 0)),
@@ -248,9 +267,11 @@ def flash_backward(
             transcendentals=h * m_pad * n_pad,
         ),
         interpret=interpret,
-    )(lse2, delta, qs, k, v, do)[:, :m]
+    )(lse_rep, delta_rep, qs, k, v, do)[:, :m]
 
-    stat_spec_kv_major = pl.BlockSpec((1, block_q), lambda jj, hh, ii: (hh, ii))
+    stat_spec_kv = pl.BlockSpec(
+        (1, block_q, _STAT_LANES), lambda jj, hh, ii: (hh, ii, 0)
+    )
     dk, dvg = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
@@ -262,8 +283,8 @@ def flash_backward(
         ),
         grid=(num_j, h, num_i),
         in_specs=[
-            stat_spec_kv_major,
-            stat_spec_kv_major,
+            stat_spec_kv,
+            stat_spec_kv,
             pl.BlockSpec((1, block_q, d), lambda jj, hh, ii: (hh, ii, 0)),
             pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
@@ -290,5 +311,5 @@ def flash_backward(
             transcendentals=h * m_pad * n_pad,
         ),
         interpret=interpret,
-    )(lse2, delta, qs, k, v, do)
+    )(lse_rep, delta_rep, qs, k, v, do)
     return dq, dk[:, :n].astype(k.dtype), dvg[:, :n].astype(v.dtype)

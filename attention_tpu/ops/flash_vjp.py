@@ -173,7 +173,7 @@ def flash_attention_diff(
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     # None flows through: the forward resolves it to BlockSizes()'s
-    # (256, 1024) and flash_backward to its own (256, 512) default — the
+    # (256, 1024) and flash_backward to its own (512, 512) default — the
     # two kernels are tuned independently (see flash_bwd.py).
     bs = block_sizes
     if q.ndim == 2:

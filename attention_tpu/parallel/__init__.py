@@ -5,4 +5,8 @@ from attention_tpu.parallel.mesh import (  # noqa: F401
 )
 from attention_tpu.parallel.kv_sharded import kv_sharded_attention  # noqa: F401
 from attention_tpu.parallel.ring import ring_attention  # noqa: F401
+from attention_tpu.parallel.serving import (  # noqa: F401
+    cache_sharded_decode,
+    head_sharded_decode,
+)
 from attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,153 @@
+"""Sharded autoregressive decoding: serve a KV cache across a mesh.
+
+The serving-side counterpart of the training-time parallel strategies —
+not in the reference (whose kernel is one-shot batch, `attention-mpi.c`),
+but required for the framework's decode path (`ops/decode.py`) to scale
+the way the batch path does:
+
+  * :func:`head_sharded_decode` — tensor-parallel serving: the KV cache
+    (and the q-head groups that read it) sharded over KV heads.  Fully
+    embarrassingly parallel: zero collectives per token; each chip
+    streams only its own cache shard.
+  * :func:`cache_sharded_decode` — sequence-parallel serving for caches
+    too large for one chip's HBM: cache *rows* sharded over the mesh,
+    per-shard online-softmax partials merged with the same two-phase
+    pmax/psum scheme as the batch path (`kv_sharded.merge_partials`,
+    the reference's `attention-mpi.c:340-380` algorithm applied to a
+    single query row).
+
+Both are `shard_map`s over a 1D mesh axis and compose with an outer
+batch/data-parallel axis via pjit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
+from attention_tpu.parallel.kv_sharded import merge_partials
+from attention_tpu.parallel.mesh import default_mesh
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "scale", "block_k", "interpret"),
+)
+def head_sharded_decode(
+    q: jax.Array,        # (B, H, d)
+    k_cache: jax.Array,  # (B, Hkv, N, d)
+    v_cache: jax.Array,  # (B, Hkv, N, dv)
+    lengths: jax.Array,  # (B,) or scalar
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "tp",
+    scale: float | None = None,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Tensor-parallel decode: KV heads sharded, zero collectives.
+
+    Contiguous head chunks keep q-head -> kv-head groups aligned per
+    device (q head j reads kv head j // group; chunk r holds q heads
+    [r·H/R, (r+1)·H/R) and exactly their kv heads [r·Hkv/R, ...)), so
+    each chip runs a complete :func:`flash_decode` on its slice.
+    """
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    b, h, d = q.shape
+    hkv = k_cache.shape[1]
+    if hkv % n_dev:
+        raise ValueError(f"kv heads {hkv} not divisible by mesh size {n_dev}")
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+
+    q_spec = P(None, axis_name, None)
+    c_spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(q_spec, c_spec, c_spec, P(None)),
+        out_specs=q_spec,
+    )
+    def run(q_local, k_local, v_local, lens_full):
+        return flash_decode(
+            q_local, k_local, v_local, lens_full,
+            scale=scale, block_k=block_k, interpret=interpret,
+        )
+
+    return run(q, k_cache, v_cache, lens)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes"),
+)
+def cache_sharded_decode(
+    q: jax.Array,        # (B, H, d)
+    k_cache: jax.Array,  # (B, Hkv, N, d)
+    v_cache: jax.Array,  # (B, Hkv, N, dv)
+    length: jax.Array,   # scalar valid length (uniform batch)
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "sp",
+    scale: float | None = None,
+    block_sizes: BlockSizes | None = None,
+) -> jax.Array:
+    """Sequence-parallel decode: cache *rows* sharded over the mesh.
+
+    Each device computes online-softmax partials over its cache shard
+    (kv_valid clipped to the shard's slice of the valid prefix), then
+    the two-phase pmax/psum merge normalizes globally — one query row's
+    worth of the reference's distributed softmax (SURVEY §3.3).
+    """
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    b, h, d = q.shape
+    _, hkv, n, dv = v_cache.shape
+    if n % n_dev:
+        raise ValueError(
+            f"cache capacity {n} not divisible by mesh size {n_dev}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    group = h // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    shard_n = n // n_dev
+    length = jnp.asarray(length, jnp.int32).reshape(())
+
+    # Each (batch, kv-head) pair becomes one kernel head whose q rows are
+    # the GQA group — the same layout trick as `flash_decode`.
+    qs = q.reshape(b * hkv, group, d)
+    kc = k_cache.reshape(b * hkv, n, d)
+    vc = v_cache.reshape(b * hkv, n, dv)
+
+    c_spec = P(None, axis_name, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(P(), c_spec, c_spec, P()),
+        out_specs=P(),
+    )
+    def run(q_full, k_local, v_local, length_full):
+        idx = lax.axis_index(axis_name)
+        kv_valid = jnp.clip(length_full - idx * shard_n, 0, shard_n)
+        out_un, lmax, lsum = flash_attention_partials(
+            q_full, k_local, v_local, scale=scale,
+            block_sizes=block_sizes, kv_valid=kv_valid,
+        )
+        return merge_partials(out_un, lmax, lsum, axis_name)
+
+    out = run(qs, kc, vc, length)  # (b*hkv, group, dv), replicated
+    return out.reshape(b, h, dv).astype(v_cache.dtype)

@@ -1,0 +1,86 @@
+"""Block-size sweep for the flash *backward* kernels on real TPU.
+
+The forward sweep (kernel_sweep.py) picked (256, 1024); the backward
+kernels (flash_bwd.py) have a different VMEM footprint (fp32 P/dS tiles
+plus dK/dV accumulators), so they are tuned separately.  Chains dO -> dQ
+through the amortized scan clock (the only honest timing under the axon
+tunnel — see utils/timing.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bench_bwd_s(seq, dim, heads, bq, bk, repeats):
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.flash import BlockSizes
+    from attention_tpu.ops.flash_bwd import flash_backward
+    from attention_tpu.ops.flash_vjp import _flash_fwd_impl
+    from attention_tpu.utils.timing import benchmark_amortized
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    scale = 1.0 / dim**0.5
+    q = jax.random.normal(ks[0], (heads, seq, dim), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (heads, seq, dim), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (heads, seq, dim), jnp.bfloat16)
+    out, lse = _flash_fwd_impl(q, k, v, scale, False, None)
+
+    def step(dout, qq, kk, vv, oo, ll):
+        dq, dk, dv = flash_backward(
+            qq, kk, vv, oo, ll, dout, scale=scale,
+            block_sizes=BlockSizes(bq, bk),
+        )
+        # dq chains the scan (same shape as dout, d == dv); the dk/dv
+        # sums keep the dK/dV kernel live — without a data dependency
+        # XLA dead-code-eliminates it and the sweep times only dQ.
+        return dq + (jnp.sum(dk) + jnp.sum(dv)).astype(dq.dtype)
+
+    return benchmark_amortized(
+        step, jax.random.normal(ks[3], out.shape, jnp.bfloat16),
+        repeats=repeats, n_short=2, n_long=8,
+        operands=(q, k, v, out, lse),
+    )
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--configs", type=str,
+                   default="256x512,512x512,256x1024,128x512,512x1024")
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args()
+
+    # backward ~= 2.5x forward FLOPs (dV, dS·K, dSᵀ·Q + P recompute)
+    flops = 5 * 2 * args.heads * args.seq * args.seq * args.dim
+
+    results = {}
+    for c in args.configs.split(","):
+        bq, bk = (int(x) for x in c.split("x"))
+        try:
+            per = _bench_bwd_s(args.seq, args.dim, args.heads, bq, bk,
+                               args.repeats)
+            results[c] = {"ms": round(per * 1e3, 3),
+                          "tflops": round(flops / per / 1e12, 1)}
+            print(json.dumps({c: results[c]}), flush=True)
+        except Exception as e:  # noqa: BLE001 - sweep must survive bad configs
+            print(json.dumps({c: {"error": str(e)[:120]}}), flush=True)
+    if not results:
+        print(json.dumps({"error": "every config failed"}))
+        return 1
+    best = min(results, key=lambda c_: results[c_]["ms"])
+    print(json.dumps({"best": best, **results[best]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,82 @@
+"""Sharded-decoding tests on the 8-device virtual CPU mesh.
+
+Oracle = the single-device fused decode kernel (itself oracle-tested in
+test_decode.py), so these tests isolate the sharding/merge logic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.parallel import cache_sharded_decode, head_sharded_decode
+from attention_tpu.parallel.mesh import default_mesh
+
+
+def _setup(rng, b, h, hkv, n, d, dtype=np.float32):
+    q = jnp.asarray(rng.standard_normal((b, h, d)), dtype)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), dtype)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), dtype)
+    return q, kc, vc
+
+
+@pytest.mark.parametrize("n_dev,hkv,h", [(4, 4, 8), (8, 8, 16), (2, 4, 4)])
+def test_head_sharded_matches_single_device(rng, n_dev, hkv, h):
+    q, kc, vc = _setup(rng, 2, h, hkv, 512, 64)
+    lens = jnp.asarray([512, 77], jnp.int32)
+    mesh = default_mesh("tp", devices=jax.devices()[:n_dev])
+    got = head_sharded_decode(q, kc, vc, lens, mesh=mesh, block_k=128)
+    want = flash_decode(q, kc, vc, lens, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_head_sharded_rejects_indivisible_heads(rng):
+    q, kc, vc = _setup(rng, 1, 6, 3, 256, 64)
+    mesh = default_mesh("tp", devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="not divisible"):
+        head_sharded_decode(q, kc, vc, 10, mesh=mesh)
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_cache_sharded_matches_single_device(rng, n_dev):
+    # capacity 1024 -> 128-row shards on 8 devices
+    q, kc, vc = _setup(rng, 2, 8, 2, 1024, 64)
+    mesh = default_mesh("sp", devices=jax.devices()[:n_dev])
+    for length in (1024, 300, 1):
+        got = cache_sharded_decode(q, kc, vc, length, mesh=mesh)
+        want = flash_decode(q, kc, vc, length, block_k=128)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5,
+            err_msg=f"length={length}",
+        )
+
+
+def test_cache_sharded_shards_really_hold_slices(rng):
+    """Shards whose slice of the valid prefix is empty must contribute
+    nothing (kv_valid clipping + merge guards)."""
+    q, kc, vc = _setup(rng, 1, 4, 4, 1024, 64)
+    mesh = default_mesh("sp", devices=jax.devices()[:8])
+    # valid prefix shorter than one 128-row shard: 7 devices fully idle
+    got = cache_sharded_decode(q, kc, vc, 100, mesh=mesh)
+    want = flash_decode(q, kc, vc, 100, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_cache_sharded_rejects_indivisible_capacity(rng):
+    q, kc, vc = _setup(rng, 1, 4, 4, 500, 64)
+    mesh = default_mesh("sp", devices=jax.devices()[:8])  # 500 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        cache_sharded_decode(q, kc, vc, 100, mesh=mesh)
+
+
+def test_head_sharded_bf16_tolerance(rng):
+    q, kc, vc = _setup(rng, 2, 8, 4, 256, 128, np.float32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, kc, vc))
+    mesh = default_mesh("tp", devices=jax.devices()[:4])
+    got = np.asarray(
+        head_sharded_decode(qb, kb, vb, 200, mesh=mesh), np.float32
+    )
+    want = np.asarray(flash_decode(q, kc, vc, 200), np.float32)
+    # the reference's ±0.02 mixed-precision contract (attention.c:143)
+    np.testing.assert_allclose(got, want, atol=0.02)
