@@ -76,13 +76,7 @@ def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    m_out_ref,
-    l_out_ref,
-    acc_scr,
-    m_scr,
-    l_scr,
-    *,
+    *rest,
     n_true: int,
     block_k: int,
     causal: bool,
@@ -90,6 +84,7 @@ def _flash_kernel(
     normalize: bool,
     out_dtype,
     dynamic_valid: bool,
+    segmented: bool,
 ):
     """One (head, q-block, kv-block) grid step of online-softmax attention.
 
@@ -99,7 +94,13 @@ def _flash_kernel(
     rotates KV shards and computes the rotating offset from its device
     index) and the number of valid local KV rows (< n when the caller's
     shard includes padding from an indivisible global sequence).
+    ``rest`` = ([q_seg, kv_seg,] o_ref, m_out, l_out, acc, m, l).
     """
+    if segmented:
+        q_seg_ref, kv_seg_ref, *rest = rest
+    else:
+        q_seg_ref = kv_seg_ref = None
+    o_ref, m_out_ref, l_out_ref, acc_scr, m_scr, l_scr = rest
     kv_idx = pl.program_id(2)
     num_kv = pl.num_programs(2)
 
@@ -143,6 +144,7 @@ def _flash_kernel(
             kv_idx=kv_idx, q_idx=q_idx,
             n_true=n_true, block_k=block_k, causal=causal,
             block_q=block_q,
+            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
         )
 
     @pl.when(kv_idx == num_kv - 1)
@@ -166,13 +168,17 @@ def _flash_kernel(
 def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
-    block_q,
+    block_q, q_seg_ref=None, kv_seg_ref=None,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
     traced count of valid KV rows, or None when all ``n_true`` rows are
-    valid (static masking only)."""
+    valid (static masking only).  ``q_seg_ref``/``kv_seg_ref`` are
+    segment-id blocks (lane-replicated (block_q, 128) / sublane-
+    replicated (8, block_k) — see `segment_masks`); scores cross segment
+    boundaries are masked."""
     dynamic_valid = valid is not None
+    segmented = q_seg_ref is not None
 
     # Q arrives pre-scaled by scale*log2(e) (`_flash_call`), so `s` is the
     # scores in the log2 domain: exp(s_nat - m_nat) == exp2(s - m).  This
@@ -187,7 +193,7 @@ def _flash_tile(
     )  # (block_q, block_k), log2-domain
 
     needs_tail_mask = n_true % block_k != 0
-    masked = needs_tail_mask or causal or dynamic_valid
+    masked = needs_tail_mask or causal or dynamic_valid or segmented
     if masked:
         col = kv_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
@@ -200,6 +206,12 @@ def _flash_tile(
             mask = jnp.logical_and(
                 mask, col + kv_offset <= row + q_offset
             )
+        if segmented:
+            # (block_q, 1) vs (1, block_k): all lanes/sublanes of the
+            # replicated id blocks are equal, so max() is just a reshape.
+            q_ids = jnp.max(q_seg_ref[...], axis=-1, keepdims=True)
+            kv_ids = jnp.max(kv_seg_ref[...], axis=0, keepdims=True)
+            mask = jnp.logical_and(mask, q_ids == kv_ids)
         s = jnp.where(mask, s, NEG_INF)
 
     # Online-softmax update (the rmax/rsum recurrence of
@@ -249,12 +261,17 @@ def _flash_call(
     q_offset=None,
     kv_offset=None,
     kv_valid=None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ):
     h, m, d = q.shape
     hkv, n, dv = v.shape
     if h % hkv != 0:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     group = h // hkv
+    segmented = q_segment_ids is not None
+    if segmented != (kv_segment_ids is not None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
 
     # Fold softmax scale * log2(e) into Q once (an (m, d) multiply in
     # fp32) so the kernel never scales the (m, n) score matrix and all
@@ -287,6 +304,7 @@ def _flash_call(
         normalize=normalize,
         out_dtype=out_dtype,
         dynamic_valid=kv_valid is not None,
+        segmented=segmented,
     )
 
     offsets = jnp.stack(
@@ -322,6 +340,17 @@ def _flash_call(
         pl.BlockSpec((1, block_k, d), kv_map),
         pl.BlockSpec((1, block_k, dv), kv_map),
     ]
+    seg_inputs = ()
+    if segmented:
+        q_rep, kv_rep = segment_masks(q_segment_ids, kv_segment_ids,
+                                      m_pad, n_pad)
+        seg_inputs = (q_rep, kv_rep)
+        in_specs += [
+            pl.BlockSpec((block_q, _STAT_LANES),
+                         lambda hh, i, j, off: (i, 0)),
+            pl.BlockSpec((8, block_k),
+                         lambda hh, i, j, off: (0, kv_map(hh, i, j, off)[1])),
+        ]
     out_shapes = [jax.ShapeDtypeStruct((h, m_pad, dv), out_dtype)]
     out_specs = [
         pl.BlockSpec((1, block_q, dv), lambda hh, i, j, off: (hh, i, 0))
@@ -363,7 +392,7 @@ def _flash_call(
             transcendentals=h * m_pad * n_pad,
         ),
         interpret=interpret,
-    )(offsets, q, k, v)
+    )(offsets, q, k, v, *seg_inputs)
 
     out = outs[0][:, :m]
     if return_stats:
@@ -373,8 +402,32 @@ def _flash_call(
     return out
 
 
-def _no_stat_kernel(kernel, off_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr):
-    kernel(off_ref, q_ref, k_ref, v_ref, o_ref, None, None, acc, m_scr, l_scr)
+def _no_stat_kernel(kernel, *args):
+    # args = (off, q, k, v, [q_seg, kv_seg], o, acc, m, l): splice None
+    # stat-output refs in front of the scratch refs.
+    *pre, o_ref, acc, m_scr, l_scr = args
+    kernel(*pre, o_ref, None, None, acc, m_scr, l_scr)
+
+
+def segment_masks(q_seg, kv_seg, m_pad: int, n_pad: int):
+    """Mosaic-legal segment-id layouts for the flash kernels.
+
+    A narrow (1, block) id vector violates the (8, 128) min-tile rule,
+    so ids ship replicated: Q ids lane-replicated (m_pad, _STAT_LANES),
+    KV ids sublane-replicated (8, n_pad).  Padding gets id -1 (matches
+    nothing; real ids are assumed non-negative).
+    """
+    q_seg = jnp.asarray(q_seg, jnp.int32)
+    kv_seg = jnp.asarray(kv_seg, jnp.int32)
+    if q_seg.shape[0] != m_pad:
+        q_seg = jnp.pad(q_seg, (0, m_pad - q_seg.shape[0]),
+                        constant_values=-1)
+    if kv_seg.shape[0] != n_pad:
+        kv_seg = jnp.pad(kv_seg, (0, n_pad - kv_seg.shape[0]),
+                         constant_values=-1)
+    q_rep = jnp.broadcast_to(q_seg[:, None], (m_pad, _STAT_LANES))
+    kv_rep = jnp.broadcast_to(kv_seg[None, :], (8, n_pad))
+    return q_rep, kv_rep
 
 
 def _should_interpret() -> bool:
@@ -433,6 +486,8 @@ def flash_attention(
     q_offset=None,
     kv_offset=None,
     kv_valid=None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> jax.Array:
     """Fused single-device attention: softmax(q k^T * scale) v.
 
@@ -440,12 +495,19 @@ def flash_attention(
     number of KV heads may divide the number of Q heads (GQA — BASELINE
     config 5: 32 Q heads sharing 4 KV heads).  ``q_offset``/``kv_offset``
     (dynamic scalars) give the global sequence positions of the local Q/KV
-    rows for causal masking over shards.
+    rows for causal masking over shards.  ``q_segment_ids``/
+    ``kv_segment_ids`` ((m,)/(n,) non-negative int32, shared across
+    heads) mask attention across packed-sequence boundaries.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = _should_interpret()
+    if q_segment_ids is not None and q.ndim == 4:
+        raise ValueError(
+            "segment ids support 2D/3D inputs (ids shared across heads); "
+            "vmap over the batch for per-sequence ids"
+        )
     qh, kh, vh, unbatch = _canon(q, k, v)
     out = _flash_call(
         qh,
@@ -461,6 +523,8 @@ def flash_attention(
         q_offset=q_offset,
         kv_offset=kv_offset,
         kv_valid=kv_valid,
+        q_segment_ids=q_segment_ids,
+        kv_segment_ids=kv_segment_ids,
     )
     return unbatch(out)
 
@@ -481,6 +545,8 @@ def flash_attention_partials(
     q_offset=None,
     kv_offset=None,
     kv_valid=None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention over a local KV shard.
 
@@ -493,6 +559,10 @@ def flash_attention_partials(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = _should_interpret()
+    if q_segment_ids is not None and q.ndim == 4:
+        raise ValueError(
+            "segment ids support 2D/3D inputs (ids shared across heads)"
+        )
     qh, kh, vh, unbatch = _canon(q, k, v)
     out, row_max, row_sum = _flash_call(
         qh,
@@ -508,6 +578,8 @@ def flash_attention_partials(
         q_offset=q_offset,
         kv_offset=kv_offset,
         kv_valid=kv_valid,
+        q_segment_ids=q_segment_ids,
+        kv_segment_ids=kv_segment_ids,
     )
     if q.ndim == 2:
         return out[0], row_max[0], row_sum[0]

@@ -61,7 +61,8 @@ def _stat_col(ref):
     return jnp.max(ref[0], axis=-1, keepdims=True)
 
 
-def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base):
+def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
+                 q_seg_ref=None, kv_seg_ref=None):
     """(block_q, block_k) probability tile, Q-major.
 
     ``qs`` is the forward's pre-scaled Q (scores come out log2-domain),
@@ -71,19 +72,31 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base):
         qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # (block_q, block_k)
     p = jnp.exp2(s2 - lse_col)
+    mask = None
     if causal:
         row = q_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
         col = k_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
         # also guards rows the forward fully masked (lse == -inf)
-        p = jnp.where(jnp.logical_and(col <= row, lse_col != NEG_INF),
-                      p, 0.0)
+        mask = jnp.logical_and(col <= row, lse_col != NEG_INF)
+    if q_seg_ref is not None:
+        q_ids = jnp.max(q_seg_ref[...], axis=-1, keepdims=True)
+        kv_ids = jnp.max(kv_seg_ref[...], axis=0, keepdims=True)
+        seg = q_ids == kv_ids
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
     return p
 
 
 def _dq_kernel(
-    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, dq_ref, acc_scr,
-    *, causal, block_q, block_k, scale, out_dtype, compute_dtype,
+    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
+    causal, block_q, block_k, scale, out_dtype, compute_dtype, segmented,
 ):
+    if segmented:
+        q_seg_ref, kv_seg_ref, *rest = rest
+    else:
+        q_seg_ref = kv_seg_ref = None
+    dq_ref, acc_scr = rest
     j = pl.program_id(2)
     q_base = pl.program_id(1) * block_q
     k_base = j * block_k
@@ -97,6 +110,7 @@ def _dq_kernel(
         p = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
+            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -122,10 +136,14 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, causal, block_q, block_k, group, compute_dtype,
+    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
+    causal, block_q, block_k, group, compute_dtype, segmented,
 ):
+    if segmented:
+        q_seg_ref, kv_seg_ref, *rest = rest
+    else:
+        q_seg_ref = kv_seg_ref = None
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     h = pl.program_id(1)
     i = pl.program_id(2)
     h_in_group = jax.lax.rem(h, group)
@@ -142,6 +160,7 @@ def _dkv_kernel(
         p = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
+            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
         )
         dv_scr[...] += jax.lax.dot_general(
             p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
@@ -186,8 +205,13 @@ def flash_backward(
     causal: bool = False,
     block_sizes: BlockSizes | None = None,
     interpret: bool = False,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """dQ, dK, dV via the two Pallas backward kernels."""
+    segmented = q_segment_ids is not None
+    if segmented != (kv_segment_ids is not None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
     # Backward default pinned independently of the forward's (256, 1024):
     # scripts/bwd_sweep.py on the real chip put block_q=512 clearly ahead
     # of 256 for the combined dQ+dKdV pass (~2.2 ms vs ~4 ms at seq=8k,
@@ -230,6 +254,24 @@ def flash_backward(
     lse_rep = jnp.broadcast_to(lse2[..., None], (h, m_pad, _STAT_LANES))
     delta_rep = jnp.broadcast_to(delta[..., None], (h, m_pad, _STAT_LANES))
 
+    seg_inputs = ()
+    seg_specs_q = []
+    seg_specs_kv = []
+    if segmented:
+        from attention_tpu.ops.flash import segment_masks
+
+        q_rep, kv_rep = segment_masks(q_segment_ids, kv_segment_ids,
+                                      m_pad, n_pad)
+        seg_inputs = (q_rep, kv_rep)
+        seg_specs_q = [
+            pl.BlockSpec((block_q, _STAT_LANES), lambda hh, ii, jj: (ii, 0)),
+            pl.BlockSpec((8, block_k), lambda hh, ii, jj: (0, jj)),
+        ]
+        seg_specs_kv = [
+            pl.BlockSpec((block_q, _STAT_LANES), lambda jj, hh, ii: (ii, 0)),
+            pl.BlockSpec((8, block_k), lambda jj, hh, ii: (0, jj)),
+        ]
+
     num_i = m_pad // block_q
     num_j = n_pad // block_k
 
@@ -245,6 +287,7 @@ def flash_backward(
             scale=scale,
             out_dtype=q.dtype,
             compute_dtype=compute_dtype,
+            segmented=segmented,
         ),
         grid=(h, num_i, num_j),
         in_specs=[
@@ -254,6 +297,7 @@ def flash_backward(
             pl.BlockSpec((1, block_k, d), lambda hh, ii, jj: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_k, dv), lambda hh, ii, jj: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_q, dv), lambda hh, ii, jj: (hh, ii, 0)),
+            *seg_specs_q,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
         out_shape=jax.ShapeDtypeStruct((h, m_pad, d), q.dtype),
@@ -267,7 +311,7 @@ def flash_backward(
             transcendentals=h * m_pad * n_pad,
         ),
         interpret=interpret,
-    )(lse_rep, delta_rep, qs, k, v, do)[:, :m]
+    )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)[:, :m]
 
     stat_spec_kv = pl.BlockSpec(
         (1, block_q, _STAT_LANES), lambda jj, hh, ii: (hh, ii, 0)
@@ -280,6 +324,7 @@ def flash_backward(
             block_k=block_k,
             group=group,
             compute_dtype=compute_dtype,
+            segmented=segmented,
         ),
         grid=(num_j, h, num_i),
         in_specs=[
@@ -289,6 +334,7 @@ def flash_backward(
             pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_q, dv), lambda jj, hh, ii: (hh, ii, 0)),
+            *seg_specs_kv,
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
@@ -311,5 +357,5 @@ def flash_backward(
             transcendentals=h * m_pad * n_pad,
         ),
         interpret=interpret,
-    )(lse_rep, delta_rep, qs, k, v, do)
+    )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)
     return dq, dk[:, :n].astype(k.dtype), dvg[:, :n].astype(v.dtype)

@@ -46,15 +46,29 @@ def _gqa_expand(k, group):
     return jnp.repeat(k, group, axis=0) if group > 1 else k
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_diff(q, k, v, scale, causal, block_sizes, bwd_chunk, bwd_impl):
-    out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_diff(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
+                bwd_chunk, bwd_impl):
+    out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
+                             q_seg, kv_seg)
     return out
 
 
-def _flash_fwd_impl(q, k, v, scale, causal, block_sizes):
+def _seg_zeros(seg):
+    """float0 cotangent for an integer segment-id primal (None stays
+    None — an empty pytree's cotangent)."""
+    import numpy as np
+
+    if seg is None:
+        return None
+    return np.zeros(seg.shape, jax.dtypes.float0)
+
+
+def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
+                    kv_seg=None):
     out_un, row_max, row_sum = flash_attention_partials(
-        q, k, v, scale=scale, causal=causal, block_sizes=block_sizes
+        q, k, v, scale=scale, causal=causal, block_sizes=block_sizes,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
     )
     l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = (out_un / l_safe[..., None]).astype(q.dtype)
@@ -64,13 +78,16 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes):
     return out, lse
 
 
-def _flash_diff_fwd(q, k, v, scale, causal, block_sizes, bwd_chunk, bwd_impl):
-    out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes)
-    return out, (q, k, v, out, lse)
+def _flash_diff_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
+                    bwd_chunk, bwd_impl):
+    out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
+                               q_seg, kv_seg)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
-    q, k, v, out, lse = res
+    q, k, v, q_seg, kv_seg, out, lse = res
+    seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg))
     if bwd_impl == "pallas":
         from attention_tpu.ops.flash import _should_interpret
         from attention_tpu.ops.flash_bwd import flash_backward
@@ -79,7 +96,8 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
             q, k, v, out, lse, dout,
             scale=scale, causal=causal, block_sizes=block_sizes,
             interpret=_should_interpret(),
-        )
+            q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        ) + seg_cots
     h, m, dk = q.shape
     hkv, n, dv = v.shape
     group = h // hkv
@@ -96,23 +114,31 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
 
     chunk = min(bwd_chunk, m)
     pad = (-m) % chunk
+    # segment ids: -1 on padded q rows matches no (non-negative) kv id
+    qseg_arr = (jnp.full((m,), 0, jnp.int32) if q_seg is None
+                else jnp.asarray(q_seg, jnp.int32))
+    kvseg_arr = (jnp.full((n,), 0, jnp.int32) if kv_seg is None
+                 else jnp.asarray(kv_seg, jnp.int32))
     if pad:
         qp = jnp.pad(q32, ((0, 0), (0, pad), (0, 0)))
         dop = jnp.pad(dout32, ((0, 0), (0, pad), (0, 0)))
         lsep = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=NEG_INF)
         deltap = jnp.pad(delta, ((0, 0), (0, pad)))
+        qsegp = jnp.pad(qseg_arr, (0, pad), constant_values=-1)
     else:
-        qp, dop, lsep, deltap = q32, dout32, lse, delta
+        qp, dop, lsep, deltap, qsegp = q32, dout32, lse, delta, qseg_arr
     n_chunks = qp.shape[1] // chunk
     qc = qp.reshape(h, n_chunks, chunk, dk).transpose(1, 0, 2, 3)
     doc = dop.reshape(h, n_chunks, chunk, dv).transpose(1, 0, 2, 3)
     lsec = lsep.reshape(h, n_chunks, chunk).transpose(1, 0, 2)
     deltac = deltap.reshape(h, n_chunks, chunk).transpose(1, 0, 2)
+    qsegc = qsegp.reshape(n_chunks, chunk)
 
     row_base = jnp.arange(n_chunks) * chunk
+    segmented = q_seg is not None
 
     def one_chunk(args):
-        qi, doi, lsei, di, base = args  # (h, chunk, dk) etc.
+        qi, doi, lsei, di, base, qsegi = args  # (h, chunk, dk) etc.
         # Recompute P with the EXACT forward scores: the kernel folds
         # scale*log2(e) into Q and re-rounds to q.dtype
         # (flash.py::_flash_call), so the backward round-trips this
@@ -127,6 +153,8 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
             rows = base + jnp.arange(chunk)
             mask = jnp.arange(n)[None, :] <= rows[:, None]
             s = jnp.where(mask, s, NEG_INF)
+        if segmented:
+            s = jnp.where(qsegi[:, None] == kvseg_arr[None, :], s, NEG_INF)
         p = jnp.where(lsei[..., None] == NEG_INF, 0.0, jnp.exp(s - lsei[..., None]))
         dp = jnp.einsum("hqe,hne->hqn", doi, v32)
         ds = p * (dp - di[..., None])  # (h, chunk, n)
@@ -136,7 +164,7 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
         return dq_i, dk_i, dv_i
 
     dq_chunks, dk_parts, dv_parts = lax.map(
-        one_chunk, (qc, doc, lsec, deltac, row_base)
+        one_chunk, (qc, doc, lsec, deltac, row_base, qsegc)
     )
     dq = dq_chunks.transpose(1, 0, 2, 3).reshape(h, m + pad, dk)[:, :m]
     dk_full = jnp.sum(dk_parts, axis=0)  # (h, n, dk)
@@ -144,7 +172,8 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
     if group > 1:
         dk_full = dk_full.reshape(hkv, group, n, dk).sum(axis=1)
         dv_full = dv_full.reshape(hkv, group, n, dv).sum(axis=1)
-    return dq.astype(q.dtype), dk_full.astype(k.dtype), dv_full.astype(v.dtype)
+    return (dq.astype(q.dtype), dk_full.astype(k.dtype),
+            dv_full.astype(v.dtype)) + seg_cots
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -160,13 +189,18 @@ def flash_attention_diff(
     block_sizes: BlockSizes | None = None,
     bwd_chunk: int = 512,
     bwd_impl: str = "pallas",
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
 
     Forward = Pallas flash kernel; backward = Pallas backward kernels
     (``bwd_impl="pallas"``) or the blocked-XLA recompute
-    (``bwd_impl="xla"``), both from the saved log-sum-exp.
+    (``bwd_impl="xla"``), both from the saved log-sum-exp.  Segment ids
+    ((m,)/(n,) int32, shared across heads; 2D/3D inputs only) mask
+    attention across packed-sequence boundaries in both directions of
+    the VJP.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -176,19 +210,26 @@ def flash_attention_diff(
     # (256, 1024) and flash_backward to its own (512, 512) default — the
     # two kernels are tuned independently (see flash_bwd.py).
     bs = block_sizes
+    qseg, kvseg = q_segment_ids, kv_segment_ids
+    if qseg is not None and q.ndim == 4:
+        raise ValueError(
+            "segment ids support 2D/3D inputs (ids shared across heads)"
+        )
     if q.ndim == 2:
         return _flash_diff(
-            q[None], k[None], v[None], scale, causal, bs, bwd_chunk, bwd_impl
+            q[None], k[None], v[None], qseg, kvseg, scale, causal, bs,
+            bwd_chunk, bwd_impl,
         )[0]
     if q.ndim == 3:
-        return _flash_diff(q, k, v, scale, causal, bs, bwd_chunk, bwd_impl)
+        return _flash_diff(q, k, v, qseg, kvseg, scale, causal, bs,
+                           bwd_chunk, bwd_impl)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
         vf = v.reshape(b * v.shape[1], *v.shape[2:])
         out = _flash_diff(
-            q.reshape(b * hq, m, d), kf, vf, scale, causal, bs, bwd_chunk,
-            bwd_impl,
+            q.reshape(b * hq, m, d), kf, vf, None, None, scale, causal, bs,
+            bwd_chunk, bwd_impl,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")
