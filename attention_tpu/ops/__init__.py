@@ -1,3 +1,9 @@
 from attention_tpu.ops.reference import attention_xla  # noqa: F401
 from attention_tpu.ops.flash import flash_attention, flash_attention_partials  # noqa: F401
 from attention_tpu.ops.decode import flash_decode  # noqa: F401
+from attention_tpu.ops.quant import (  # noqa: F401
+    QuantizedKV,
+    flash_decode_quantized,
+    quantize_kv,
+    update_quantized_kv,
+)

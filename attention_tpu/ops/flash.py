@@ -343,7 +343,7 @@ def _flash_call(
     seg_inputs = ()
     if segmented:
         q_rep, kv_rep = segment_masks(q_segment_ids, kv_segment_ids,
-                                      m_pad, n_pad)
+                                      m, n, m_pad, n_pad)
         seg_inputs = (q_rep, kv_rep)
         in_specs += [
             pl.BlockSpec((block_q, _STAT_LANES),
@@ -409,22 +409,26 @@ def _no_stat_kernel(kernel, *args):
     kernel(*pre, o_ref, None, None, acc, m_scr, l_scr)
 
 
-def segment_masks(q_seg, kv_seg, m_pad: int, n_pad: int):
+def segment_masks(q_seg, kv_seg, m: int, n: int, m_pad: int, n_pad: int):
     """Mosaic-legal segment-id layouts for the flash kernels.
 
     A narrow (1, block) id vector violates the (8, 128) min-tile rule,
     so ids ship replicated: Q ids lane-replicated (m_pad, _STAT_LANES),
-    KV ids sublane-replicated (8, n_pad).  Padding gets id -1 (matches
+    KV ids sublane-replicated (8, n_pad).  Ids must match the TRUE
+    sequence lengths (m, n); only kernel padding gets id -1 (matches
     nothing; real ids are assumed non-negative).
     """
     q_seg = jnp.asarray(q_seg, jnp.int32)
     kv_seg = jnp.asarray(kv_seg, jnp.int32)
-    if q_seg.shape[0] != m_pad:
-        q_seg = jnp.pad(q_seg, (0, m_pad - q_seg.shape[0]),
-                        constant_values=-1)
-    if kv_seg.shape[0] != n_pad:
-        kv_seg = jnp.pad(kv_seg, (0, n_pad - kv_seg.shape[0]),
-                         constant_values=-1)
+    if q_seg.shape != (m,) or kv_seg.shape != (n,):
+        raise ValueError(
+            f"segment id shapes {q_seg.shape}/{kv_seg.shape} != "
+            f"({m},)/({n},)"
+        )
+    if m_pad != m:
+        q_seg = jnp.pad(q_seg, (0, m_pad - m), constant_values=-1)
+    if n_pad != n:
+        kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
     q_rep = jnp.broadcast_to(q_seg[:, None], (m_pad, _STAT_LANES))
     kv_rep = jnp.broadcast_to(kv_seg[None, :], (8, n_pad))
     return q_rep, kv_rep

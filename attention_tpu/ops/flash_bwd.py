@@ -261,7 +261,7 @@ def flash_backward(
         from attention_tpu.ops.flash import segment_masks
 
         q_rep, kv_rep = segment_masks(q_segment_ids, kv_segment_ids,
-                                      m_pad, n_pad)
+                                      m, n, m_pad, n_pad)
         seg_inputs = (q_rep, kv_rep)
         seg_specs_q = [
             pl.BlockSpec((block_q, _STAT_LANES), lambda hh, ii, jj: (ii, 0)),

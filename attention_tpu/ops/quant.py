@@ -1,0 +1,297 @@
+"""int8-quantized KV cache + fused quantized flash-decode kernel.
+
+Cuts the KV cache's HBM footprint to 0.63x bf16 (int8 values at 0.5x
+plus 32B/row of replicated fp32 scales against 128B/row saved) at
+decode speed parity — more context per chip for free accuracy-wise
+(~4e-4 output error measured at seq=32k).
+
+Quantization scheme: symmetric per-token absmax (one fp32 scale per
+cached row per head).  The kernel never dequantizes into (block_k, d)
+fp tiles via per-row multiplies: a per-token scale is a scalar on the
+contraction's token axis, so it commutes out of both matmuls —
+
+    scores = q · (K_q · s_K)ᵀ = (q · K_qᵀ) ∘ s_K     (row-vec, post-matmul)
+    out    = p · (V_q · s_V)  = (p ∘ s_V) · V_q       (folded into P)
+
+and the token axis lies along *lanes* of the score/probability tiles,
+so the scales apply as (1, block_k) row vectors — no narrow-block
+transposes.  Scales ship sublane-replicated (8, N) per (batch, kv head)
+(a (1, block_k) vector block would violate Mosaic's (8, 128) min-tile
+rule; the 8x replication costs 32B/row against the 224B/row saved).
+
+**Byte-planar int32 storage.**  The obvious int8 cache layout DMAs
+~10x slower than bf16 on the current Mosaic toolchain (measured: a
+DMA-only kernel over (block_k, d) int8 blocks runs ~12 ms where the
+same bytes as int32 run 0.9 ms), so quantized values are stored as
+int32 words holding 4 bytes each, with columns pre-permuted so that
+in-kernel sign-extending shifts yield four (block_k, d/4) planes whose
+lane-concatenation restores the original column order — no in-kernel
+byte interleave, no bitcast (Mosaic rejects bitwidth-changing
+bitcasts).  Unpack cost is a handful of VPU ops per tile; measured
+decode time is ~parity with bf16 at half the bytes.
+
+The reference's mixed-precision boundary (fp64 edges / fp32 compute +
+wire, `attention-mpi.c:31-101`) pushed one level further: bf16 compute,
+int8 storage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu.ops.decode import _pick_block_k
+from attention_tpu.ops.flash import (
+    _LOG2E,
+    _STAT_LANES,
+    NEG_INF,
+    _ceil_to,
+    _compiler_params,
+    _should_interpret,
+)
+
+
+class QuantizedKV(NamedTuple):
+    """int8 KV cache in byte-planar int32 words: values
+    (B, Hkv, N, d//4) int32 + per-token fp32 scales stored
+    sublane-replicated (B, Hkv, 8, N)."""
+
+    k_planar: jax.Array
+    k_scale: jax.Array
+    v_planar: jax.Array
+    v_scale: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.k_planar.shape[2]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k_planar.shape[3] * 4
+
+
+def _planar_perm(d: int) -> np.ndarray:
+    """Column permutation st. stored[..., 4w+i] = orig[..., i*(d//4)+w]:
+    byte-plane i of the packed words is exactly original columns
+    [i*d/4, (i+1)*d/4) — planes lane-concatenate back in order."""
+    d4 = d // 4
+    idx = np.empty(d, np.int64)
+    for w in range(d4):
+        for i in range(4):
+            idx[4 * w + i] = i * d4 + w
+    return idx
+
+
+def _pack_planar(q8: jax.Array) -> jax.Array:
+    """(..., N, d) int8 -> (..., N, d//4) int32 byte-planar words."""
+    d = q8.shape[-1]
+    if d % 4:
+        raise ValueError(f"head dim {d} must be a multiple of 4")
+    perm = q8[..., _planar_perm(d)]
+    grouped = perm.reshape(*perm.shape[:-1], d // 4, 4)
+    return jax.lax.bitcast_convert_type(grouped, jnp.int32)
+
+
+def _unpack_planar(w: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
+    """(rows, d//4) int32 words -> (rows, d) compute_dtype, original
+    column order (see `_planar_perm`).  Runs inside the kernel: four
+    sign-extending shifts + a lane concat."""
+    planes = [
+        ((w << (24 - 8 * i)) >> 24).astype(compute_dtype) for i in range(4)
+    ]
+    return jnp.concatenate(planes, axis=-1)
+
+
+def _quant_rows(x):
+    """Symmetric per-token absmax int8 -> (planar int32, (..., 8, N) scales)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)  # (..., N)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    scale_rep = jnp.broadcast_to(
+        scale[..., None, :], (*scale.shape[:-1], 8, scale.shape[-1])
+    )
+    return _pack_planar(q), scale_rep
+
+
+def quantize_kv(k: jax.Array, v: jax.Array) -> QuantizedKV:
+    """Quantize full (B, Hkv, N, d) K/V caches to the int8 cache format."""
+    k_p, k_s = _quant_rows(k)
+    v_p, v_s = _quant_rows(v)
+    return QuantizedKV(k_p, k_s, v_p, v_s)
+
+
+def update_quantized_kv(cache: QuantizedKV, k_new: jax.Array,
+                        v_new: jax.Array, index) -> QuantizedKV:
+    """Write S new rows (B, Hkv, S, d) at ``index`` (dynamic scalar).
+
+    Overflow (index + S > capacity) NaN-poisons the written scales —
+    dynamic_update_slice would otherwise clamp the start index and
+    silently destroy earlier rows (same contract as the bf16
+    ``KVCache`` path, models/attention_layer.py).
+    """
+    k_p, k_s = _quant_rows(k_new)
+    v_p, v_s = _quant_rows(v_new)
+    overflow = index + k_new.shape[2] > cache.capacity
+    k_s = jnp.where(overflow, jnp.nan, k_s)
+    v_s = jnp.where(overflow, jnp.nan, v_s)
+    zero = jnp.zeros((), jnp.int32)
+    return QuantizedKV(
+        jax.lax.dynamic_update_slice(cache.k_planar, k_p, (zero, zero, index, zero)),
+        jax.lax.dynamic_update_slice(cache.k_scale, k_s, (zero, zero, zero, index)),
+        jax.lax.dynamic_update_slice(cache.v_planar, v_p, (zero, zero, index, zero)),
+        jax.lax.dynamic_update_slice(cache.v_scale, v_s, (zero, zero, zero, index)),
+    )
+
+
+def _decode_q_kernel(
+    lens_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+    acc_scr, m_scr, l_scr,
+    *, hkv: int, block_k: int,
+):
+    """One (batch*kv-head, kv-block) grid step of int8-cache decode."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    valid = lens_ref[bh // hkv]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * block_k < valid)
+    def _tile():
+        q = q_ref[0]                       # (group_pad, d), log2-prescaled
+        kq = _unpack_planar(k_ref[0], q.dtype)      # (block_k, d)
+        s = jax.lax.dot_general(
+            q, kq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_scale = jnp.max(ks_ref[0], axis=0, keepdims=True)  # (1, block_k)
+        s = s * k_scale                     # dequant on the score tile
+        col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < valid, s, NEG_INF)
+
+        m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)
+        l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp2(m_prev - m_next))
+        p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp2(s - m_next))
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape
+        )
+        v_scale = jnp.max(vs_ref[0], axis=0, keepdims=True)  # (1, block_k)
+        pv = jax.lax.dot_general(
+            (p * v_scale).astype(jnp.bfloat16),   # dequant folded into P
+            _unpack_planar(v_ref[0], jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def flash_decode_quantized(
+    q: jax.Array,          # (B, H, d)
+    cache: QuantizedKV,    # byte-planar int8 caches + scales
+    lengths: jax.Array,    # (B,) int32 or scalar
+    *,
+    scale: float | None = None,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """softmax(q K[:len]^T * scale) V[:len] against an int8 cache."""
+    b, h, d = q.shape
+    bk_, hkv, n, d4 = cache.k_planar.shape
+    if bk_ != b or d4 * 4 != d or cache.v_planar.shape != (b, hkv, n, d4):
+        raise ValueError(
+            f"cache shapes inconsistent: Q{q.shape} K{cache.k_planar.shape} "
+            f"V{cache.v_planar.shape}"
+        )
+    if cache.k_scale.shape != (b, hkv, 8, n) or \
+            cache.v_scale.shape != (b, hkv, 8, n):
+        raise ValueError(
+            f"scale shapes {cache.k_scale.shape}/{cache.v_scale.shape} "
+            f"!= {(b, hkv, 8, n)}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(jnp.bfloat16)
+    qs = qs.reshape(b * hkv, group, d)
+    group_pad = _ceil_to(group, 16)
+    if group_pad != group:
+        qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
+
+    block_k = _pick_block_k(n, block_k)
+    kc = cache.k_planar.reshape(b * hkv, n, d4)
+    vc = cache.v_planar.reshape(b * hkv, n, d4)
+    ks = cache.k_scale.reshape(b * hkv, 8, n)
+    vs = cache.v_scale.reshape(b * hkv, 8, n)
+
+    def kv_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        last = jnp.maximum((valid + block_k - 1) // block_k - 1, 0)
+        return (bh, jnp.minimum(j, last), 0)
+
+    def scale_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        last = jnp.maximum((valid + block_k - 1) // block_k - 1, 0)
+        return (bh, 0, jnp.minimum(j, last))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n // block_k),
+        in_specs=[
+            pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d4), kv_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+            pl.BlockSpec((1, block_k, d4), kv_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, d), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_q_kernel, hkv=hkv, block_k=block_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, d), jnp.bfloat16),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * n * d,
+            bytes_accessed=(kc.size + vc.size + ks.size + vs.size) * 4
+            + qs.size * 2,
+            transcendentals=b * h * n,
+        ),
+        interpret=interpret,
+    )(lens, qs, kc, ks, vc, vs)
+
+    return out[:, :group].reshape(b, h, d)

@@ -1,0 +1,91 @@
+"""int8 KV-cache decode tests: quantization round-trip, kernel accuracy
+vs the fp oracle, incremental updates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.quant import (
+    QuantizedKV,
+    flash_decode_quantized,
+    quantize_kv,
+    update_quantized_kv,
+)
+
+
+def _caches(rng, b, hkv, n, d):
+    kc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    return jnp.asarray(kc), jnp.asarray(vc)
+
+
+def test_quantize_roundtrip_error_bounded(rng):
+    kc, vc = _caches(rng, 2, 2, 256, 64)
+    qkv = quantize_kv(kc, vc)
+    assert qkv.k_planar.dtype == jnp.int32
+    assert qkv.k_planar.shape == (2, 2, 256, 16)
+    assert qkv.k_scale.shape == (2, 2, 8, 256)
+    assert qkv.capacity == 256 and qkv.head_dim == 64
+    # unpack the planar words in numpy and check the round-trip bound:
+    # per-token absmax gives |x - deq(x)| <= scale/2 = amax/254
+    # (scale rows are identical across the 8 replicated sublanes)
+    words = np.asarray(qkv.k_planar).astype(np.int64)
+    planes = [((words << (24 - 8 * i)) % (1 << 32) + 0).astype(np.uint32)
+              for i in range(4)]
+    planes = [(p_.astype(np.int32) >> 24) for p_ in planes]
+    k_q = np.concatenate(planes, axis=-1)  # plane-concat = original order
+    scale = np.asarray(qkv.k_scale[:, :, 0, :])  # (b, hkv, n)
+    deq = k_q * scale[..., None]
+    amax = np.max(np.abs(np.asarray(kc)), axis=-1, keepdims=True)
+    assert np.all(np.abs(deq - np.asarray(kc)) <= amax / 254 + 1e-6)
+
+
+@pytest.mark.parametrize("h,hkv", [(4, 4), (8, 2)])
+def test_quantized_decode_close_to_fp(rng, h, hkv):
+    b, n, d = 2, 512, 64
+    kc, vc = _caches(rng, b, hkv, n, d)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    lens = jnp.asarray([512, 100], jnp.int32)
+    fp = np.asarray(flash_decode(q, kc, vc, lens, block_k=128))
+    qt = np.asarray(flash_decode_quantized(
+        q, quantize_kv(kc, vc), lens, block_k=128
+    ), np.float32)
+    # int8 per-token quantization inside the reference's ±0.02 contract
+    np.testing.assert_allclose(qt, fp, atol=0.02)
+
+
+def test_quantized_decode_empty_cache(rng):
+    kc, vc = _caches(rng, 1, 2, 128, 64)
+    q = jnp.asarray(rng.standard_normal((1, 2, 64)), jnp.float32)
+    out = flash_decode_quantized(q, quantize_kv(kc, vc), 0)
+    assert bool(jnp.all(out == 0.0))
+
+
+def test_incremental_update_matches_full_quantization(rng):
+    b, hkv, n, d = 1, 2, 256, 32
+    kc, vc = _caches(rng, b, hkv, n, d)
+    # quantize the first 100 rows, then append rows 100:103 incrementally
+    base = quantize_kv(kc.at[:, :, 100:].set(0.0), vc.at[:, :, 100:].set(0.0))
+    upd = update_quantized_kv(
+        base, kc[:, :, 100:103], vc[:, :, 100:103], jnp.asarray(100)
+    )
+    full = quantize_kv(kc.at[:, :, 103:].set(0.0), vc.at[:, :, 103:].set(0.0))
+    np.testing.assert_array_equal(np.asarray(upd.k_planar[:, :, :103]),
+                                  np.asarray(full.k_planar[:, :, :103]))
+    np.testing.assert_allclose(np.asarray(upd.k_scale[..., :103]),
+                               np.asarray(full.k_scale[..., :103]))
+    q = jnp.asarray(rng.standard_normal((b, hkv, d)), jnp.float32)
+    got = np.asarray(flash_decode_quantized(q, upd, 103, block_k=128),
+                     np.float32)
+    want = np.asarray(flash_decode(q, kc, vc, 103, block_k=128))
+    np.testing.assert_allclose(got, want, atol=0.02)
+
+
+def test_quantized_decode_shape_validation(rng):
+    kc, vc = _caches(rng, 1, 2, 128, 64)
+    qkv = quantize_kv(kc, vc)
+    q = jnp.zeros((1, 2, 32), jnp.float32)  # wrong d
+    with pytest.raises(ValueError, match="inconsistent"):
+        flash_decode_quantized(q, qkv, 10)
