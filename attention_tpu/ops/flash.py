@@ -214,26 +214,7 @@ def _flash_tile(
             mask = jnp.logical_and(mask, q_ids == kv_ids)
         s = jnp.where(mask, s, NEG_INF)
 
-    # Online-softmax update (the rmax/rsum recurrence of
-    # `online_softmax_attention`, attention-mpi.c:175-182).  Stats live
-    # lane-replicated in (block_q, 128) VMEM scratch; reduce them back to
-    # (block_q, 1) instead of lane-slicing.
-    m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)  # (bq, 1)
-    l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_next = jnp.maximum(m_prev, m_cur)
-    if masked:
-        # exp(old_max - new_max) rescale of the running accumulator
-        # (attention-mpi.c:179-181); the where-guards keep fully masked
-        # blocks/rows from producing NaN via exp2(-inf - -inf).
-        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp2(m_prev - m_next))
-        p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp2(s - m_next))
-    else:
-        # Unmasked: m_next is finite (a real row max), so exp2(-inf - m)
-        # underflows to 0 on its own — skip the two per-element selects.
-        corr = jnp.exp2(m_prev - m_next)
-        p = jnp.exp2(s - m_next)
-    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    p, corr = _online_softmax_update(s, m_scr, l_scr, masked=masked)
 
     pv = jax.lax.dot_general(
         p.astype(v_ref.dtype),
@@ -242,8 +223,34 @@ def _flash_tile(
         preferred_element_type=jnp.float32,
     )
     acc_scr[...] = acc_scr[...] * corr + pv
+
+
+def _online_softmax_update(s, m_scr, l_scr, *, masked):
+    """The rmax/rsum recurrence of `online_softmax_attention`
+    (attention-mpi.c:175-182), shared by the forward, decode, and
+    quantized-decode kernels.  Updates the lane-replicated (rows, 128)
+    m/l VMEM scratches in place from log2-domain scores ``s`` and
+    returns ``(p, corr)`` — the probability tile and the accumulator
+    rescale factor exp(old_max - new_max) (attention-mpi.c:179-181).
+    Stats are reduced back to (rows, 1) columns instead of lane-slicing.
+    """
+    m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)  # (rows, 1)
+    l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    if masked:
+        # the where-guards keep fully masked blocks/rows from producing
+        # NaN via exp2(-inf - -inf)
+        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp2(m_prev - m_next))
+        p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp2(s - m_next))
+    else:
+        # Unmasked: m_next is finite (a real row max), so exp2(-inf - m)
+        # underflows to 0 on its own — skip the two per-element selects.
+        corr = jnp.exp2(m_prev - m_next)
+        p = jnp.exp2(s - m_next)
+    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
     m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
     l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+    return p, corr
 
 
 def _flash_call(

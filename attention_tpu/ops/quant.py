@@ -53,6 +53,7 @@ from attention_tpu.ops.flash import (
     NEG_INF,
     _ceil_to,
     _compiler_params,
+    _online_softmax_update,
     _should_interpret,
 )
 
@@ -180,14 +181,7 @@ def _decode_q_kernel(
         col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(col < valid, s, NEG_INF)
 
-        m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)
-        l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
-        m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp2(m_prev - m_next))
-        p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp2(s - m_next))
-        l_scr[...] = jnp.broadcast_to(
-            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape
-        )
+        p, corr = _online_softmax_update(s, m_scr, l_scr, masked=True)
         v_scale = jnp.max(vs_ref[0], axis=0, keepdims=True)  # (1, block_k)
         pv = jax.lax.dot_general(
             (p * v_scale).astype(jnp.bfloat16),   # dequant folded into P
@@ -196,7 +190,6 @@ def _decode_q_kernel(
             preferred_element_type=jnp.float32,
         )
         acc_scr[...] = acc_scr[...] * corr + pv
-        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
 
     @pl.when(j == num_j - 1)
     def _finalize():

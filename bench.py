@@ -71,7 +71,7 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
 
 
 def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
-                    dim: int, repeats: int):
+                    dim: int, repeats: int, *, quantized: bool = False):
     """Per-step seconds of fused flash-decode at a full KV cache."""
     import jax
     import jax.numpy as jnp
@@ -84,6 +84,17 @@ def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
     kc = jax.random.normal(kk, (batch, kv_heads, cache_len, dim), jnp.bfloat16)
     vc = jax.random.normal(kv, (batch, kv_heads, cache_len, dim), jnp.bfloat16)
     lens = jnp.full((batch,), cache_len, jnp.int32)
+    if quantized:
+        from attention_tpu.ops.quant import (
+            flash_decode_quantized,
+            quantize_kv,
+        )
+
+        qkv = quantize_kv(kc, vc)
+        return benchmark_amortized(
+            lambda x, c, ll: flash_decode_quantized(x, c, ll).astype(x.dtype),
+            q, repeats=repeats, operands=(qkv, lens),
+        )
     return benchmark_amortized(
         lambda x, kcc, vcc, ll: flash_decode(x, kcc, vcc, ll),
         q, repeats=repeats, operands=(kc, vc, lens),
@@ -135,7 +146,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seq", type=int, default=32768)
     p.add_argument("--dim", type=int, default=128)
-    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument(
+        "--repeats", type=int, default=5,
+        help="amortized-slope timing repeats; the min fights the shared "
+        "chip's large run-to-run contention variance",
+    )
     p.add_argument("--block-q", type=int, default=256)
     p.add_argument("--block-k", type=int, default=1024)
     p.add_argument(
@@ -208,6 +223,14 @@ def main(argv=None) -> int:
             "ms": round(dec_s * 1e3, 3),
             "tokens_per_s": round(dec_b / dec_s, 1),
             "cache_read_gb_per_s": round(cache_bytes / dec_s / 1e9, 1),
+        }
+        dq_s = _bench_decode_s(dec_b, dec_h, dec_hkv, dec_len, dec_d,
+                               args.repeats, quantized=True)
+        ladder["decode_int8_cache32k"] = {
+            "ms": round(dq_s * 1e3, 3),
+            "tokens_per_s": round(dec_b / dq_s, 1),
+            # int8 values + 32B/row replicated fp32 scales vs bf16 values
+            "hbm_vs_bf16": round((dec_d + 32) / (2 * dec_d), 2),
         }
         result["detail"]["ladder"] = ladder
 
