@@ -55,7 +55,13 @@ class TransformerBlock(nn.Module):
 
 
 class TinyDecoder(nn.Module):
-    """Decoder-only LM: embed -> N blocks -> norm -> logits."""
+    """Decoder-only LM: embed -> N blocks -> norm -> logits.
+
+    ``remat=True`` rematerializes each block's activations in the
+    backward pass (`jax.checkpoint` via `nn.remat`) — the HBM-for-FLOPs
+    trade that lets long-sequence training fit; ignored on the cached
+    decode path (no backward there).
+    """
 
     vocab: int = 256
     dim: int = 256
@@ -64,19 +70,28 @@ class TinyDecoder(nn.Module):
     num_kv_heads: int = 2
     impl: str = "flash"
     dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
         head_dim = self.dim // self.num_q_heads
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
         new_caches = []
+        block_cls = (
+            nn.remat(TransformerBlock)
+            if self.remat and caches is None
+            else TransformerBlock
+        )
         for i in range(self.depth):
-            block = TransformerBlock(
+            # explicit name: keeps the param tree identical whether or
+            # not the block class is wrapped in nn.remat
+            block = block_cls(
                 num_q_heads=self.num_q_heads,
                 num_kv_heads=self.num_kv_heads,
                 head_dim=head_dim,
                 impl=self.impl,
                 dtype=self.dtype,
+                name=f"TransformerBlock_{i}",
             )
             if caches is None:
                 x = block(x)
