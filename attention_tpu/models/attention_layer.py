@@ -18,6 +18,12 @@ import jax.numpy as jnp
 from attention_tpu.ops.decode import flash_decode
 from attention_tpu.ops.flash import flash_attention
 from attention_tpu.ops.flash_vjp import flash_attention_diff
+from attention_tpu.ops.quant import (
+    QuantizedKV,
+    flash_decode_quantized,
+    quantize_kv,
+    update_quantized_kv,
+)
 from attention_tpu.ops.reference import attention_xla
 
 
@@ -42,6 +48,23 @@ class KVCache(NamedTuple):
             v=jnp.zeros(shape, dtype),
             length=jnp.zeros((), jnp.int32),
         )
+
+    def quantize(self) -> "QuantKVCache":
+        """One-shot int8 conversion (after prefill): 0.63x the HBM for
+        the rest of the decode loop; the bf16 arrays can then be freed."""
+        return QuantKVCache(kv=quantize_kv(self.k, self.v),
+                            length=self.length)
+
+
+class QuantKVCache(NamedTuple):
+    """int8 decode cache: byte-planar `QuantizedKV` + valid length.
+
+    Decode-only (S == 1 steps, ``impl='flash'``): the serving flow is
+    bf16 prefill -> :meth:`KVCache.quantize` -> int8 decode loop.
+    """
+
+    kv: QuantizedKV
+    length: jax.Array
 
 
 def _xla_mha(q, k, v, *, causal):
@@ -103,7 +126,8 @@ class GQASelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jax.Array, cache: KVCache | None = None):
+    def __call__(self, x: jax.Array,
+                 cache: "KVCache | QuantKVCache | None" = None):
         if self.num_q_heads % self.num_kv_heads != 0:
             raise ValueError(
                 f"q heads {self.num_q_heads} not a multiple of kv heads "
@@ -121,6 +145,8 @@ class GQASelfAttention(nn.Module):
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B, H, S, dh)
         if cache is None:
             out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal)
+        elif isinstance(cache, QuantKVCache):
+            out, cache = self._quantized_decode(q, k, v, cache)
         else:
             out, cache = self._cached_attention(q, k, v, cache)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
@@ -169,3 +195,23 @@ class GQASelfAttention(nn.Module):
         # loud instead — poison the output with NaN.
         out = jnp.where(new_len <= capacity, out, jnp.nan).astype(out.dtype)
         return out, KVCache(kc, vc, new_len)
+
+    def _quantized_decode(self, q, k, v, cache: QuantKVCache):
+        """One decode step against an int8 cache: quantize the new KV
+        row in, run the fused quantized kernel.  Decode-only — prefill
+        runs on the bf16 `KVCache`, then `KVCache.quantize()` converts."""
+        if q.shape[2] != 1:
+            raise ValueError(
+                "QuantKVCache supports single-token decode steps; prefill "
+                "on a bf16 KVCache, then .quantize() it"
+            )
+        if self.impl != "flash":
+            raise ValueError(
+                f"impl {self.impl!r} has no quantized-cache path "
+                "(supported: ['flash'])"
+            )
+        kv = update_quantized_kv(cache.kv, k, v, cache.length)
+        new_len = cache.length + 1
+        out = flash_decode_quantized(q[:, :, 0, :], kv, new_len)
+        # overflow already NaN-poisons via update_quantized_kv's scales
+        return out[:, :, None, :].astype(q.dtype), QuantKVCache(kv, new_len)

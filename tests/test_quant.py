@@ -89,3 +89,67 @@ def test_quantized_decode_shape_validation(rng):
     q = jnp.zeros((1, 2, 32), jnp.float32)  # wrong d
     with pytest.raises(ValueError, match="inconsistent"):
         flash_decode_quantized(q, qkv, 10)
+
+
+def test_model_int8_decode_close_to_fp(rng):
+    """Teacher-forced int8-cache decode tracks the bf16-cache logits."""
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=61, dim=64, depth=2, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, 61, (2, 9)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    fp_caches = model.init_caches(batch=2, capacity=128)
+    l_fp, fp_caches = model.apply({"params": params}, tokens[:, :5], fp_caches)
+    q_caches = tuple(c.quantize() for c in fp_caches)
+    for t in range(5, 9):
+        step = tokens[:, t : t + 1]
+        lf, fp_caches = model.apply({"params": params}, step, fp_caches)
+        lq, q_caches = model.apply({"params": params}, step, q_caches)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                                   atol=0.05, rtol=0.05)
+    assert int(q_caches[0].length) == 9
+
+
+def test_generate_int8_cache_runs_and_matches(rng):
+    from attention_tpu.models import TinyDecoder, generate
+
+    model = TinyDecoder(vocab=61, dim=64, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    fp = np.asarray(generate(model, params, prompt, steps=4))
+    q8 = np.asarray(generate(model, params, prompt, steps=4, int8_cache=True))
+    # greedy argmax over well-separated random logits: tokens match
+    np.testing.assert_array_equal(q8, fp)
+
+
+def test_quant_cache_rejects_prefill_and_xla(rng):
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, 31, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    caches = model.init_caches(batch=1, capacity=128)
+    _, caches = model.apply({"params": params}, tokens[:, :1], caches)
+    qcaches = tuple(c.quantize() for c in caches)
+    with pytest.raises(ValueError, match="single-token"):
+        model.apply({"params": params}, tokens[:, 1:4], qcaches)
+
+    xla_model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                            num_kv_heads=2, impl="xla", dtype=jnp.float32)
+    with pytest.raises(ValueError, match="quantized-cache"):
+        xla_model.apply({"params": params}, tokens[:, 1:2], qcaches)
+
+
+def test_generate_int8_rejects_xla_impl_up_front(rng):
+    from attention_tpu.models import TinyDecoder, generate
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="xla", dtype=jnp.float32)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="int8_cache requires"):
+        generate(model, params, prompt, steps=2, int8_cache=True)
