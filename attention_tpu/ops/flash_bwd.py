@@ -34,6 +34,12 @@ pre-scaled by scale·log2(e) and re-rounded to the input dtype, so scores
 are log2-domain and P = exp2(S₂ - lse₂) reproduces the forward's exact
 probabilities; dK picks up a ln2 factor (dK = ln2 · dSᵀ Q_scaled) and dQ
 the plain `scale` (contraction against unscaled K).
+
+Sliding-window note: the backward kernels handle ``window`` by masking
+plus per-tile skip guards over the full grid.  Skipped grid steps are
+not free (un-overlapped DMA latency — see the banded-grid fix in the
+forward kernel), so windowed backward wall-time does not yet shrink
+with the window; restructuring these grids into bands is future work.
 """
 
 from __future__ import annotations
@@ -62,7 +68,7 @@ def _stat_col(ref):
 
 
 def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
-                 q_seg_ref=None, kv_seg_ref=None):
+                 q_seg_ref=None, kv_seg_ref=None, window=None):
     """(block_q, block_k) probability tile, Q-major.
 
     ``qs`` is the forward's pre-scaled Q (scores come out log2-domain),
@@ -78,6 +84,8 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
         col = k_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
         # also guards rows the forward fully masked (lse == -inf)
         mask = jnp.logical_and(col <= row, lse_col != NEG_INF)
+        if window is not None:
+            mask = jnp.logical_and(mask, col >= row - (window - 1))
     if q_seg_ref is not None:
         q_ids = jnp.max(q_seg_ref[...], axis=-1, keepdims=True)
         kv_ids = jnp.max(kv_seg_ref[...], axis=0, keepdims=True)
@@ -91,6 +99,7 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
 def _dq_kernel(
     lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, scale, out_dtype, compute_dtype, segmented,
+    window,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
@@ -110,7 +119,7 @@ def _dq_kernel(
         p = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
-            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
+            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -124,9 +133,15 @@ def _dq_kernel(
 
     if causal:
         # KV tiles strictly above the diagonal are all zeros under the
-        # causal mask — skip them (halves causal backward FLOPs).
-        # Init/finalize stay outside the guard.
-        pl.when(k_base <= q_base + block_q - 1)(_compute)
+        # causal mask — skip them (halves causal backward FLOPs); under a
+        # sliding window also skip tiles wholly before the window start.
+        keep = k_base <= q_base + block_q - 1
+        if window is not None:
+            keep = jnp.logical_and(
+                keep,
+                k_base + block_k - 1 >= q_base - (window - 1),
+            )
+        pl.when(keep)(_compute)
     else:
         _compute()
 
@@ -137,7 +152,7 @@ def _dq_kernel(
 
 def _dkv_kernel(
     lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
-    causal, block_q, block_k, group, compute_dtype, segmented,
+    causal, block_q, block_k, group, compute_dtype, segmented, window,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
@@ -160,7 +175,7 @@ def _dkv_kernel(
         p = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
-            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
+            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
         )
         dv_scr[...] += jax.lax.dot_general(
             p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
@@ -177,8 +192,15 @@ def _dkv_kernel(
         )  # (block_k, d) = dSᵀ Q_scaled
     if causal:
         # Q tiles wholly above the diagonal contribute nothing to this
-        # KV block — skip them (halves causal backward FLOPs).
-        pl.when(k_base <= q_base + block_q - 1)(_compute)
+        # KV block — skip them (halves causal backward FLOPs); under a
+        # sliding window also skip Q tiles wholly past the window end.
+        keep = k_base <= q_base + block_q - 1
+        if window is not None:
+            keep = jnp.logical_and(
+                keep,
+                k_base + block_k - 1 >= q_base - (window - 1),
+            )
+        pl.when(keep)(_compute)
     else:
         _compute()
 
@@ -207,11 +229,14 @@ def flash_backward(
     interpret: bool = False,
     q_segment_ids=None,
     kv_segment_ids=None,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """dQ, dK, dV via the two Pallas backward kernels."""
     segmented = q_segment_ids is not None
     if segmented != (kv_segment_ids is not None):
         raise ValueError("q_segment_ids and kv_segment_ids go together")
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
     # Backward default pinned independently of the forward's (256, 1024):
     # scripts/bwd_sweep.py on the real chip put block_q=512 clearly ahead
     # of 256 for the combined dQ+dKdV pass (~2.2 ms vs ~4 ms at seq=8k,
@@ -288,6 +313,7 @@ def flash_backward(
             out_dtype=q.dtype,
             compute_dtype=compute_dtype,
             segmented=segmented,
+            window=window,
         ),
         grid=(h, num_i, num_j),
         in_specs=[
@@ -325,6 +351,7 @@ def flash_backward(
             group=group,
             compute_dtype=compute_dtype,
             segmented=segmented,
+            window=window,
         ),
         grid=(num_j, h, num_i),
         in_specs=[

@@ -46,11 +46,11 @@ def _gqa_expand(k, group):
     return jnp.repeat(k, group, axis=0) if group > 1 else k
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_diff(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                bwd_chunk, bwd_impl):
+                bwd_chunk, bwd_impl, window):
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                             q_seg, kv_seg)
+                             q_seg, kv_seg, window)
     return out
 
 
@@ -65,10 +65,10 @@ def _seg_zeros(seg):
 
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
-                    kv_seg=None):
+                    kv_seg=None, window=None):
     out_un, row_max, row_sum = flash_attention_partials(
         q, k, v, scale=scale, causal=causal, block_sizes=block_sizes,
-        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
     )
     l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = (out_un / l_safe[..., None]).astype(q.dtype)
@@ -79,13 +79,14 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
 
 
 def _flash_diff_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                    bwd_chunk, bwd_impl):
+                    bwd_chunk, bwd_impl, window):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                               q_seg, kv_seg)
+                               q_seg, kv_seg, window)
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
-def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
+def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
+                    window, res, dout):
     q, k, v, q_seg, kv_seg, out, lse = res
     seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg))
     if bwd_impl == "pallas":
@@ -96,7 +97,7 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
             q, k, v, out, lse, dout,
             scale=scale, causal=causal, block_sizes=block_sizes,
             interpret=_should_interpret(),
-            q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+            q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
         ) + seg_cots
     h, m, dk = q.shape
     hkv, n, dv = v.shape
@@ -152,6 +153,10 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl, res, dout):
         if causal:
             rows = base + jnp.arange(chunk)
             mask = jnp.arange(n)[None, :] <= rows[:, None]
+            if window is not None:
+                mask = jnp.logical_and(
+                    mask, jnp.arange(n)[None, :] >= rows[:, None] - (window - 1)
+                )
             s = jnp.where(mask, s, NEG_INF)
         if segmented:
             s = jnp.where(qsegi[:, None] == kvseg_arr[None, :], s, NEG_INF)
@@ -191,6 +196,7 @@ def flash_attention_diff(
     bwd_impl: str = "pallas",
     q_segment_ids=None,
     kv_segment_ids=None,
+    window: int | None = None,
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
@@ -218,18 +224,18 @@ def flash_attention_diff(
     if q.ndim == 2:
         return _flash_diff(
             q[None], k[None], v[None], qseg, kvseg, scale, causal, bs,
-            bwd_chunk, bwd_impl,
+            bwd_chunk, bwd_impl, window,
         )[0]
     if q.ndim == 3:
         return _flash_diff(q, k, v, qseg, kvseg, scale, causal, bs,
-                           bwd_chunk, bwd_impl)
+                           bwd_chunk, bwd_impl, window)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
         vf = v.reshape(b * v.shape[1], *v.shape[2:])
         out = _flash_diff(
             q.reshape(b * hq, m, d), kf, vf, None, None, scale, causal, bs,
-            bwd_chunk, bwd_impl,
+            bwd_chunk, bwd_impl, window,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")

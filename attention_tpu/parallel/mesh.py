@@ -38,6 +38,36 @@ def default_mesh(axis_name: str = "kv", devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def hybrid_mesh(inner_axis: str = "kv", outer_axis: str = "dp") -> Mesh:
+    """A 2D (outer, inner) mesh laid out so the inner axis rides ICI and
+    the outer axis rides DCN — the multi-host analog of the reference's
+    multi-node MPI world (nodes over ConnectX-5 fabric, ranks within a
+    node over shared memory; `README.md:85-89`, process-placement study
+    Q5).
+
+    On a multi-host (multi-process) runtime this uses
+    `mesh_utils.create_hybrid_device_mesh` so every inner-axis
+    collective (the two-phase pmax/psum softmax, ring ppermute) stays
+    on-slice; keep only low-frequency traffic (data-parallel gradient
+    psum) on the outer axis.  On a single host it degrades to
+    (1, n_devices) — same program, no DCN hops.
+    """
+    devices = jax.devices()
+    n_proc = getattr(jax, "process_count", lambda: 1)()
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+
+        per_proc = len(devices) // n_proc
+        # result shape = mesh_shape * dcn_mesh_shape elementwise:
+        # (1, per_proc) x (n_proc, 1) -> (n_proc, per_proc) matching
+        # (outer_axis, inner_axis)
+        dev_mesh = mesh_utils.create_hybrid_device_mesh(
+            (1, per_proc), (n_proc, 1), devices=devices
+        )
+        return Mesh(dev_mesh, (outer_axis, inner_axis))
+    return Mesh(np.asarray(devices).reshape(1, -1), (outer_axis, inner_axis))
+
+
 def choose_kv_placement(
     n: int,
     dk: int,

@@ -204,3 +204,12 @@ def test_bf16_kv_sharded_within_contract(rng):
         kv_sharded_attention(qb, kb, vb, block_sizes=BlockSizes(64, 64))
     ).astype(np.float64)
     assert np.max(np.abs(out - attention_oracle(q, k, v))) < 0.02
+
+
+def test_hybrid_mesh_single_host_shape():
+    from attention_tpu.parallel.mesh import hybrid_mesh
+
+    mesh = hybrid_mesh(inner_axis="kv", outer_axis="dp")
+    assert mesh.axis_names == ("dp", "kv")
+    assert mesh.shape["dp"] == 1
+    assert mesh.shape["kv"] == len(jax.devices())
