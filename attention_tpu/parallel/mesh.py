@@ -61,8 +61,12 @@ def hybrid_mesh(inner_axis: str = "kv", outer_axis: str = "dp") -> Mesh:
         # result shape = mesh_shape * dcn_mesh_shape elementwise:
         # (1, per_proc) x (n_proc, 1) -> (n_proc, per_proc) matching
         # (outer_axis, inner_axis)
+        # process_is_granule: DCN granules are hosts (matching n_proc),
+        # not ICI slices — a multi-host single-slice pod has 1 slice but
+        # n_proc hosts, and the default slice grouping would raise.
         dev_mesh = mesh_utils.create_hybrid_device_mesh(
-            (1, per_proc), (n_proc, 1), devices=devices
+            (1, per_proc), (n_proc, 1), devices=devices,
+            process_is_granule=True,
         )
         return Mesh(dev_mesh, (outer_axis, inner_axis))
     return Mesh(np.asarray(devices).reshape(1, -1), (outer_axis, inner_axis))

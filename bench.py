@@ -38,12 +38,13 @@ import time
 
 def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
                    block_k: int, *, heads: int | None = None,
-                   kv_heads: int | None = None, n_short: int = 4,
-                   n_long: int = 20):
+                   kv_heads: int | None = None, window: int | None = None,
+                   n_short: int = 4, n_long: int = 20):
     """Per-call seconds of the fused flash kernel at (seq, dim), bf16.
 
     ``heads``/``kv_heads`` switch to multi-head (h, seq, dim) inputs
-    (GQA when kv_heads < heads).  Shared by bench.py (headline) and
+    (GQA when kv_heads < heads); ``window`` benchmarks causal
+    sliding-window attention.  Shared by bench.py (headline) and
     scripts/kernel_sweep.py so both use one timing method and one input
     recipe.
     """
@@ -61,7 +62,10 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
     v = jax.random.normal(kv, kvshape, jnp.bfloat16)
     bs = BlockSizes(block_q, block_k)
     return benchmark_amortized(
-        lambda x, kk, vv: flash_attention(x, kk, vv, block_sizes=bs),
+        lambda x, kk, vv: flash_attention(
+            x, kk, vv, block_sizes=bs, causal=window is not None,
+            window=window,
+        ),
         q,
         repeats=repeats,
         n_short=n_short,
@@ -214,6 +218,15 @@ def main(argv=None) -> int:
                 "gflops": round(fl / s / 1e9, 1),
                 "util": round(fl / s / peak_flops(), 4),
             }
+        # sliding-window config: banded grid, cost ~ window not sequence
+        w_s = _bench_flash_s(32768, 128, args.repeats, args.block_q,
+                             args.block_k, window=1024, n_short=4,
+                             n_long=32)
+        w_fl = 2 * 32768 * (1024 + args.block_q) * (128 + 128)
+        ladder["swa_w1024_32k"] = {
+            "ms": round(w_s * 1e3, 3),
+            "gflops": round(w_fl / w_s / 1e9, 1),
+        }
         # fixed config (name encodes it) — independent of --dim/--seq
         dec_b, dec_h, dec_hkv, dec_len, dec_d = 8, 32, 4, 32768, 128
         dec_s = _bench_decode_s(dec_b, dec_h, dec_hkv, dec_len, dec_d,
