@@ -67,7 +67,7 @@ class QuantKVCache(NamedTuple):
     length: jax.Array
 
 
-def _xla_mha(q, k, v, *, causal):
+def _xla_mha(q, k, v, *, causal, window=None):
     """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
     and auto-partitionable by XLA under pjit shardings."""
     if not causal:
@@ -78,14 +78,15 @@ def _xla_mha(q, k, v, *, causal):
         return attention_xla(q, k, v)
     # causal = the start=0, fully-valid instance of the cached mask
     return _xla_cached_attention(q, k, v, start=0, new_len=k.shape[2],
-                                 causal=True)
+                                 causal=True, window=window)
 
 
-def _flash_mha(q, k, v, *, causal):
-    return flash_attention_diff(q, k, v, causal=causal)
+def _flash_mha(q, k, v, *, causal, window=None):
+    return flash_attention_diff(q, k, v, causal=causal, window=window)
 
 
-def _xla_cached_attention(q, kc, vc, *, start, new_len, causal):
+def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
+                          window=None):
     """Dense cached attention over (B, H, S, dh) vs full-capacity caches
     (B, Hkv, N, dh), masked to the valid prefix.  Pure einsums — XLA
     auto-partitions it under pjit shardings, the serving analog of
@@ -102,6 +103,8 @@ def _xla_cached_attention(q, kc, vc, *, start, new_len, causal):
     if causal:
         row = jnp.arange(q.shape[2])[:, None]
         mask = jnp.logical_and(mask, col <= row + start)
+        if window is not None:
+            mask = jnp.logical_and(mask, col >= row + start - (window - 1))
     s = jnp.where(mask, s * scale, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
     return jnp.einsum("bhmn,bhnd->bhmd", p, vc)
@@ -124,6 +127,7 @@ class GQASelfAttention(nn.Module):
     impl: str = "flash"
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
+    window: int | None = None  # sliding-window attention (requires causal)
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -143,8 +147,14 @@ class GQASelfAttention(nn.Module):
         k = dense("k_proj", self.num_kv_heads)(x)
         v = dense("v_proj", self.num_kv_heads)(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B, H, S, dh)
+        if self.window is not None:
+            if not self.causal:
+                raise ValueError("window requires causal=True")
+            if self.window < 1:
+                raise ValueError(f"window must be >= 1, got {self.window}")
         if cache is None:
-            out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal)
+            out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,
+                                        window=self.window)
         elif isinstance(cache, QuantKVCache):
             out, cache = self._quantized_decode(q, k, v, cache)
         else:
@@ -181,14 +191,17 @@ class GQASelfAttention(nn.Module):
         if self.impl == "xla":
             out = _xla_cached_attention(
                 q, kc, vc, start=cache.length, new_len=new_len,
-                causal=self.causal,
+                causal=self.causal, window=self.window,
             )
-        elif s_new == 1:
+        elif s_new == 1 and self.window is None:
             out = flash_decode(q[:, :, 0, :], kc, vc, new_len)[:, :, None, :]
         else:
+            # windowed decode steps also take this path: the banded flash
+            # kernel applies the window over the cache (a rolling-buffer
+            # cache that frees out-of-window rows is future work)
             out = flash_attention(
                 q, kc, vc, causal=self.causal,
-                q_offset=cache.length, kv_valid=new_len,
+                q_offset=cache.length, kv_valid=new_len, window=self.window,
             )
         # Overflowing the cache would silently clamp the write index
         # (dynamic_update_slice semantics) and corrupt attention; make it
@@ -209,6 +222,10 @@ class GQASelfAttention(nn.Module):
             raise ValueError(
                 f"impl {self.impl!r} has no quantized-cache path "
                 "(supported: ['flash'])"
+            )
+        if self.window is not None:
+            raise ValueError(
+                "sliding-window decode is not supported on the int8 cache"
             )
         kv = update_quantized_kv(cache.kv, k, v, cache.length)
         new_len = cache.length + 1

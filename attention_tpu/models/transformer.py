@@ -34,6 +34,7 @@ class TransformerBlock(nn.Module):
     impl: str = "flash"
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
+    window: int | None = None
 
     @nn.compact
     def __call__(self, x, cache=None):
@@ -45,6 +46,7 @@ class TransformerBlock(nn.Module):
             impl=self.impl,
             causal=self.causal,
             dtype=self.dtype,
+            window=self.window,
         )(y, cache)
         if cache is not None:
             attn_out, cache = attn_out
@@ -71,6 +73,7 @@ class TinyDecoder(nn.Module):
     impl: str = "flash"
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    window: int | None = None  # sliding-window attention in every block
 
     @nn.compact
     def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
@@ -91,6 +94,7 @@ class TinyDecoder(nn.Module):
                 head_dim=head_dim,
                 impl=self.impl,
                 dtype=self.dtype,
+                window=self.window,
                 name=f"TransformerBlock_{i}",
             )
             if caches is None:

@@ -106,3 +106,69 @@ def test_window_composes_with_segments(rng):
     a = _dense_swa(q[:100], q[:100], q[:100], 1.0 / d**0.5, w)
     b = _dense_swa(q[100:], q[100:], q[100:], 1.0 / d**0.5, w)
     np.testing.assert_allclose(got, np.concatenate([a, b]), atol=2e-5)
+
+def test_windowed_model_flash_matches_xla(rng):
+    """Both impls of the windowed model family agree (full forward)."""
+    from attention_tpu.models import TinyDecoder
+
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 48)), jnp.int32)
+    kwargs = dict(vocab=31, dim=32, depth=1, num_q_heads=4, num_kv_heads=2,
+                  dtype=jnp.float32, window=16)
+    mf = TinyDecoder(impl="flash", **kwargs)
+    mx = TinyDecoder(impl="xla", **kwargs)
+    params = mf.init(jax.random.PRNGKey(0), tokens)["params"]
+    lf = mf.apply({"params": params}, tokens)
+    lx = mx.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lx),
+                               atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("impl", ["flash", "xla"])
+def test_windowed_cached_decode_matches_forward(rng, impl):
+    """Teacher-forced windowed decode == windowed full forward."""
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl=impl, dtype=jnp.float32,
+                        window=8)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 20)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = model.apply({"params": params}, tokens)
+
+    caches = model.init_caches(batch=2, capacity=128)
+    stepwise = []
+    for t in range(tokens.shape[1]):
+        logits, caches = model.apply(
+            {"params": params}, tokens[:, t : t + 1], caches
+        )
+        stepwise.append(logits[:, 0])
+    got = jnp.stack(stepwise, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_windowed_model_rejects_int8_cache(rng):
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=8)
+    tokens = jnp.asarray(rng.integers(0, 31, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    caches = model.init_caches(batch=1, capacity=128)
+    _, caches = model.apply({"params": params}, tokens[:, :1], caches)
+    qcaches = tuple(c.quantize() for c in caches)
+    with pytest.raises(ValueError, match="sliding-window decode"):
+        model.apply({"params": params}, tokens[:, 1:2], qcaches)
+
+
+@pytest.mark.parametrize("impl", ["flash", "xla"])
+def test_windowed_model_rejects_bad_window(rng, impl):
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl=impl, dtype=jnp.float32,
+                        window=0)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="window must be"):
+        model.init(jax.random.PRNGKey(0), tokens)
