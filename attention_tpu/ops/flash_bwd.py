@@ -35,11 +35,11 @@ are log2-domain and P = exp2(S₂ - lse₂) reproduces the forward's exact
 probabilities; dK picks up a ln2 factor (dK = ln2 · dSᵀ Q_scaled) and dQ
 the plain `scale` (contraction against unscaled K).
 
-Sliding-window note: the backward kernels handle ``window`` by masking
-plus per-tile skip guards over the full grid.  Skipped grid steps are
-not free (un-overlapped DMA latency — see the banded-grid fix in the
-forward kernel), so windowed backward wall-time does not yet shrink
-with the window; restructuring these grids into bands is future work.
+Sliding-window note: like the forward kernel, windowed backward uses
+banded grids — the dQ kernel's KV sweep and the dK/dV kernel's Q sweep
+cover only the blocks the window can touch (skipped grid steps are not
+free: they pay un-overlapped DMA latency), so windowed backward
+wall-time scales with the window, not the sequence.
 """
 
 from __future__ import annotations
@@ -99,18 +99,24 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
 def _dq_kernel(
     lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, scale, out_dtype, compute_dtype, segmented,
-    window,
+    window, n_j_total,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
     else:
         q_seg_ref = kv_seg_ref = None
     dq_ref, acc_scr = rest
-    j = pl.program_id(2)
+    jb = pl.program_id(2)
     q_base = pl.program_id(1) * block_q
+    if window is None:
+        j = jb
+    else:
+        # banded grid (mirrors the forward kernel): skipped grid steps
+        # are not free, so the j dimension covers only the window band
+        j = jnp.maximum((q_base - (window - 1)) // block_k, 0) + jb
     k_base = j * block_k
 
-    @pl.when(j == 0)
+    @pl.when(jb == 0)
     def _init():
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
@@ -133,19 +139,16 @@ def _dq_kernel(
 
     if causal:
         # KV tiles strictly above the diagonal are all zeros under the
-        # causal mask — skip them (halves causal backward FLOPs); under a
-        # sliding window also skip tiles wholly before the window start.
+        # causal mask — skip them (halves causal backward FLOPs); the
+        # banded window grid can also run past the last real KV block.
         keep = k_base <= q_base + block_q - 1
         if window is not None:
-            keep = jnp.logical_and(
-                keep,
-                k_base + block_k - 1 >= q_base - (window - 1),
-            )
+            keep = jnp.logical_and(keep, j < n_j_total)
         pl.when(keep)(_compute)
     else:
         _compute()
 
-    @pl.when(j == pl.num_programs(2) - 1)
+    @pl.when(jb == pl.num_programs(2) - 1)
     def _finalize():
         dq_ref[0] = (acc_scr[...] * scale).astype(out_dtype)
 
@@ -153,6 +156,7 @@ def _dq_kernel(
 def _dkv_kernel(
     lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, group, compute_dtype, segmented, window,
+    n_i_total,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
@@ -160,12 +164,18 @@ def _dkv_kernel(
         q_seg_ref = kv_seg_ref = None
     dk_ref, dv_ref, dk_scr, dv_scr = rest
     h = pl.program_id(1)
-    i = pl.program_id(2)
+    ib = pl.program_id(2)
     h_in_group = jax.lax.rem(h, group)
-    q_base = i * block_q
     k_base = pl.program_id(0) * block_k
+    if window is None:
+        i = ib
+    else:
+        # banded: only q blocks within [diagonal, diagonal + window)
+        # contribute to this kv block
+        i = k_base // block_q + ib
+    q_base = i * block_q
 
-    @pl.when(jnp.logical_and(h_in_group == 0, i == 0))
+    @pl.when(jnp.logical_and(h_in_group == 0, ib == 0))
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -192,13 +202,15 @@ def _dkv_kernel(
         )  # (block_k, d) = dSᵀ Q_scaled
     if causal:
         # Q tiles wholly above the diagonal contribute nothing to this
-        # KV block — skip them (halves causal backward FLOPs); under a
-        # sliding window also skip Q tiles wholly past the window end.
+        # KV block — skip them (halves causal backward FLOPs); the
+        # banded window grid can also run past the last real Q block.
         keep = k_base <= q_base + block_q - 1
         if window is not None:
+            # band_i overestimates by one tile when block_k % block_q
+            # == 0: also skip q tiles wholly past the window end
+            keep = jnp.logical_and(keep, i < n_i_total)
             keep = jnp.logical_and(
-                keep,
-                k_base + block_k - 1 >= q_base - (window - 1),
+                keep, q_base - (window - 1) <= k_base + block_k - 1
             )
         pl.when(keep)(_compute)
     else:
@@ -206,7 +218,7 @@ def _dkv_kernel(
 
     @pl.when(
         jnp.logical_and(
-            h_in_group == group - 1, i == pl.num_programs(2) - 1
+            h_in_group == group - 1, ib == pl.num_programs(2) - 1
         )
     )
     def _finalize():
@@ -279,6 +291,36 @@ def flash_backward(
     lse_rep = jnp.broadcast_to(lse2[..., None], (h, m_pad, _STAT_LANES))
     delta_rep = jnp.broadcast_to(delta[..., None], (h, m_pad, _STAT_LANES))
 
+    num_i = m_pad // block_q
+    num_j = n_pad // block_k
+    if window is None:
+        band_j = num_j
+        band_i = num_i
+    else:
+        # banded grids: the inner sweep covers only blocks the window
+        # can touch (see the forward kernel's banded-grid note)
+        band_j = min(num_j, -(-(window - 1 + block_q) // block_k) + 1)
+        band_i = min(num_i, (block_k - 1 + window - 1) // block_q + 2)
+
+    def j_abs(ii, jj):
+        # clamp band-tail steps to the last block the row actually
+        # computes (its causal diagonal), so their DMAs elide instead of
+        # fetching a never-used block
+        if window is None:
+            return jj
+        base = jnp.maximum((ii * block_q - (window - 1)) // block_k, 0)
+        causal_last = (ii * block_q + block_q - 1) // block_k
+        return jnp.minimum(base + jj,
+                           jnp.minimum(causal_last, num_j - 1))
+
+    def i_abs(jj, ii):
+        # clamp to the last q block inside this kv block's window span
+        if window is None:
+            return ii
+        win_last = (jj * block_k + block_k - 1 + window - 1) // block_q
+        return jnp.minimum(jj * block_k // block_q + ii,
+                           jnp.minimum(win_last, num_i - 1))
+
     seg_inputs = ()
     seg_specs_q = []
     seg_specs_kv = []
@@ -290,15 +332,14 @@ def flash_backward(
         seg_inputs = (q_rep, kv_rep)
         seg_specs_q = [
             pl.BlockSpec((block_q, _STAT_LANES), lambda hh, ii, jj: (ii, 0)),
-            pl.BlockSpec((8, block_k), lambda hh, ii, jj: (0, jj)),
+            pl.BlockSpec((8, block_k),
+                         lambda hh, ii, jj: (0, j_abs(ii, jj))),
         ]
         seg_specs_kv = [
-            pl.BlockSpec((block_q, _STAT_LANES), lambda jj, hh, ii: (ii, 0)),
+            pl.BlockSpec((block_q, _STAT_LANES),
+                         lambda jj, hh, ii: (i_abs(jj, ii), 0)),
             pl.BlockSpec((8, block_k), lambda jj, hh, ii: (0, jj)),
         ]
-
-    num_i = m_pad // block_q
-    num_j = n_pad // block_k
 
     stat_spec_q = pl.BlockSpec(
         (1, block_q, _STAT_LANES), lambda hh, ii, jj: (hh, ii, 0)
@@ -314,14 +355,17 @@ def flash_backward(
             compute_dtype=compute_dtype,
             segmented=segmented,
             window=window,
+            n_j_total=num_j,
         ),
-        grid=(h, num_i, num_j),
+        grid=(h, num_i, band_j),
         in_specs=[
             stat_spec_q,
             stat_spec_q,
             pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
-            pl.BlockSpec((1, block_k, d), lambda hh, ii, jj: (hh // group, jj, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda hh, ii, jj: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda hh, ii, jj: (hh // group, j_abs(ii, jj), 0)),
+            pl.BlockSpec((1, block_k, dv),
+                         lambda hh, ii, jj: (hh // group, j_abs(ii, jj), 0)),
             pl.BlockSpec((1, block_q, dv), lambda hh, ii, jj: (hh, ii, 0)),
             *seg_specs_q,
         ],
@@ -330,17 +374,17 @@ def flash_backward(
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
-            flops=6 * h * m_pad * n_pad * d,
+            flops=6 * h * m_pad * (band_j * block_k) * d,
             bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
             + h * (k.size + v.size) // hkv * k.dtype.itemsize
             + h * m_pad * d * qs.dtype.itemsize,
-            transcendentals=h * m_pad * n_pad,
+            transcendentals=h * m_pad * (band_j * block_k),
         ),
         interpret=interpret,
     )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)[:, :m]
 
     stat_spec_kv = pl.BlockSpec(
-        (1, block_q, _STAT_LANES), lambda jj, hh, ii: (hh, ii, 0)
+        (1, block_q, _STAT_LANES), lambda jj, hh, ii: (hh, i_abs(jj, ii), 0)
     )
     dk, dvg = pl.pallas_call(
         functools.partial(
@@ -352,15 +396,18 @@ def flash_backward(
             compute_dtype=compute_dtype,
             segmented=segmented,
             window=window,
+            n_i_total=num_i,
         ),
-        grid=(num_j, h, num_i),
+        grid=(num_j, h, band_i),
         in_specs=[
             stat_spec_kv,
             stat_spec_kv,
-            pl.BlockSpec((1, block_q, d), lambda jj, hh, ii: (hh, ii, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda jj, hh, ii: (hh, i_abs(jj, ii), 0)),
             pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
-            pl.BlockSpec((1, block_q, dv), lambda jj, hh, ii: (hh, ii, 0)),
+            pl.BlockSpec((1, block_q, dv),
+                         lambda jj, hh, ii: (hh, i_abs(jj, ii), 0)),
             *seg_specs_kv,
         ],
         out_specs=[
@@ -377,11 +424,11 @@ def flash_backward(
         ],
         compiler_params=_compiler_params(("parallel", "arbitrary", "arbitrary")),
         cost_estimate=pl.CostEstimate(
-            flops=8 * h * m_pad * n_pad * d,
+            flops=8 * h * (band_i * block_q) * n_pad * d,
             bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
             + h * (k.size + v.size) // hkv * k.dtype.itemsize
             + (n_pad * (d + dv)) * hkv * 4,
-            transcendentals=h * m_pad * n_pad,
+            transcendentals=h * (band_i * block_q) * n_pad,
         ),
         interpret=interpret,
     )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)

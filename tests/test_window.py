@@ -172,3 +172,37 @@ def test_windowed_model_rejects_bad_window(rng, impl):
     tokens = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="window must be"):
         model.init(jax.random.PRNGKey(0), tokens)
+
+
+@pytest.mark.parametrize("window", [30, 200])
+def test_window_grads_multiblock_banded(rng, window):
+    """Exercise the banded backward grids with nontrivial band offsets:
+    m large enough for many blocks at small BlockSizes."""
+    from attention_tpu.ops.flash import BlockSizes
+
+    h, m, d = 1, 1280, 32
+    q = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    wt = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    bs = BlockSizes(128, 128)
+
+    def flash_loss(q, k, v):
+        out = flash_attention_diff(q, k, v, causal=True, window=window,
+                                   block_sizes=bs)
+        return jnp.sum(out * wt)
+
+    def dense_loss(q, k, v):
+        s = jnp.einsum("hmd,hnd->hmn", q, k) / d**0.5
+        row = jnp.arange(m)[:, None]
+        col = jnp.arange(m)[None, :]
+        mask = jnp.logical_and(col <= row, col >= row - (window - 1))
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("hmn,hnd->hmd", p, v) * wt)
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "dq dk dv".split()):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   atol=3e-4, rtol=1e-3, err_msg=name)
