@@ -2,6 +2,7 @@ from attention_tpu.models.attention_layer import (  # noqa: F401
     GQASelfAttention,
     KVCache,
     QuantKVCache,
+    RollingKVCache,
 )
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
 from attention_tpu.models.decode import decode_step, generate, prefill  # noqa: F401

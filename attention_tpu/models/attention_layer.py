@@ -67,6 +67,47 @@ class QuantKVCache(NamedTuple):
     length: jax.Array
 
 
+class RollingKVCache(NamedTuple):
+    """Ring-buffer cache for sliding-window models: capacity is the
+    window (rounded up to 128), NOT the sequence length, so decode
+    memory is bounded however long generation runs.
+
+    Correctness rests on softmax being permutation-invariant over KV
+    rows: slots hold the last ``capacity`` tokens in wrapped order, and
+    the decode kernel attends over every valid slot without caring
+    about their order.  The effective window is the capacity (the
+    requested window rounded up to the 128-slot granule).
+
+    ``length`` counts total tokens seen (it keeps growing past
+    capacity; the slot for the next token is ``length % capacity``).
+    """
+
+    k: jax.Array  # (B, Hkv, C, dh)
+    v: jax.Array
+    length: jax.Array
+
+    @classmethod
+    def create(cls, batch: int, num_kv_heads: int, window: int,
+               head_dim: int, dtype=jnp.bfloat16) -> "RollingKVCache":
+        if window % 128:
+            raise ValueError(
+                f"rolling caches require window % 128 == 0 (got {window}): "
+                "a rounded-up capacity would give prefill and decode "
+                "different effective windows"
+            )
+        cap = window
+        shape = (batch, num_kv_heads, cap, head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+
 def _xla_mha(q, k, v, *, causal, window=None):
     """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
     and auto-partitionable by XLA under pjit shardings."""
@@ -157,6 +198,8 @@ class GQASelfAttention(nn.Module):
                                         window=self.window)
         elif isinstance(cache, QuantKVCache):
             out, cache = self._quantized_decode(q, k, v, cache)
+        elif isinstance(cache, RollingKVCache):
+            out, cache = self._rolling_attention(q, k, v, cache)
         else:
             out, cache = self._cached_attention(q, k, v, cache)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
@@ -208,6 +251,70 @@ class GQASelfAttention(nn.Module):
         # loud instead — poison the output with NaN.
         out = jnp.where(new_len <= capacity, out, jnp.nan).astype(out.dtype)
         return out, KVCache(kc, vc, new_len)
+
+    def _rolling_attention(self, q, k, v, cache: RollingKVCache):
+        """Bounded-memory sliding-window serving on the ring buffer.
+
+        S == 1 (decode): write the new row at ``length % capacity`` and
+        attend over the valid slots with the fused decode kernel (slot
+        order is irrelevant to softmax).  S > 1 (prefill) assumes a
+        FRESH cache: the chunk attends only to itself (causal +
+        window), and its last ``capacity`` rows seed the buffer.
+        """
+        if self.impl != "flash":
+            raise ValueError(
+                f"impl {self.impl!r} has no rolling-cache path "
+                "(supported: ['flash'])"
+            )
+        if self.window is None:
+            raise ValueError("RollingKVCache requires a windowed model")
+        cap = cache.capacity
+        if cap != self.window:
+            raise ValueError(
+                f"rolling capacity {cap} != window {self.window}"
+            )
+        s_new = q.shape[2]
+        if s_new == 1:
+            slot = jnp.mod(cache.length, cap)
+            kc = jax.lax.dynamic_update_slice(
+                cache.k, k.astype(cache.k.dtype), (0, 0, slot, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                cache.v, v.astype(cache.v.dtype), (0, 0, slot, 0)
+            )
+            valid = jnp.minimum(cache.length + 1, cap)
+            out = flash_decode(q[:, :, 0, :], kc, vc, valid)[:, :, None, :]
+        else:
+            # fresh-cache prefill: the chunk sees only itself.  A
+            # non-fresh cache would silently drop in-window history, so
+            # poison that case loudly (the convention of this module).
+            out = flash_attention(q, k, v, causal=True, window=self.window)
+            out = jnp.where(cache.length == 0, out, jnp.nan).astype(out.dtype)
+            keep = min(s_new, cap)
+            # rows land rotated so the invariant 'next slot = length %
+            # cap' holds: token j sits at slot j % cap.  split is static
+            # (fresh cache), giving 1-2 contiguous dynamic_update_slice
+            # writes instead of a TPU-hostile index-array scatter.
+            rows_k = k[:, :, -keep:].astype(cache.k.dtype)
+            rows_v = v[:, :, -keep:].astype(cache.v.dtype)
+            split = (s_new - keep) % cap
+            zero = jnp.zeros((), jnp.int32)
+            kc, vc = cache.k, cache.v
+            first = cap - split
+            kc = jax.lax.dynamic_update_slice(
+                kc, rows_k[:, :, :first], (zero, zero, jnp.int32(split), zero)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                vc, rows_v[:, :, :first], (zero, zero, jnp.int32(split), zero)
+            )
+            if split:
+                kc = jax.lax.dynamic_update_slice(
+                    kc, rows_k[:, :, first:], (zero, zero, zero, zero)
+                )
+                vc = jax.lax.dynamic_update_slice(
+                    vc, rows_v[:, :, first:], (zero, zero, zero, zero)
+                )
+        return out, RollingKVCache(kc, vc, cache.length + s_new)
 
     def _quantized_decode(self, q, k, v, cache: QuantKVCache):
         """One decode step against an int8 cache: quantize the new KV

@@ -42,7 +42,9 @@ def decode_step(model: TinyDecoder, params, token: jax.Array, caches):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "steps", "capacity", "int8_cache")
+    jax.jit,
+    static_argnames=("model", "steps", "capacity", "int8_cache",
+                     "rolling_cache"),
 )
 def generate(
     model: TinyDecoder,
@@ -52,6 +54,7 @@ def generate(
     steps: int,
     capacity: int | None = None,
     int8_cache: bool = False,
+    rolling_cache: bool = False,
 ) -> jax.Array:
     """Greedy generation: (B, S) prompt -> (B, steps) continuation.
 
@@ -61,22 +64,36 @@ def generate(
     error).
     """
     b, s = prompt.shape
-    if capacity is None:
-        capacity = -(-(s + steps) // 128) * 128
-    if capacity < s + steps:
-        raise ValueError(f"capacity {capacity} < prompt+steps {s + steps}")
-    if capacity % 128:
-        # flash_decode's cache-capacity contract, checked up front so the
-        # error doesn't surface from inside the jitted scan
-        raise ValueError(f"capacity {capacity} must be a multiple of 128")
-    if int8_cache and model.impl != "flash":
-        raise ValueError(
-            f"int8_cache requires impl='flash' (model has {model.impl!r})"
-        )
-
-    last_logits, caches = prefill(model, params, prompt, capacity)
-    if int8_cache:
-        caches = tuple(c.quantize() for c in caches)
+    if rolling_cache:
+        # ring-buffer path: cache size is the model's window; the
+        # full-cache capacity contract below does not apply
+        if int8_cache:
+            raise ValueError("rolling_cache and int8_cache are exclusive")
+        if model.window is None:
+            raise ValueError("rolling_cache requires a windowed model")
+        caches = model.init_caches(b, 0, rolling=True)
+        logits, caches = model.apply({"params": params}, prompt, caches)
+        last_logits = logits[:, -1]
+    else:
+        if capacity is None:
+            capacity = -(-(s + steps) // 128) * 128
+        if capacity < s + steps:
+            raise ValueError(
+                f"capacity {capacity} < prompt+steps {s + steps}"
+            )
+        if capacity % 128:
+            # flash_decode's cache-capacity contract, checked up front so
+            # the error doesn't surface from inside the jitted scan
+            raise ValueError(
+                f"capacity {capacity} must be a multiple of 128"
+            )
+        if int8_cache and model.impl != "flash":
+            raise ValueError(
+                f"int8_cache requires impl='flash' (model has {model.impl!r})"
+            )
+        last_logits, caches = prefill(model, params, prompt, capacity)
+        if int8_cache:
+            caches = tuple(c.quantize() for c in caches)
     first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
 
     def step(carry, _):

@@ -12,7 +12,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from attention_tpu.models.attention_layer import GQASelfAttention, KVCache
+from attention_tpu.models.attention_layer import (
+    GQASelfAttention,
+    KVCache,
+    RollingKVCache,
+)
 
 
 class MLP(nn.Module):
@@ -107,9 +111,22 @@ class TinyDecoder(nn.Module):
         return logits if caches is None else (logits, tuple(new_caches))
 
     def init_caches(self, batch: int, capacity: int,
-                    cache_dtype=None) -> tuple:
-        """Fresh per-layer KV caches for autoregressive decoding."""
+                    cache_dtype=None, rolling: bool = False) -> tuple:
+        """Fresh per-layer KV caches for autoregressive decoding.
+
+        ``rolling=True`` (windowed models only) returns ring-buffer
+        caches whose memory is bounded by the window, not by
+        ``capacity``/sequence length."""
         head_dim = self.dim // self.num_q_heads
+        if rolling:
+            if self.window is None:
+                raise ValueError("rolling caches require a windowed model")
+            return tuple(
+                RollingKVCache.create(batch, self.num_kv_heads,
+                                      self.window, head_dim,
+                                      cache_dtype or self.dtype)
+                for _ in range(self.depth)
+            )
         return tuple(
             KVCache.create(batch, self.num_kv_heads, capacity, head_dim,
                            cache_dtype or self.dtype)

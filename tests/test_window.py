@@ -206,3 +206,99 @@ def test_window_grads_multiblock_banded(rng, window):
     for gf, gd, name in zip(g_flash, g_dense, "dq dk dv".split()):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
                                    atol=3e-4, rtol=1e-3, err_msg=name)
+
+
+def test_rolling_cache_matches_full_cache_windowed_decode(rng):
+    """Step-by-step decode on the ring buffer == the full-capacity cache
+    for a windowed model (window a multiple of 128, so the rolling
+    effective window is exact)."""
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=128)
+    # run well past the window so the ring buffer wraps
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 200)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.init_caches(batch=2, capacity=256)
+    roll = model.init_caches(batch=2, capacity=0, rolling=True)
+    assert roll[0].capacity == 128  # memory bounded by the window
+    for t in range(tokens.shape[1]):
+        step = tokens[:, t : t + 1]
+        lf, full = model.apply({"params": params}, step, full)
+        lr, roll = model.apply({"params": params}, step, roll)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, rtol=1e-3,
+                                   err_msg=f"t={t}")
+    assert int(roll[0].length) == 200
+
+
+def test_rolling_generate_matches_full_generate(rng):
+    from attention_tpu.models import TinyDecoder, generate
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=128)
+    prompt = jnp.asarray(rng.integers(0, 31, (2, 20)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    full = np.asarray(generate(model, params, prompt, steps=5))
+    roll = np.asarray(generate(model, params, prompt, steps=5,
+                               rolling_cache=True))
+    np.testing.assert_array_equal(roll, full)
+
+
+def test_rolling_cache_rejects_unwindowed_model(rng):
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    with pytest.raises(ValueError, match="rolling caches require"):
+        model.init_caches(batch=1, capacity=0, rolling=True)
+
+
+def test_rolling_prefill_longer_than_window_then_decode(rng):
+    """Prompt longer than the window: the ring seeds with the last
+    `window` tokens (rotated), and subsequent decode matches the
+    full-cache windowed model."""
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=128)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 300)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.init_caches(batch=2, capacity=384)
+    lf, full = model.apply({"params": params}, tokens[:, :280], full)
+    roll = model.init_caches(batch=2, capacity=0, rolling=True)
+    lr, roll = model.apply({"params": params}, tokens[:, :280], roll)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                               atol=2e-4, rtol=1e-3)
+    for t in range(280, 300):
+        step = tokens[:, t : t + 1]
+        lf, full = model.apply({"params": params}, step, full)
+        lr, roll = model.apply({"params": params}, step, roll)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"t={t}")
+
+
+def test_rolling_nonfresh_prefill_poisons(rng):
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=128)
+    tokens = jnp.asarray(rng.integers(0, 31, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    roll = model.init_caches(batch=1, capacity=0, rolling=True)
+    _, roll = model.apply({"params": params}, tokens[:, :4], roll)
+    logits, _ = model.apply({"params": params}, tokens[:, 4:], roll)
+    assert bool(jnp.all(jnp.isnan(logits)))
+
+
+def test_rolling_requires_128_multiple_window():
+    from attention_tpu.models import RollingKVCache
+
+    with pytest.raises(ValueError, match="window % 128"):
+        RollingKVCache.create(1, 2, 100, 16)
