@@ -9,10 +9,11 @@ second all-to-all converts back.  Two collectives total per call — cheaper
 than a ring when the head count divides the mesh and sequences are only
 moderately long.
 
-GQA handling: KV heads are repeated up to the Q head count before the
-all-to-all when the KV head count does not divide the mesh size (the
-32Q/4KV BASELINE config on an 8-chip mesh).  That spends HBM to keep the
-reshard uniform; a grouped all-to-all is a future optimization.
+GQA handling: when the mesh size does not divide the KV head count,
+KV heads are repeated just enough to make the reshard uniform —
+normally up to the MESH size (the 32Q/4KV BASELINE config on an 8-chip
+mesh repeats 2x), falling back to the full Q head count only for
+ratios that divide neither way.
 """
 
 from __future__ import annotations
@@ -67,14 +68,22 @@ def ulysses_attention(
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
 
-    # GQA survives the all-to-all untouched iff the KV head count divides
-    # the mesh size (contiguous head chunks keep q-head -> kv-head groups
-    # aligned per device); otherwise repeat KV heads up to the Q head count.
+    # GQA survives the all-to-all untouched iff the mesh size divides
+    # the KV head count (each device then holds whole kv heads and the
+    # contiguous q chunks stay group-aligned).  Otherwise the minimal
+    # fix is repeating KV
+    # heads up to the MESH size, not the Q head count: device r then
+    # holds q heads [r·hq/R, (r+1)·hq/R) and expanded kv head r, whose
+    # original head is r//(R/hkv) == (r·hq/R)//(hq/hkv) — the exact head
+    # that q-chunk needs.  For 32q/4kv on 8 chips this moves 2x the KV
+    # rows over the wire instead of 8x.  Ratios that divide neither way
+    # fall back to the full repeat.
     if hkv != hq and hkv % n_dev != 0:
         if hq % hkv != 0:
             raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
-        k = jnp.repeat(k, hq // hkv, axis=-3)
-        v = jnp.repeat(v, hq // hkv, axis=-3)
+        expand = n_dev // hkv if n_dev % hkv == 0 else hq // hkv
+        k = jnp.repeat(k, expand, axis=-3)
+        v = jnp.repeat(v, expand, axis=-3)
 
     head_axis = q.ndim - 3
     seq_axis = q.ndim - 2

@@ -213,3 +213,20 @@ def test_hybrid_mesh_single_host_shape():
     assert mesh.axis_names == ("dp", "kv")
     assert mesh.shape["dp"] == 1
     assert mesh.shape["kv"] == len(jax.devices())
+
+
+def test_ulysses_gqa_minimal_expansion_matches_flash(rng):
+    """32q/4kv on the 8-device mesh takes the expand-to-mesh path (2x
+    repeat, not 8x) and must still match single-device flash."""
+    from attention_tpu.ops.flash import flash_attention
+    from attention_tpu.parallel import ulysses_attention
+    from attention_tpu.parallel.mesh import default_mesh
+
+    h, hkv, m, d = 32, 4, 256, 32
+    q = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, m, d)), jnp.float32)
+    mesh = default_mesh("sp", devices=jax.devices()[:8])
+    got = ulysses_attention(q, k, v, mesh=mesh)
+    want = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
