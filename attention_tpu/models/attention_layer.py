@@ -25,6 +25,7 @@ from attention_tpu.ops.quant import (
     update_quantized_kv,
 )
 from attention_tpu.ops.reference import attention_xla
+from attention_tpu.ops.rope import apply_rope
 
 
 class KVCache(NamedTuple):
@@ -169,6 +170,8 @@ class GQASelfAttention(nn.Module):
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     window: int | None = None  # sliding-window attention (requires causal)
+    rope: bool = False  # rotary position embeddings on Q/K
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -188,6 +191,14 @@ class GQASelfAttention(nn.Module):
         k = dense("k_proj", self.num_kv_heads)(x)
         v = dense("v_proj", self.num_kv_heads)(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B, H, S, dh)
+        if self.rope:
+            # rotate BEFORE caching: keys are stored already-rotated at
+            # their absolute positions (scores depend only on relative
+            # position, so cached history never needs re-rotation)
+            offset = 0 if cache is None else cache.length
+            pos = offset + jnp.arange(x.shape[1], dtype=jnp.int32)
+            q = apply_rope(q, pos, self.rope_theta)
+            k = apply_rope(k, pos, self.rope_theta)
         if self.window is not None:
             if not self.causal:
                 raise ValueError("window requires causal=True")

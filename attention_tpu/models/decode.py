@@ -41,11 +41,36 @@ def decode_step(model: TinyDecoder, params, token: jax.Array, caches):
     return logits[:, -1], caches
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("model", "steps", "capacity", "int8_cache",
-                     "rolling_cache"),
-)
+def _select_token(logits, rng, *, temperature, top_k, top_p):
+    """(B, V) fp32 logits -> (B,) int32 next tokens.
+
+    ``rng is None`` is greedy argmax.  Otherwise temperature (traced
+    scalar, > 0) scales the logits and top-k / top-p (nucleus) restrict
+    the support BEFORE the categorical draw; both are implemented with
+    static shapes (`lax.top_k` + sorted cumulative mass) so the whole
+    selector lives inside the decode scan.  Only ``top_k`` is static
+    (lax.top_k needs a concrete k); temperature/top_p trace, so sweeping
+    them reuses one compiled executable.
+    """
+    if rng is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p (always >= 1 tok)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
 def generate(
     model: TinyDecoder,
     params,
@@ -55,14 +80,67 @@ def generate(
     capacity: int | None = None,
     int8_cache: bool = False,
     rolling_cache: bool = False,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
-    """Greedy generation: (B, S) prompt -> (B, steps) continuation.
+    """Autoregressive generation: (B, S) prompt -> (B, steps) continuation.
 
     One jit: prefill, then a `lax.scan` of fused decode steps.
     ``int8_cache=True`` quantizes the caches once after prefill and runs
     the token loop against int8 KV (0.63x cache HBM, ~1e-3-grade logit
-    error).
+    error).  ``temperature == 0`` (default) is greedy; ``temperature >
+    0`` samples (requires ``rng``), optionally truncated by ``top_k``
+    and/or nucleus ``top_p``.  temperature and top_p are traced scalars
+    — sweeping them reuses one compiled executable; only top_k (a
+    shape) and the greedy/sampled split recompile.
     """
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and not (1 <= top_k <= model.vocab):
+        raise ValueError(
+            f"top_k must be in [1, vocab={model.vocab}], got {top_k}"
+        )
+    if temperature == 0.0:
+        if top_k is not None or top_p is not None:
+            # would otherwise be silently ignored — fail loudly instead
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature == 0 "
+                "is greedy argmax)"
+            )
+        # greedy: drop the sampling machinery from the trace entirely
+        rng = None
+    return _generate_jit(
+        model, params, prompt, jnp.float32(temperature), top_p, rng,
+        steps=steps, capacity=capacity, int8_cache=int8_cache,
+        rolling_cache=rolling_cache, top_k=top_k,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "steps", "capacity", "int8_cache",
+                     "rolling_cache", "top_k"),
+)
+def _generate_jit(
+    model: TinyDecoder,
+    params,
+    prompt: jax.Array,
+    temperature: jax.Array,
+    top_p,
+    rng,
+    *,
+    steps: int,
+    capacity: int | None,
+    int8_cache: bool,
+    rolling_cache: bool,
+    top_k: int | None,
+) -> jax.Array:
     b, s = prompt.shape
     if rolling_cache:
         # ring-buffer path: cache size is the model's window; the
@@ -94,15 +172,20 @@ def generate(
         last_logits, caches = prefill(model, params, prompt, capacity)
         if int8_cache:
             caches = tuple(c.quantize() for c in caches)
-    first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    sampled = rng is not None
+    key0, key_loop = (
+        jax.random.split(rng) if sampled else (None, None)
+    )
+    pick = functools.partial(_select_token, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+    first = pick(last_logits, key0)
 
-    def step(carry, _):
+    def step(carry, step_key):
         tok, caches = carry
         logits, caches = decode_step(model, params, tok, caches)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = pick(logits, step_key)
         return (nxt, caches), tok
 
-    (_, _), toks = jax.lax.scan(
-        step, (first, caches), None, length=steps
-    )
+    keys = jax.random.split(key_loop, steps) if sampled else None
+    (_, _), toks = jax.lax.scan(step, (first, caches), keys, length=steps)
     return jnp.moveaxis(toks, 0, 1)  # (B, steps)

@@ -39,6 +39,8 @@ class TransformerBlock(nn.Module):
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     window: int | None = None
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, cache=None):
@@ -51,6 +53,8 @@ class TransformerBlock(nn.Module):
             causal=self.causal,
             dtype=self.dtype,
             window=self.window,
+            rope=self.rope,
+            rope_theta=self.rope_theta,
         )(y, cache)
         if cache is not None:
             attn_out, cache = attn_out
@@ -78,6 +82,8 @@ class TinyDecoder(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     window: int | None = None  # sliding-window attention in every block
+    rope: bool = False  # rotary position embeddings in every block
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
@@ -99,6 +105,8 @@ class TinyDecoder(nn.Module):
                 impl=self.impl,
                 dtype=self.dtype,
                 window=self.window,
+                rope=self.rope,
+                rope_theta=self.rope_theta,
                 name=f"TransformerBlock_{i}",
             )
             if caches is None:

@@ -1,0 +1,51 @@
+"""Rotary position embeddings (RoPE).
+
+The reference kernel is position-free (plain SDPA over given Q/K/V —
+`attention.c:20-75`); a usable model family needs positions.  RoPE is
+the TPU-friendly choice: a pure elementwise rotation of Q and K that
+fuses into the surrounding projections under XLA, adds no parameters,
+no attention-bias tensor, and keys can be cached *already rotated* (the
+score depends only on relative position), so the decode path needs no
+re-rotation of history.
+
+Split-half convention (as in the original RoFormer paper and most JAX
+implementations): the head dim is split into two halves that form the
+(real, imag) components of dh/2 complex pairs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jax.Array, head_dim: int,
+                theta: float = 10000.0) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables for ``positions`` (any shape), fp32.
+
+    Returns arrays of shape ``positions.shape + (head_dim // 2,)``.
+    """
+    if head_dim % 2:
+        raise ValueError(f"RoPE requires an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    inv_freq = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotate ``x`` (..., S, dh) by its per-row positions (..., S).
+
+    ``positions`` broadcasts against x's leading axes (pass ``(S,)`` for
+    shared positions, ``(B, 1, S)``-shaped for per-sequence offsets).
+    Math runs in fp32; the result is cast back to ``x.dtype``.
+    """
+    half = x.shape[-1] // 2
+    cos, sin = rope_angles(positions, x.shape[-1], theta)
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)

@@ -1,0 +1,135 @@
+"""Sampling tests for generate(): temperature / top-k / top-p.
+
+The selector runs inside the decode `lax.scan`, so everything here is
+static-shape by construction; these tests pin the semantics (greedy
+default unchanged, determinism under a fixed key, support truncation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.models import TinyDecoder, generate
+from attention_tpu.models.decode import _select_token
+
+
+def _tiny():
+    return TinyDecoder(vocab=61, dim=64, depth=2, num_q_heads=4,
+                       num_kv_heads=2, impl="flash", dtype=jnp.float32)
+
+
+def test_select_token_greedy_without_rng():
+    logits = jnp.asarray([[0.0, 2.0, 1.0], [3.0, 0.0, 0.0]])
+    got = _select_token(logits, None, temperature=0.0, top_k=None,
+                        top_p=None)
+    np.testing.assert_array_equal(np.asarray(got), [1, 0])
+
+
+def test_select_token_top_k_restricts_support(rng):
+    """With top_k=2, only the two highest logits may ever be drawn."""
+    logits = jnp.asarray(rng.standard_normal((1, 16)), jnp.float32)
+    allowed = set(np.argsort(np.asarray(logits[0]))[-2:].tolist())
+    for i in range(40):
+        tok = _select_token(logits, jax.random.PRNGKey(i), temperature=1.5,
+                            top_k=2, top_p=None)
+        assert int(tok[0]) in allowed
+
+
+def test_select_token_top_p_keeps_minimal_nucleus():
+    """Distribution [0.6, 0.3, 0.1] with top_p=0.7: nucleus = {0, 1}."""
+    probs = jnp.asarray([[0.6, 0.3, 0.1]])
+    logits = jnp.log(probs)
+    seen = set()
+    for i in range(60):
+        tok = _select_token(logits, jax.random.PRNGKey(i), temperature=1.0,
+                            top_k=None, top_p=0.7)
+        seen.add(int(tok[0]))
+    assert 2 not in seen
+    assert seen == {0, 1}
+
+
+def test_select_token_top_p_always_keeps_one():
+    """top_p smaller than the max prob still keeps the argmax."""
+    logits = jnp.asarray([[5.0, 0.0, 0.0]])
+    tok = _select_token(logits, jax.random.PRNGKey(0), temperature=1.0,
+                        top_k=None, top_p=0.01)
+    assert int(tok[0]) == 0
+
+
+def test_generate_default_still_greedy(rng):
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    a = generate(model, params, prompt, steps=5)
+    b = generate(model, params, prompt, steps=5, temperature=0.0,
+                 rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_sampling_deterministic_given_key(rng):
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    kw = dict(steps=6, temperature=0.8, top_k=10)
+    a = generate(model, params, prompt, rng=jax.random.PRNGKey(3), **kw)
+    b = generate(model, params, prompt, rng=jax.random.PRNGKey(3), **kw)
+    c = generate(model, params, prompt, rng=jax.random.PRNGKey(4), **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (2, 6)
+    # different key should (overwhelmingly) differ somewhere
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_generate_sampling_requires_rng(rng):
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="requires an rng"):
+        generate(model, params, prompt, steps=2, temperature=1.0)
+
+
+def test_generate_rejects_bad_top_k(rng):
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    for bad in (0, 62):  # below 1 / above vocab: both up-front errors
+        with pytest.raises(ValueError, match="top_k"):
+            generate(model, params, prompt, steps=2, temperature=1.0,
+                     top_k=bad, rng=jax.random.PRNGKey(0))
+
+
+def test_generate_rejects_sampling_knobs_when_greedy(rng):
+    """top_k/top_p with temperature == 0 would be silently ignored —
+    must fail loudly instead."""
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(model, params, prompt, steps=2, top_k=5)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(model, params, prompt, steps=2, top_p=0.9)
+
+
+def test_sampling_settings_do_not_retrace(rng):
+    """temperature/top_p are traced scalars: sweeping them must reuse
+    one compiled executable (only top_k / greedy-vs-sampled recompile)."""
+    from attention_tpu.models.decode import _generate_jit
+
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 5)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    before = _generate_jit._cache_size()
+    for t, p in [(0.7, 0.9), (0.8, 0.9), (1.3, 0.5)]:
+        generate(model, params, prompt, steps=2, temperature=t, top_p=p,
+                 rng=jax.random.PRNGKey(1))
+    assert _generate_jit._cache_size() == before + 1
+
+
+def test_generate_rejects_bad_top_p(rng):
+    model = _tiny()
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, steps=2, temperature=1.0,
+                 top_p=1.5, rng=jax.random.PRNGKey(0))
