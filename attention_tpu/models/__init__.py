@@ -4,5 +4,6 @@ from attention_tpu.models.attention_layer import (  # noqa: F401
     QuantKVCache,
     RollingKVCache,
 )
+from attention_tpu.models.cross_attention import GQACrossAttention  # noqa: F401
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
 from attention_tpu.models.decode import decode_step, generate, prefill  # noqa: F401

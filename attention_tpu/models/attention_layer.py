@@ -58,7 +58,7 @@ class KVCache(NamedTuple):
 
 
 class QuantKVCache(NamedTuple):
-    """int8 decode cache: byte-planar `QuantizedKV` + valid length.
+    """int8 decode cache: `QuantizedKV` (int8 values + scales) + valid length.
 
     Decode-only (S == 1 steps, ``impl='flash'``): the serving flow is
     bf16 prefill -> :meth:`KVCache.quantize` -> int8 decode loop.

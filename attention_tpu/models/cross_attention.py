@@ -1,0 +1,91 @@
+"""Cross-attention: queries from the decoder stream, K/V from a memory.
+
+The reference kernel already handles m != n (`attention.c:20-75` takes
+independent m and n); this module is that capability surfaced at the
+model layer — encoder-decoder attention over a memory sequence, with
+the same GQA head grouping and impl split ('flash' fused kernel /
+'xla' dense einsums) as `GQASelfAttention`.
+
+No causal mask and no RoPE here: cross-attention scores are not
+relative-position-structured (queries and memory live on different
+axes), matching standard encoder-decoder practice.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from attention_tpu.models.attention_layer import ATTN_IMPLS
+
+
+class GQACrossAttention(nn.Module):
+    """(B, S, D) x + (B, T, D_mem) memory -> (B, S, D).
+
+    K/V are projected from ``memory`` (length T independent of S);
+    attention is full (non-causal) over the memory.  ``precompute_kv``
+    (see :meth:`kv`) lets serving project the memory once and reuse it
+    across decode steps.
+    """
+
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    impl: str = "flash"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def _dense(self, name, heads):
+        return nn.DenseGeneral(
+            features=(heads, self.head_dim),
+            use_bias=False,
+            dtype=self.dtype,
+            name=name,
+        )
+
+    @nn.compact
+    def __call__(self, x: jax.Array, memory: jax.Array | None = None,
+                 kv: tuple[jax.Array, jax.Array] | None = None):
+        """Pass ``memory`` (B, T, D_mem) to project K/V here, or ``kv``
+        ((B, Hkv, T, dh) pair from :meth:`project_kv`) to reuse a
+        precomputed projection."""
+        if self.num_q_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"q heads {self.num_q_heads} not a multiple of kv heads "
+                f"{self.num_kv_heads}"
+            )
+        if (memory is None) == (kv is None):
+            raise ValueError("pass exactly one of memory= or kv=")
+        q = self._dense("q_proj", self.num_q_heads)(x)
+        q = q.transpose(0, 2, 1, 3)  # (B, Hq, S, dh)
+        if kv is None:
+            k = self._dense("k_proj", self.num_kv_heads)(memory)
+            v = self._dense("v_proj", self.num_kv_heads)(memory)
+            k, v = (t.transpose(0, 2, 1, 3) for t in (k, v))
+        else:
+            k, v = kv
+        if self.impl not in ATTN_IMPLS:
+            raise KeyError(
+                f"impl {self.impl!r} has no cross-attention path "
+                f"(supported: {sorted(ATTN_IMPLS)})"
+            )
+        out = ATTN_IMPLS[self.impl](q, k, v, causal=False)
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
+        return nn.DenseGeneral(
+            features=x.shape[-1], use_bias=False, dtype=self.dtype,
+            name="o_proj",
+        )(out.astype(self.dtype))
+
+    def project_kv(self, params, memory: jax.Array):
+        """Project ``memory`` once for reuse across decode steps: returns
+        (k, v) shaped (B, Hkv, T, dh) suitable for the ``kv=`` argument.
+
+        ``params`` is this module's own param subtree.  Direct einsums
+        against the DenseGeneral kernels (D, Hkv, dh) — same math, same
+        dtype policy, usable outside an apply() scope."""
+        mem = memory.astype(self.dtype)
+        wk = params["k_proj"]["kernel"].astype(self.dtype)
+        wv = params["v_proj"]["kernel"].astype(self.dtype)
+        k = jnp.einsum("btd,dhk->bhtk", mem, wk)
+        v = jnp.einsum("btd,dhk->bhtk", mem, wv)
+        return k, v

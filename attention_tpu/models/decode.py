@@ -93,8 +93,9 @@ def generate(
     error).  ``temperature == 0`` (default) is greedy; ``temperature >
     0`` samples (requires ``rng``), optionally truncated by ``top_k``
     and/or nucleus ``top_p``.  temperature and top_p are traced scalars
-    — sweeping them reuses one compiled executable; only top_k (a
-    shape) and the greedy/sampled split recompile.
+    — sweeping their values reuses one compiled executable; top_k (a
+    shape), the greedy/sampled split, and toggling top_p between None
+    and a float (a pytree-structure change) recompile.
     """
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")

@@ -1,9 +1,9 @@
 """int8-quantized KV cache + fused quantized flash-decode kernel.
 
 Cuts the KV cache's HBM footprint to 0.63x bf16 (int8 values at 0.5x
-plus 32B/row of replicated fp32 scales against 128B/row saved) at
-decode speed parity — more context per chip for free accuracy-wise
-(~4e-4 output error measured at seq=32k).
+plus 32B/row of replicated fp32 scales against 128B/row saved) — more
+context per chip for free accuracy-wise (~4e-4 output error measured
+at seq=32k).
 
 Quantization scheme: symmetric per-token absmax (one fp32 scale per
 cached row per head).  The kernel never dequantizes into (block_k, d)
@@ -19,16 +19,13 @@ transposes.  Scales ship sublane-replicated (8, N) per (batch, kv head)
 (a (1, block_k) vector block would violate Mosaic's (8, 128) min-tile
 rule; the 8x replication costs 32B/row against the 224B/row saved).
 
-**Byte-planar int32 storage.**  The obvious int8 cache layout DMAs
-~10x slower than bf16 on the current Mosaic toolchain (measured: a
-DMA-only kernel over (block_k, d) int8 blocks runs ~12 ms where the
-same bytes as int32 run 0.9 ms), so quantized values are stored as
-int32 words holding 4 bytes each, with columns pre-permuted so that
-in-kernel sign-extending shifts yield four (block_k, d/4) planes whose
-lane-concatenation restores the original column order — no in-kernel
-byte interleave, no bitcast (Mosaic rejects bitwidth-changing
-bitcasts).  Unpack cost is a handful of VPU ops per tile; measured
-decode time is ~parity with bf16 at half the bytes.
+**Storage is plain int8** (B, Hkv, N, d): blocks DMA at full rate on
+the current Mosaic toolchain and dequant is one int8->bf16 convert per
+tile.  (An earlier revision stored byte-planar int32 words to dodge a
+since-fixed ~10x int8-DMA slowdown — see git history if it ever
+regresses; measured now: int8 blocks stream FASTER than bf16 per
+block, and the planar unpack's 12 VPU ops/tile made decode ~1.7x
+slower than bf16 instead of at parity.)
 
 The reference's mixed-precision boundary (fp64 edges / fp32 compute +
 wire, `attention-mpi.c:31-101`) pushed one level further: bf16 compute,
@@ -42,7 +39,6 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -59,58 +55,25 @@ from attention_tpu.ops.flash import (
 
 
 class QuantizedKV(NamedTuple):
-    """int8 KV cache in byte-planar int32 words: values
-    (B, Hkv, N, d//4) int32 + per-token fp32 scales stored
-    sublane-replicated (B, Hkv, 8, N)."""
+    """int8 KV cache: values (B, Hkv, N, d) int8 + per-token fp32
+    scales stored sublane-replicated (B, Hkv, 8, N)."""
 
-    k_planar: jax.Array
+    k_q: jax.Array
     k_scale: jax.Array
-    v_planar: jax.Array
+    v_q: jax.Array
     v_scale: jax.Array
 
     @property
     def capacity(self) -> int:
-        return self.k_planar.shape[2]
+        return self.k_q.shape[2]
 
     @property
     def head_dim(self) -> int:
-        return self.k_planar.shape[3] * 4
-
-
-def _planar_perm(d: int) -> np.ndarray:
-    """Column permutation st. stored[..., 4w+i] = orig[..., i*(d//4)+w]:
-    byte-plane i of the packed words is exactly original columns
-    [i*d/4, (i+1)*d/4) — planes lane-concatenate back in order."""
-    d4 = d // 4
-    idx = np.empty(d, np.int64)
-    for w in range(d4):
-        for i in range(4):
-            idx[4 * w + i] = i * d4 + w
-    return idx
-
-
-def _pack_planar(q8: jax.Array) -> jax.Array:
-    """(..., N, d) int8 -> (..., N, d//4) int32 byte-planar words."""
-    d = q8.shape[-1]
-    if d % 4:
-        raise ValueError(f"head dim {d} must be a multiple of 4")
-    perm = q8[..., _planar_perm(d)]
-    grouped = perm.reshape(*perm.shape[:-1], d // 4, 4)
-    return jax.lax.bitcast_convert_type(grouped, jnp.int32)
-
-
-def _unpack_planar(w: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
-    """(rows, d//4) int32 words -> (rows, d) compute_dtype, original
-    column order (see `_planar_perm`).  Runs inside the kernel: four
-    sign-extending shifts + a lane concat."""
-    planes = [
-        ((w << (24 - 8 * i)) >> 24).astype(compute_dtype) for i in range(4)
-    ]
-    return jnp.concatenate(planes, axis=-1)
+        return self.k_q.shape[3]
 
 
 def _quant_rows(x):
-    """Symmetric per-token absmax int8 -> (planar int32, (..., 8, N) scales)."""
+    """Symmetric per-token absmax int8 -> (int8 values, (..., 8, N) scales)."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)  # (..., N)
     scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
     q = jnp.round(x.astype(jnp.float32) / scale[..., None])
@@ -118,14 +81,14 @@ def _quant_rows(x):
     scale_rep = jnp.broadcast_to(
         scale[..., None, :], (*scale.shape[:-1], 8, scale.shape[-1])
     )
-    return _pack_planar(q), scale_rep
+    return q, scale_rep
 
 
 def quantize_kv(k: jax.Array, v: jax.Array) -> QuantizedKV:
     """Quantize full (B, Hkv, N, d) K/V caches to the int8 cache format."""
-    k_p, k_s = _quant_rows(k)
-    v_p, v_s = _quant_rows(v)
-    return QuantizedKV(k_p, k_s, v_p, v_s)
+    k_q, k_s = _quant_rows(k)
+    v_q, v_s = _quant_rows(v)
+    return QuantizedKV(k_q, k_s, v_q, v_s)
 
 
 def update_quantized_kv(cache: QuantizedKV, k_new: jax.Array,
@@ -137,16 +100,16 @@ def update_quantized_kv(cache: QuantizedKV, k_new: jax.Array,
     silently destroy earlier rows (same contract as the bf16
     ``KVCache`` path, models/attention_layer.py).
     """
-    k_p, k_s = _quant_rows(k_new)
-    v_p, v_s = _quant_rows(v_new)
+    k_q, k_s = _quant_rows(k_new)
+    v_q, v_s = _quant_rows(v_new)
     overflow = index + k_new.shape[2] > cache.capacity
     k_s = jnp.where(overflow, jnp.nan, k_s)
     v_s = jnp.where(overflow, jnp.nan, v_s)
     zero = jnp.zeros((), jnp.int32)
     return QuantizedKV(
-        jax.lax.dynamic_update_slice(cache.k_planar, k_p, (zero, zero, index, zero)),
+        jax.lax.dynamic_update_slice(cache.k_q, k_q, (zero, zero, index, zero)),
         jax.lax.dynamic_update_slice(cache.k_scale, k_s, (zero, zero, zero, index)),
-        jax.lax.dynamic_update_slice(cache.v_planar, v_p, (zero, zero, index, zero)),
+        jax.lax.dynamic_update_slice(cache.v_q, v_q, (zero, zero, index, zero)),
         jax.lax.dynamic_update_slice(cache.v_scale, v_s, (zero, zero, zero, index)),
     )
 
@@ -171,7 +134,7 @@ def _decode_q_kernel(
     @pl.when(j * block_k < valid)
     def _tile():
         q = q_ref[0]                       # (group_pad, d), log2-prescaled
-        kq = _unpack_planar(k_ref[0], q.dtype)      # (block_k, d)
+        kq = k_ref[0].astype(q.dtype)      # (block_k, d) int8 -> bf16
         s = jax.lax.dot_general(
             q, kq, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -185,7 +148,7 @@ def _decode_q_kernel(
         v_scale = jnp.max(vs_ref[0], axis=0, keepdims=True)  # (1, block_k)
         pv = jax.lax.dot_general(
             (p * v_scale).astype(jnp.bfloat16),   # dequant folded into P
-            _unpack_planar(v_ref[0], jnp.bfloat16),
+            v_ref[0].astype(jnp.bfloat16),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -203,7 +166,7 @@ def _decode_q_kernel(
 )
 def flash_decode_quantized(
     q: jax.Array,          # (B, H, d)
-    cache: QuantizedKV,    # byte-planar int8 caches + scales
+    cache: QuantizedKV,    # int8 caches + scales
     lengths: jax.Array,    # (B,) int32 or scalar
     *,
     scale: float | None = None,
@@ -212,11 +175,11 @@ def flash_decode_quantized(
 ) -> jax.Array:
     """softmax(q K[:len]^T * scale) V[:len] against an int8 cache."""
     b, h, d = q.shape
-    bk_, hkv, n, d4 = cache.k_planar.shape
-    if bk_ != b or d4 * 4 != d or cache.v_planar.shape != (b, hkv, n, d4):
+    bk_, hkv, n, dk_ = cache.k_q.shape
+    if bk_ != b or dk_ != d or cache.v_q.shape != (b, hkv, n, d):
         raise ValueError(
-            f"cache shapes inconsistent: Q{q.shape} K{cache.k_planar.shape} "
-            f"V{cache.v_planar.shape}"
+            f"cache shapes inconsistent: Q{q.shape} K{cache.k_q.shape} "
+            f"V{cache.v_q.shape}"
         )
     if cache.k_scale.shape != (b, hkv, 8, n) or \
             cache.v_scale.shape != (b, hkv, 8, n):
@@ -240,8 +203,8 @@ def flash_decode_quantized(
         qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
 
     block_k = _pick_block_k(n, block_k)
-    kc = cache.k_planar.reshape(b * hkv, n, d4)
-    vc = cache.v_planar.reshape(b * hkv, n, d4)
+    kc = cache.k_q.reshape(b * hkv, n, d)
+    vc = cache.v_q.reshape(b * hkv, n, d)
     ks = cache.k_scale.reshape(b * hkv, 8, n)
     vs = cache.v_scale.reshape(b * hkv, 8, n)
 
@@ -260,9 +223,9 @@ def flash_decode_quantized(
         grid=(b * hkv, n // block_k),
         in_specs=[
             pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d4), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, 8, block_k), scale_index),
-            pl.BlockSpec((1, block_k, d4), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, 8, block_k), scale_index),
         ],
         out_specs=pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
@@ -280,7 +243,7 @@ def flash_decode_quantized(
         compiler_params=_compiler_params(("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * n * d,
-            bytes_accessed=(kc.size + vc.size + ks.size + vs.size) * 4
+            bytes_accessed=kc.size + vc.size + (ks.size + vs.size) * 4
             + qs.size * 2,
             transcendentals=b * h * n,
         ),

@@ -24,18 +24,13 @@ def _caches(rng, b, hkv, n, d):
 def test_quantize_roundtrip_error_bounded(rng):
     kc, vc = _caches(rng, 2, 2, 256, 64)
     qkv = quantize_kv(kc, vc)
-    assert qkv.k_planar.dtype == jnp.int32
-    assert qkv.k_planar.shape == (2, 2, 256, 16)
+    assert qkv.k_q.dtype == jnp.int8
+    assert qkv.k_q.shape == (2, 2, 256, 64)
     assert qkv.k_scale.shape == (2, 2, 8, 256)
     assert qkv.capacity == 256 and qkv.head_dim == 64
-    # unpack the planar words in numpy and check the round-trip bound:
-    # per-token absmax gives |x - deq(x)| <= scale/2 = amax/254
-    # (scale rows are identical across the 8 replicated sublanes)
-    words = np.asarray(qkv.k_planar).astype(np.int64)
-    planes = [((words << (24 - 8 * i)) % (1 << 32) + 0).astype(np.uint32)
-              for i in range(4)]
-    planes = [(p_.astype(np.int32) >> 24) for p_ in planes]
-    k_q = np.concatenate(planes, axis=-1)  # plane-concat = original order
+    # round-trip bound: per-token absmax gives |x - deq(x)| <= scale/2
+    # = amax/254 (scale rows identical across the 8 replicated sublanes)
+    k_q = np.asarray(qkv.k_q, np.int32)
     scale = np.asarray(qkv.k_scale[:, :, 0, :])  # (b, hkv, n)
     deq = k_q * scale[..., None]
     amax = np.max(np.abs(np.asarray(kc)), axis=-1, keepdims=True)
@@ -72,8 +67,8 @@ def test_incremental_update_matches_full_quantization(rng):
         base, kc[:, :, 100:103], vc[:, :, 100:103], jnp.asarray(100)
     )
     full = quantize_kv(kc.at[:, :, 103:].set(0.0), vc.at[:, :, 103:].set(0.0))
-    np.testing.assert_array_equal(np.asarray(upd.k_planar[:, :, :103]),
-                                  np.asarray(full.k_planar[:, :, :103]))
+    np.testing.assert_array_equal(np.asarray(upd.k_q[:, :, :103]),
+                                  np.asarray(full.k_q[:, :, :103]))
     np.testing.assert_allclose(np.asarray(upd.k_scale[..., :103]),
                                np.asarray(full.k_scale[..., :103]))
     q = jnp.asarray(rng.standard_normal((b, hkv, d)), jnp.float32)
