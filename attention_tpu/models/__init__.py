@@ -5,5 +5,6 @@ from attention_tpu.models.attention_layer import (  # noqa: F401
     RollingKVCache,
 )
 from attention_tpu.models.cross_attention import GQACrossAttention  # noqa: F401
+from attention_tpu.models.moe import MoEMLP  # noqa: F401
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
 from attention_tpu.models.decode import decode_step, generate, prefill  # noqa: F401

@@ -73,6 +73,10 @@ def _param_spec(path: tuple, value: Any) -> P:
         return P(None, "tp", None)
     if "o_proj" in joined:  # (hq*dh, dim): shard the head-derived dim
         return P("tp", None)
+    if "experts_" in joined:  # MoE (E, d, h)/(E, h, d): shard experts
+        return P("tp", None, None)
+    if "router" in joined:  # (d, E) router: small, replicate
+        return P()
     if "Dense_0" in joined:  # MLP up (dim, hidden): shard hidden
         return P(None, "tp")
     if "Dense_1" in joined:  # MLP down (hidden, dim)
@@ -103,12 +107,18 @@ def shard_params(params, mesh: Mesh):
 
 
 def loss_fn(params, model: TinyDecoder, batch: jax.Array) -> jax.Array:
-    """Next-token cross-entropy over (B, S) int tokens."""
-    logits = model.apply({"params": params}, batch[:, :-1])
+    """Next-token cross-entropy over (B, S) int tokens, plus any sown
+    auxiliary losses (MoE load-balancing)."""
+    logits, mods = model.apply(
+        {"params": params}, batch[:, :-1], mutable=["losses"]
+    )
     targets = batch[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    ce = -jnp.mean(
+        jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    )
+    aux = sum(jax.tree_util.tree_leaves(mods.get("losses", {})), 0.0)
+    return ce + aux
 
 
 def make_train_step(model: TinyDecoder, optimizer, mesh: Mesh):

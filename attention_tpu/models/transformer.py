@@ -17,6 +17,7 @@ from attention_tpu.models.attention_layer import (
     KVCache,
     RollingKVCache,
 )
+from attention_tpu.models.moe import MoEMLP
 
 
 class MLP(nn.Module):
@@ -41,6 +42,10 @@ class TransformerBlock(nn.Module):
     window: int | None = None
     rope: bool = False
     rope_theta: float = 10000.0
+    moe_experts: int | None = None  # None = dense MLP
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    ep_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, cache=None):
@@ -60,7 +65,17 @@ class TransformerBlock(nn.Module):
             attn_out, cache = attn_out
         x = x + attn_out
         y = nn.RMSNorm(dtype=self.dtype)(x)
-        x = x + MLP(dtype=self.dtype)(y)
+        if self.moe_experts:
+            mlp_out = MoEMLP(
+                num_experts=self.moe_experts,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                ep_axis=self.ep_axis,
+                dtype=self.dtype,
+            )(y)
+        else:
+            mlp_out = MLP(dtype=self.dtype)(y)
+        x = x + mlp_out
         return x if cache is None else (x, cache)
 
 
@@ -84,6 +99,10 @@ class TinyDecoder(nn.Module):
     window: int | None = None  # sliding-window attention in every block
     rope: bool = False  # rotary position embeddings in every block
     rope_theta: float = 10000.0
+    moe_experts: int | None = None  # MoE MLP in every block (None = dense)
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    ep_axis: str | None = None  # mesh axis experts shard over
 
     @nn.compact
     def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
@@ -107,6 +126,10 @@ class TinyDecoder(nn.Module):
                 window=self.window,
                 rope=self.rope,
                 rope_theta=self.rope_theta,
+                moe_experts=self.moe_experts,
+                moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                ep_axis=self.ep_axis,
                 name=f"TransformerBlock_{i}",
             )
             if caches is None:
