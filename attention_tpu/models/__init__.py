@@ -6,5 +6,9 @@ from attention_tpu.models.attention_layer import (  # noqa: F401
 )
 from attention_tpu.models.cross_attention import GQACrossAttention  # noqa: F401
 from attention_tpu.models.moe import MoEMLP  # noqa: F401
+from attention_tpu.models.pipeline import (  # noqa: F401
+    make_pipelined_train_step,
+    pipelined_forward,
+)
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
 from attention_tpu.models.decode import decode_step, generate, prefill  # noqa: F401

@@ -1,0 +1,138 @@
+"""Pipeline-parallel forward/training for the TinyDecoder stack.
+
+Splits the decoder's depth into contiguous stages over a ``pp`` mesh
+axis and drives them with :func:`parallel.pipeline.pipeline_apply`.
+The embedding, final norm and LM head are tiny relative to the blocks;
+they run replicated outside the pipeline (the standard GPipe cut).
+
+Limits (documented, enforced): depth must divide evenly into stages;
+blocks must be homogeneous (they are — TinyDecoder repeats one config);
+MoE aux losses sown inside blocks are dropped under the pipeline (the
+scan carries activations only); ``ep_axis`` is rejected (an expert
+axis cannot live inside the 1D ``pp`` shard_map — run MoE pipelines
+with replicated experts per stage).  ``model.remat=True`` is honored:
+each block application is wrapped in ``jax.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from attention_tpu.models.transformer import TinyDecoder, TransformerBlock
+from attention_tpu.parallel.pipeline import pipeline_apply
+
+import flax.linen as nn
+
+
+def stack_block_params(params, depth: int, n_stages: int):
+    """Stack per-block param subtrees into (n_stages, depth//n_stages,
+    ...) leaves for the pipeline."""
+    if depth % n_stages:
+        raise ValueError(f"depth {depth} not divisible by {n_stages} stages")
+    blocks = [params[f"TransformerBlock_{i}"] for i in range(depth)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    per = depth // n_stages
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape(n_stages, per, *a.shape[1:]), stacked
+    )
+
+
+def _block_module(model: TinyDecoder) -> TransformerBlock:
+    return TransformerBlock(
+        num_q_heads=model.num_q_heads,
+        num_kv_heads=model.num_kv_heads,
+        head_dim=model.dim // model.num_q_heads,
+        impl=model.impl,
+        dtype=model.dtype,
+        window=model.window,
+        rope=model.rope,
+        rope_theta=model.rope_theta,
+        moe_experts=model.moe_experts,
+        moe_top_k=model.moe_top_k,
+        moe_capacity_factor=model.moe_capacity_factor,
+    )
+
+
+def pipelined_forward(
+    model: TinyDecoder,
+    params,
+    tokens: jax.Array,  # (B, S) int32
+    *,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    n_micro: int | None = None,
+) -> jax.Array:
+    """Forward pass with the block stack pipelined over ``axis_name``.
+
+    Numerically equal to ``model.apply`` (same params, no caches) up to
+    dtype rounding; microbatches split the batch axis.
+    """
+    if model.ep_axis is not None:
+        raise ValueError(
+            "pipelined_forward cannot honor ep_axis "
+            f"{model.ep_axis!r}: an expert axis cannot live inside the "
+            f"1D {axis_name!r} shard_map — use a model without ep_axis "
+            "(experts run replicated per stage)"
+        )
+    n_stages = mesh.shape[axis_name]
+    block = _block_module(model)
+    stage_params = stack_block_params(params, model.depth, n_stages)
+
+    emb = params["Embed_0"]["embedding"]
+    x = jnp.take(emb, tokens, axis=0).astype(model.dtype)
+
+    def apply_block(one_block, xs):
+        return block.apply({"params": one_block}, xs)
+
+    if model.remat:
+        apply_block = jax.checkpoint(apply_block)
+
+    def stage_fn(blk_params, xs):
+        def body(carry, one_block):
+            return apply_block(one_block, carry).astype(carry.dtype), None
+
+        out, _ = lax.scan(body, xs, blk_params)
+        return out
+
+    x = pipeline_apply(stage_fn, stage_params, x, mesh=mesh,
+                       axis_name=axis_name, n_micro=n_micro)
+
+    x = nn.RMSNorm(dtype=model.dtype).apply(
+        {"params": params["RMSNorm_0"]}, x
+    )
+    logits = x.astype(jnp.float32) @ params["Dense_0"]["kernel"].astype(
+        jnp.float32
+    )
+    return logits
+
+
+def make_pipelined_train_step(model: TinyDecoder, optimizer, mesh: Mesh,
+                              *, axis_name: str = "pp",
+                              n_micro: int | None = None):
+    """Jitted train step whose forward/backward run the pipeline
+    schedule (backward = AD through the scan+ppermute)."""
+
+    def loss_fn(params, batch):
+        logits = pipelined_forward(model, params, batch[:, :-1],
+                                   mesh=mesh, axis_name=axis_name,
+                                   n_micro=n_micro)
+        targets = batch[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
