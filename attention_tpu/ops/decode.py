@@ -17,7 +17,11 @@ TPU-native design notes:
     block.  Pallas elides the DMA when consecutive grid steps map to the
     same block, and `@pl.when(j * block_k < valid)` skips the compute,
     so both bandwidth and FLOPs scale with the *used* prefix, not the
-    cache capacity.
+    cache capacity — at ``block_k`` granularity: the default 2048 rows
+    (sweep-chosen: 512-row blocks cap streaming at ~450-500 GB/s where
+    2048 reaches ~730-900) means a short prefix still pays one full
+    block per KV head (~0.05 ms); pass a smaller ``block_k`` if a
+    workload lives entirely at short lengths.
   * All Q heads sharing one KV head (GQA) are processed together as the
     row-block of a single (group, block_k) MXU matmul, so the KV cache
     is read once per KV head, not once per Q head.
@@ -102,7 +106,7 @@ def flash_decode(
     lengths: jax.Array,  # (B,) int32 valid rows per sequence, or scalar
     *,
     scale: float | None = None,
-    block_k: int = 512,
+    block_k: int = 2048,
     interpret: bool | None = None,
 ) -> jax.Array:
     """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv)."""

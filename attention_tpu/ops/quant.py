@@ -170,7 +170,7 @@ def flash_decode_quantized(
     lengths: jax.Array,    # (B,) int32 or scalar
     *,
     scale: float | None = None,
-    block_k: int = 512,
+    block_k: int = 2048,
     interpret: bool | None = None,
 ) -> jax.Array:
     """softmax(q K[:len]^T * scale) V[:len] against an int8 cache."""

@@ -48,7 +48,7 @@ def head_sharded_decode(
     mesh: Mesh | None = None,
     axis_name: str = "tp",
     scale: float | None = None,
-    block_k: int = 512,
+    block_k: int = 2048,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Tensor-parallel decode: KV heads sharded, zero collectives.
