@@ -109,7 +109,7 @@ class RollingKVCache(NamedTuple):
         return self.k.shape[2]
 
 
-def _xla_mha(q, k, v, *, causal, window=None):
+def _xla_mha(q, k, v, *, causal, window=None, softcap=None):
     """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
     and auto-partitionable by XLA under pjit shardings."""
     if not causal:
@@ -117,18 +117,20 @@ def _xla_mha(q, k, v, *, causal, window=None):
         if hq != hkv:
             k = jnp.repeat(k, hq // hkv, axis=1)
             v = jnp.repeat(v, hq // hkv, axis=1)
-        return attention_xla(q, k, v)
+        return attention_xla(q, k, v, softcap=softcap)
     # causal = the start=0, fully-valid instance of the cached mask
     return _xla_cached_attention(q, k, v, start=0, new_len=k.shape[2],
-                                 causal=True, window=window)
+                                 causal=True, window=window,
+                                 softcap=softcap)
 
 
-def _flash_mha(q, k, v, *, causal, window=None):
-    return flash_attention_diff(q, k, v, causal=causal, window=window)
+def _flash_mha(q, k, v, *, causal, window=None, softcap=None):
+    return flash_attention_diff(q, k, v, causal=causal, window=window,
+                                softcap=softcap)
 
 
 def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
-                          window=None):
+                          window=None, softcap=None):
     """Dense cached attention over (B, H, S, dh) vs full-capacity caches
     (B, Hkv, N, dh), masked to the valid prefix.  Pure einsums — XLA
     auto-partitions it under pjit shardings, the serving analog of
@@ -139,7 +141,9 @@ def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
         vc = jnp.repeat(vc, hq // hkv, axis=1)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhmd,bhnd->bhmn", q, kc,
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=jnp.float32) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
     col = jnp.arange(kc.shape[2])[None, :]
     mask = col < new_len
     if causal:
@@ -147,7 +151,7 @@ def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
         mask = jnp.logical_and(mask, col <= row + start)
         if window is not None:
             mask = jnp.logical_and(mask, col >= row + start - (window - 1))
-    s = jnp.where(mask, s * scale, -jnp.inf)
+    s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
     return jnp.einsum("bhmn,bhnd->bhmd", p, vc)
 
@@ -172,6 +176,7 @@ class GQASelfAttention(nn.Module):
     window: int | None = None  # sliding-window attention (requires causal)
     rope: bool = False  # rotary position embeddings on Q/K
     rope_theta: float = 10000.0
+    softcap: float | None = None  # logit soft-capping (Gemma-2 style)
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -206,7 +211,8 @@ class GQASelfAttention(nn.Module):
                 raise ValueError(f"window must be >= 1, got {self.window}")
         if cache is None:
             out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,
-                                        window=self.window)
+                                        window=self.window,
+                                        softcap=self.softcap)
         elif isinstance(cache, QuantKVCache):
             out, cache = self._quantized_decode(q, k, v, cache)
         elif isinstance(cache, RollingKVCache):
@@ -246,9 +252,11 @@ class GQASelfAttention(nn.Module):
             out = _xla_cached_attention(
                 q, kc, vc, start=cache.length, new_len=new_len,
                 causal=self.causal, window=self.window,
+                softcap=self.softcap,
             )
         elif s_new == 1 and self.window is None:
-            out = flash_decode(q[:, :, 0, :], kc, vc, new_len)[:, :, None, :]
+            out = flash_decode(q[:, :, 0, :], kc, vc, new_len,
+                               softcap=self.softcap)[:, :, None, :]
         else:
             # windowed decode steps also take this path: the banded flash
             # kernel applies the window over the cache (a rolling-buffer
@@ -256,6 +264,7 @@ class GQASelfAttention(nn.Module):
             out = flash_attention(
                 q, kc, vc, causal=self.causal,
                 q_offset=cache.length, kv_valid=new_len, window=self.window,
+                softcap=self.softcap,
             )
         # Overflowing the cache would silently clamp the write index
         # (dynamic_update_slice semantics) and corrupt attention; make it
@@ -294,12 +303,14 @@ class GQASelfAttention(nn.Module):
                 cache.v, v.astype(cache.v.dtype), (0, 0, slot, 0)
             )
             valid = jnp.minimum(cache.length + 1, cap)
-            out = flash_decode(q[:, :, 0, :], kc, vc, valid)[:, :, None, :]
+            out = flash_decode(q[:, :, 0, :], kc, vc, valid,
+                               softcap=self.softcap)[:, :, None, :]
         else:
             # fresh-cache prefill: the chunk sees only itself.  A
             # non-fresh cache would silently drop in-window history, so
             # poison that case loudly (the convention of this module).
-            out = flash_attention(q, k, v, causal=True, window=self.window)
+            out = flash_attention(q, k, v, causal=True, window=self.window,
+                                  softcap=self.softcap)
             out = jnp.where(cache.length == 0, out, jnp.nan).astype(out.dtype)
             keep = min(s_new, cap)
             # rows land rotated so the invariant 'next slot = length %
@@ -347,6 +358,7 @@ class GQASelfAttention(nn.Module):
             )
         kv = update_quantized_kv(cache.kv, k, v, cache.length)
         new_len = cache.length + 1
-        out = flash_decode_quantized(q[:, :, 0, :], kv, new_len)
+        out = flash_decode_quantized(q[:, :, 0, :], kv, new_len,
+                                     softcap=self.softcap)
         # overflow already NaN-poisons via update_quantized_kv's scales
         return out[:, :, None, :].astype(q.dtype), QuantKVCache(kv, new_len)

@@ -52,6 +52,7 @@ def _block_module(model: TinyDecoder) -> TransformerBlock:
         window=model.window,
         rope=model.rope,
         rope_theta=model.rope_theta,
+        softcap=model.softcap,
         moe_experts=model.moe_experts,
         moe_top_k=model.moe_top_k,
         moe_capacity_factor=model.moe_capacity_factor,

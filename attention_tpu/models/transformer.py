@@ -42,6 +42,7 @@ class TransformerBlock(nn.Module):
     window: int | None = None
     rope: bool = False
     rope_theta: float = 10000.0
+    softcap: float | None = None
     moe_experts: int | None = None  # None = dense MLP
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -60,6 +61,7 @@ class TransformerBlock(nn.Module):
             window=self.window,
             rope=self.rope,
             rope_theta=self.rope_theta,
+            softcap=self.softcap,
         )(y, cache)
         if cache is not None:
             attn_out, cache = attn_out
@@ -99,6 +101,7 @@ class TinyDecoder(nn.Module):
     window: int | None = None  # sliding-window attention in every block
     rope: bool = False  # rotary position embeddings in every block
     rope_theta: float = 10000.0
+    softcap: float | None = None  # attention logit soft-capping
     moe_experts: int | None = None  # MoE MLP in every block (None = dense)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -126,6 +129,7 @@ class TinyDecoder(nn.Module):
                 window=self.window,
                 rope=self.rope,
                 rope_theta=self.rope_theta,
+                softcap=self.softcap,
                 moe_experts=self.moe_experts,
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,

@@ -55,6 +55,7 @@ from attention_tpu.ops.flash import (
 def _decode_kernel(
     lens_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
     *, hkv: int, block_k: int, block_q: int, n: int,
+    softcap2: float | None = None,
 ):
     """One (batch*kv-head, kv-block) grid step of cached decode."""
     bh = pl.program_id(0)
@@ -75,6 +76,7 @@ def _decode_kernel(
             valid=valid, q_offset=0, kv_offset=0,
             kv_idx=j, q_idx=0,
             n_true=n, block_k=block_k, causal=False, block_q=block_q,
+            softcap2=softcap2,
         )
 
     @pl.when(j == num_j - 1)
@@ -97,7 +99,7 @@ def _pick_block_k(n: int, want: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_k", "interpret")
+    jax.jit, static_argnames=("scale", "block_k", "interpret", "softcap")
 )
 def flash_decode(
     q: jax.Array,        # (B, H, d)
@@ -108,8 +110,13 @@ def flash_decode(
     scale: float | None = None,
     block_k: int = 2048,
     interpret: bool | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
-    """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv)."""
+    """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv).
+
+    ``softcap`` applies Gemma-2-style logit capping before softmax."""
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
     if q.ndim != 3 or k_cache.ndim != 4 or v_cache.ndim != 4:
         raise ValueError(
             f"expected q (B,H,d), caches (B,Hkv,N,d): got "
@@ -173,7 +180,9 @@ def flash_decode(
 
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, hkv=hkv, block_k=block_k, block_q=group_pad, n=n
+            _decode_kernel, hkv=hkv, block_k=block_k, block_q=group_pad,
+            n=n,
+            softcap2=None if softcap is None else softcap * _LOG2E,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, dv), v_cache.dtype),

@@ -87,6 +87,7 @@ def _flash_kernel(
     segmented: bool,
     window: int | None,
     n_true_blocks: int,
+    softcap2: float | None = None,
 ):
     """One (head, q-block, kv-block) grid step of online-softmax attention.
 
@@ -167,7 +168,7 @@ def _flash_kernel(
             n_true=n_true, block_k=block_k, causal=causal,
             block_q=block_q,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
-            window=window,
+            window=window, softcap2=softcap2,
         )
 
     @pl.when(jb == pl.num_programs(2) - 1)
@@ -191,7 +192,7 @@ def _flash_kernel(
 def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
-    block_q, q_seg_ref=None, kv_seg_ref=None, window=None,
+    block_q, q_seg_ref=None, kv_seg_ref=None, window=None, softcap2=None,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
@@ -214,6 +215,11 @@ def _flash_tile(
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # (block_q, block_k), log2-domain
+    if softcap2 is not None:
+        # logit soft-capping (Gemma-2 style): cap * tanh(s / cap),
+        # applied before masking; softcap2 is the cap in log2 units
+        # (cap * log2(e)) since s is log2-domain
+        s = softcap2 * jnp.tanh(s / softcap2)
 
     needs_tail_mask = n_true % block_k != 0
     masked = needs_tail_mask or causal or dynamic_valid or segmented
@@ -300,6 +306,7 @@ def _flash_call(
     q_segment_ids=None,
     kv_segment_ids=None,
     window=None,
+    softcap=None,
 ):
     h, m, d = q.shape
     hkv, n, dv = v.shape
@@ -316,6 +323,8 @@ def _flash_call(
             )
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
 
     # Fold softmax scale * log2(e) into Q once (an (m, d) multiply in
     # fp32) so the kernel never scales the (m, n) score matrix and all
@@ -360,6 +369,7 @@ def _flash_call(
         segmented=segmented,
         window=window,
         n_true_blocks=num_kv_blocks,
+        softcap2=None if softcap is None else softcap * _LOG2E,
     )
 
     offsets = jnp.stack(
@@ -546,6 +556,7 @@ def _canon(q, k, v):
         "block_sizes",
         "interpret",
         "window",
+        "softcap",
     ),
 )
 def flash_attention(
@@ -563,6 +574,7 @@ def flash_attention(
     q_segment_ids=None,
     kv_segment_ids=None,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Fused single-device attention: softmax(q k^T * scale) v.
 
@@ -575,6 +587,8 @@ def flash_attention(
     heads) mask attention across packed-sequence boundaries.  ``window``
     (static int, requires causal) keeps the last ``window`` positions per
     query — sliding-window attention; skipped tiles cost no FLOPs.
+    ``softcap`` (static float) applies Gemma-2-style logit capping
+    ``cap * tanh(scores / cap)`` before masking and softmax.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -603,6 +617,7 @@ def flash_attention(
         q_segment_ids=q_segment_ids,
         kv_segment_ids=kv_segment_ids,
         window=window,
+        softcap=softcap,
     )
     return unbatch(out)
 
@@ -610,7 +625,7 @@ def flash_attention(
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "causal", "block_sizes", "interpret",
-                     "window"),
+                     "window", "softcap"),
 )
 def flash_attention_partials(
     q: jax.Array,
@@ -627,6 +642,7 @@ def flash_attention_partials(
     q_segment_ids=None,
     kv_segment_ids=None,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention over a local KV shard.
 
@@ -661,6 +677,7 @@ def flash_attention_partials(
         q_segment_ids=q_segment_ids,
         kv_segment_ids=kv_segment_ids,
         window=window,
+        softcap=softcap,
     )
     if q.ndim == 2:
         return out[0], row_max[0], row_sum[0]

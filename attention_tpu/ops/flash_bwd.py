@@ -68,8 +68,11 @@ def _stat_col(ref):
 
 
 def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
-                 q_seg_ref=None, kv_seg_ref=None, window=None):
-    """(block_q, block_k) probability tile, Q-major.
+                 q_seg_ref=None, kv_seg_ref=None, window=None,
+                 softcap2=None):
+    """(block_q, block_k) probability tile, Q-major; returns (p, dcap)
+    where ``dcap`` is the softcap derivative factor 1 - tanh^2 (None
+    when no softcap).
 
     ``qs`` is the forward's pre-scaled Q (scores come out log2-domain),
     ``lse_col`` a (block_q, 1) log2-domain log-sum-exp column.
@@ -77,6 +80,11 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
     s2 = jax.lax.dot_general(
         qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # (block_q, block_k)
+    dcap = None
+    if softcap2 is not None:
+        t = jnp.tanh(s2 / softcap2)
+        s2 = softcap2 * t
+        dcap = 1.0 - t * t
     p = jnp.exp2(s2 - lse_col)
     mask = None
     if causal:
@@ -93,13 +101,13 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
         mask = seg if mask is None else jnp.logical_and(mask, seg)
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
-    return p
+    return p, dcap
 
 
 def _dq_kernel(
     lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, scale, out_dtype, compute_dtype, segmented,
-    window, n_j_total,
+    window, n_j_total, softcap2,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
@@ -122,16 +130,19 @@ def _dq_kernel(
 
     def _compute():
         qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p = _recompute_p(
+        p, dcap = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
+            softcap2=softcap2,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k) = dO Vᵀ
         ds = p * (dp - _stat_col(delta_ref))
+        if dcap is not None:
+            ds = ds * dcap  # chain through cap*tanh(s/cap)
         acc_scr[...] += jax.lax.dot_general(
             ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -156,7 +167,7 @@ def _dq_kernel(
 def _dkv_kernel(
     lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, group, compute_dtype, segmented, window,
-    n_i_total,
+    n_i_total, softcap2,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
@@ -182,10 +193,11 @@ def _dkv_kernel(
 
     def _compute():
         qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p = _recompute_p(
+        p, dcap = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
+            softcap2=softcap2,
         )
         dv_scr[...] += jax.lax.dot_general(
             p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
@@ -196,6 +208,8 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         ds = p * (dp - _stat_col(delta_ref))
+        if dcap is not None:
+            ds = ds * dcap  # chain through cap*tanh(s/cap)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(compute_dtype), qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -242,8 +256,12 @@ def flash_backward(
     q_segment_ids=None,
     kv_segment_ids=None,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """dQ, dK, dV via the two Pallas backward kernels."""
+    """dQ, dK, dV via the two Pallas backward kernels.
+
+    ``softcap`` must match the forward's: P is recomputed from capped
+    scores and dS picks up the 1 - tanh^2 chain factor."""
     segmented = q_segment_ids is not None
     if segmented != (kv_segment_ids is not None):
         raise ValueError("q_segment_ids and kv_segment_ids go together")
@@ -356,6 +374,7 @@ def flash_backward(
             segmented=segmented,
             window=window,
             n_j_total=num_j,
+            softcap2=None if softcap is None else softcap * _LOG2E,
         ),
         grid=(h, num_i, band_j),
         in_specs=[
@@ -397,6 +416,7 @@ def flash_backward(
             segmented=segmented,
             window=window,
             n_i_total=num_i,
+            softcap2=None if softcap is None else softcap * _LOG2E,
         ),
         grid=(num_j, h, band_i),
         in_specs=[

@@ -46,11 +46,12 @@ def _gqa_expand(k, group):
     return jnp.repeat(k, group, axis=0) if group > 1 else k
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash_diff(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                bwd_chunk, bwd_impl, window):
+                bwd_chunk, bwd_impl, window, softcap):
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                             q_seg, kv_seg, window)
+                             q_seg, kv_seg, window, softcap)
     return out
 
 
@@ -65,10 +66,11 @@ def _seg_zeros(seg):
 
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
-                    kv_seg=None, window=None):
+                    kv_seg=None, window=None, softcap=None):
     out_un, row_max, row_sum = flash_attention_partials(
         q, k, v, scale=scale, causal=causal, block_sizes=block_sizes,
         q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
+        softcap=softcap,
     )
     l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = (out_un / l_safe[..., None]).astype(q.dtype)
@@ -79,14 +81,14 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
 
 
 def _flash_diff_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                    bwd_chunk, bwd_impl, window):
+                    bwd_chunk, bwd_impl, window, softcap):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                               q_seg, kv_seg, window)
+                               q_seg, kv_seg, window, softcap)
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
-                    window, res, dout):
+                    window, softcap, res, dout):
     q, k, v, q_seg, kv_seg, out, lse = res
     seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg))
     if bwd_impl == "pallas":
@@ -98,6 +100,7 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
             scale=scale, causal=causal, block_sizes=block_sizes,
             interpret=_should_interpret(),
             q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
+            softcap=softcap,
         ) + seg_cots
     h, m, dk = q.shape
     hkv, n, dv = v.shape
@@ -150,6 +153,11 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
         # the plain `scale` factor with the original q.
         qsi = (qi * (scale * _LOG2E)).astype(q_dtype).astype(jnp.float32)
         s = jnp.einsum("hqd,hnd->hqn", qsi, k32) * _LN2
+        dcap = None
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
+            dcap = 1.0 - t * t
         if causal:
             rows = base + jnp.arange(chunk)
             mask = jnp.arange(n)[None, :] <= rows[:, None]
@@ -163,6 +171,8 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
         p = jnp.where(lsei[..., None] == NEG_INF, 0.0, jnp.exp(s - lsei[..., None]))
         dp = jnp.einsum("hqe,hne->hqn", doi, v32)
         ds = p * (dp - di[..., None])  # (h, chunk, n)
+        if dcap is not None:
+            ds = ds * dcap  # chain through cap*tanh(s/cap)
         dq_i = jnp.einsum("hqn,hnd->hqd", ds, k32) * scale
         dk_i = jnp.einsum("hqn,hqd->hnd", ds, qi) * scale
         dv_i = jnp.einsum("hqn,hqe->hne", p, doi)
@@ -197,6 +207,7 @@ def flash_attention_diff(
     q_segment_ids=None,
     kv_segment_ids=None,
     window: int | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
@@ -224,18 +235,18 @@ def flash_attention_diff(
     if q.ndim == 2:
         return _flash_diff(
             q[None], k[None], v[None], qseg, kvseg, scale, causal, bs,
-            bwd_chunk, bwd_impl, window,
+            bwd_chunk, bwd_impl, window, softcap,
         )[0]
     if q.ndim == 3:
         return _flash_diff(q, k, v, qseg, kvseg, scale, causal, bs,
-                           bwd_chunk, bwd_impl, window)
+                           bwd_chunk, bwd_impl, window, softcap)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
         vf = v.reshape(b * v.shape[1], *v.shape[2:])
         out = _flash_diff(
             q.reshape(b * hq, m, d), kf, vf, None, None, scale, causal, bs,
-            bwd_chunk, bwd_impl, window,
+            bwd_chunk, bwd_impl, window, softcap,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")

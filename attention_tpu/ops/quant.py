@@ -117,7 +117,7 @@ def update_quantized_kv(cache: QuantizedKV, k_new: jax.Array,
 def _decode_q_kernel(
     lens_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
     acc_scr, m_scr, l_scr,
-    *, hkv: int, block_k: int,
+    *, hkv: int, block_k: int, softcap2: float | None = None,
 ):
     """One (batch*kv-head, kv-block) grid step of int8-cache decode."""
     bh = pl.program_id(0)
@@ -141,6 +141,9 @@ def _decode_q_kernel(
         )
         k_scale = jnp.max(ks_ref[0], axis=0, keepdims=True)  # (1, block_k)
         s = s * k_scale                     # dequant on the score tile
+        if softcap2 is not None:
+            # logit soft-capping in log2 units (see flash.py::_flash_tile)
+            s = softcap2 * jnp.tanh(s / softcap2)
         col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(col < valid, s, NEG_INF)
 
@@ -162,7 +165,7 @@ def _decode_q_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_k", "interpret")
+    jax.jit, static_argnames=("scale", "block_k", "interpret", "softcap")
 )
 def flash_decode_quantized(
     q: jax.Array,          # (B, H, d)
@@ -172,8 +175,13 @@ def flash_decode_quantized(
     scale: float | None = None,
     block_k: int = 2048,
     interpret: bool | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
-    """softmax(q K[:len]^T * scale) V[:len] against an int8 cache."""
+    """softmax(q K[:len]^T * scale) V[:len] against an int8 cache.
+
+    ``softcap`` applies Gemma-2-style logit capping before softmax."""
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
     b, h, d = q.shape
     bk_, hkv, n, dk_ = cache.k_q.shape
     if bk_ != b or dk_ != d or cache.v_q.shape != (b, hkv, n, d):
@@ -237,7 +245,10 @@ def flash_decode_quantized(
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_q_kernel, hkv=hkv, block_k=block_k),
+        functools.partial(
+            _decode_q_kernel, hkv=hkv, block_k=block_k,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, d), jnp.bfloat16),
         compiler_params=_compiler_params(("parallel", "arbitrary")),

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "precision"))
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "precision", "softcap"))
 def attention_xla(
     q: jax.Array,
     k: jax.Array,
@@ -29,6 +30,7 @@ def attention_xla(
     *,
     scale: float | None = None,
     precision: str | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """softmax(q k^T * scale) v over the last two axes.
 
@@ -38,13 +40,17 @@ def attention_xla(
     with its d2f/f2d converters (`attention-mpi.c:31-101`): narrow compute
     inside, wider type at the edges.
     """
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     scores = jnp.einsum(
         "...md,...nd->...mn", q, k, precision=precision,
         preferred_element_type=jnp.float32,
-    )
-    weights = jax.nn.softmax(scores * scale, axis=-1)
+    ) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
+    weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum(
         "...mn,...nd->...md", weights.astype(v.dtype), v, precision=precision,
         preferred_element_type=jnp.float32,
@@ -61,6 +67,7 @@ def attention_xla_partials(
     causal: bool = False,
     q_offset=0,
     kv_offset=0,
+    softcap: float | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention partials over a local KV shard.
 
@@ -74,6 +81,8 @@ def attention_xla_partials(
     ``causal`` with ``q_offset``/``kv_offset`` applies the global causal
     triangle over shards — both mirror the flash kernel's masking.
     """
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     grouped = (
@@ -99,6 +108,8 @@ def attention_xla_partials(
         scores = jnp.einsum(
             "...md,...nd->...mn", q, k, preferred_element_type=jnp.float32
         ) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     masked = False
     if kv_valid is not None:
         col = jnp.arange(k.shape[-2])

@@ -60,16 +60,17 @@ def merge_partials(out_un, lmax, lsum, axis_name: str):
 
 def _local_partials(
     q, k, v, *, impl, scale, block_sizes, kv_valid, causal=False, q_offset=0,
-    kv_offset=0,
+    kv_offset=0, softcap=None,
 ):
     if impl == "flash":
         return flash_attention_partials(
             q, k, v, scale=scale, block_sizes=block_sizes, kv_valid=kv_valid,
             causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+            softcap=softcap,
         )
     return attention_xla_partials(
         q, k, v, scale=scale, kv_valid=kv_valid, causal=causal,
-        q_offset=q_offset, kv_offset=kv_offset,
+        q_offset=q_offset, kv_offset=kv_offset, softcap=softcap,
     )
 
 
@@ -82,6 +83,7 @@ def _local_partials(
         "block_sizes",
         "impl",
         "causal",
+        "softcap",
     ),
 )
 def kv_sharded_attention(
@@ -95,6 +97,7 @@ def kv_sharded_attention(
     block_sizes: BlockSizes | None = None,
     impl: str = "flash",
     causal: bool = False,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Distributed attention with K/V rows sharded over a 1D mesh.
 
@@ -146,6 +149,7 @@ def kv_sharded_attention(
             kv_valid=kv_valid,
             causal=causal,
             kv_offset=idx * n_local,
+            softcap=softcap,
         )
         return merge_partials(out_un, lmax, lsum, axis_name).astype(q_full.dtype)
 
@@ -154,7 +158,8 @@ def kv_sharded_attention(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal"),
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
+                     "softcap"),
 )
 def q_sharded_attention(
     q: jax.Array,
@@ -166,6 +171,7 @@ def q_sharded_attention(
     scale: float | None = None,
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Replicated-KV attention with Q rows sharded — the 'replicate' arm of
     the adaptive placement policy (small KV, `attention-mpi.c:217-241`).
@@ -194,7 +200,7 @@ def q_sharded_attention(
         q_offset = lax.axis_index(axis_name) * m_local
         return flash_attention(
             q_local, k_full, v_full, scale=scale, block_sizes=block_sizes,
-            causal=causal, q_offset=q_offset,
+            causal=causal, q_offset=q_offset, softcap=softcap,
         )
 
     out = run(q, k, v)

@@ -39,7 +39,8 @@ NEG_INF = float("-inf")
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal"),
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
+                     "softcap"),
 )
 def ring_attention(
     q: jax.Array,
@@ -51,6 +52,7 @@ def ring_attention(
     scale: float | None = None,
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Ring attention over a 1D mesh axis; output is Q-sharded like Q.
 
@@ -120,6 +122,7 @@ def ring_attention(
                 q_offset=idx * m_local,
                 kv_offset=shard * n_local,
                 kv_valid=kv_valid,
+                softcap=softcap,
             )
             # online merge across ring steps (rmax/rsum recurrence,
             # attention-mpi.c:179-181)

@@ -37,7 +37,8 @@ from attention_tpu.parallel.mesh import default_mesh
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis_name", "scale", "block_k", "interpret"),
+    static_argnames=("mesh", "axis_name", "scale", "block_k", "interpret",
+                     "softcap"),
 )
 def head_sharded_decode(
     q: jax.Array,        # (B, H, d)
@@ -50,6 +51,7 @@ def head_sharded_decode(
     scale: float | None = None,
     block_k: int = 2048,
     interpret: bool | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Tensor-parallel decode: KV heads sharded, zero collectives.
 
@@ -81,6 +83,7 @@ def head_sharded_decode(
         return flash_decode(
             q_local, k_local, v_local, lens_full,
             scale=scale, block_k=block_k, interpret=interpret,
+            softcap=softcap,
         )
 
     return run(q, k_cache, v_cache, lens)
@@ -88,7 +91,8 @@ def head_sharded_decode(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis_name", "scale", "block_sizes"),
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes",
+                     "softcap"),
 )
 def cache_sharded_decode(
     q: jax.Array,        # (B, H, d)
@@ -100,6 +104,7 @@ def cache_sharded_decode(
     axis_name: str = "sp",
     scale: float | None = None,
     block_sizes: BlockSizes | None = None,
+    softcap: float | None = None,
 ) -> jax.Array:
     """Sequence-parallel decode: cache *rows* sharded over the mesh.
 
@@ -146,6 +151,7 @@ def cache_sharded_decode(
         out_un, lmax, lsum = flash_attention_partials(
             q_full, k_local, v_local, scale=scale,
             block_sizes=block_sizes, kv_valid=kv_valid,
+            softcap=softcap,
         )
         return merge_partials(out_un, lmax, lsum, axis_name)
 

@@ -31,7 +31,8 @@ from attention_tpu.parallel.mesh import default_mesh
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal"),
+    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
+                     "softcap"),
 )
 def ulysses_attention(
     q: jax.Array,
@@ -43,6 +44,7 @@ def ulysses_attention(
     scale: float | None = None,
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
+    softcap: float | None = None,
 ) -> jax.Array:
     """All-to-all sequence-parallel attention for multi-head inputs.
 
@@ -102,7 +104,8 @@ def ulysses_attention(
         kh = lax.all_to_all(k_local, axis_name, head_axis, seq_axis, tiled=True)
         vh = lax.all_to_all(v_local, axis_name, head_axis, seq_axis, tiled=True)
         out = flash_attention(
-            qh, kh, vh, scale=scale, block_sizes=block_sizes, causal=causal
+            qh, kh, vh, scale=scale, block_sizes=block_sizes, causal=causal,
+            softcap=softcap,
         )
         # head-sharded -> seq-sharded
         return lax.all_to_all(out, axis_name, seq_axis, head_axis, tiled=True)

@@ -1,0 +1,242 @@
+"""Logit soft-capping tests (Gemma-2-style cap * tanh(s / cap)).
+
+Oracle: fp64 NumPy softmax over capped scores.  Covered surfaces:
+fused forward (2D/3D/GQA/causal), XLA reference, decode kernel, int8
+decode kernel, both backward implementations (Pallas kernels and
+blocked-XLA) against jax.grad of the dense reference, every
+distributed path on the 8-device mesh, and the model family.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.flash import flash_attention
+from attention_tpu.ops.flash_vjp import flash_attention_diff
+from attention_tpu.ops.quant import flash_decode_quantized, quantize_kv
+from attention_tpu.ops.reference import attention_xla
+
+
+def _oracle(q, k, v, softcap, causal=False):
+    s = (q.astype(np.float64) @ k.astype(np.float64).T) / np.sqrt(q.shape[-1])
+    s = softcap * np.tanh(s / softcap)
+    if causal:
+        m, n = s.shape
+        mask = np.arange(n)[None, :] <= np.arange(m)[:, None]
+        s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return p @ v.astype(np.float64)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_softcap_forward_matches_oracle(rng, causal):
+    m, n, d = 256, 384, 64
+    if causal:
+        n = m
+    q = rng.standard_normal((m, d)).astype(np.float32) * 3.0
+    k = rng.standard_normal((n, d)).astype(np.float32)
+    v = rng.standard_normal((n, d)).astype(np.float32)
+    got = np.asarray(flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=causal, softcap=20.0,
+    ))
+    want = _oracle(q, k, v, 20.0, causal)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_softcap_actually_caps(rng):
+    """With a tiny cap the output must differ from uncapped attention."""
+    q = jnp.asarray(rng.standard_normal((64, 32)) * 5, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    a = np.asarray(flash_attention(q, k, v))
+    b = np.asarray(flash_attention(q, k, v, softcap=1.0))
+    assert not np.allclose(a, b, atol=1e-3)
+
+
+def test_softcap_xla_reference_matches_oracle(rng):
+    q = rng.standard_normal((64, 32)).astype(np.float32) * 2
+    k = rng.standard_normal((80, 32)).astype(np.float32)
+    v = rng.standard_normal((80, 32)).astype(np.float32)
+    got = np.asarray(attention_xla(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), softcap=10.0
+    ))
+    np.testing.assert_allclose(got, _oracle(q, k, v, 10.0), atol=2e-5)
+
+
+def test_softcap_flash_matches_xla_gqa(rng):
+    q = jnp.asarray(rng.standard_normal((8, 128, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 192, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 192, 64)), jnp.float32)
+    got = np.asarray(flash_attention(q, k, v, softcap=15.0))
+    kx = jnp.repeat(k, 4, axis=0)
+    vx = jnp.repeat(v, 4, axis=0)
+    want = np.asarray(attention_xla(q, kx, vx, softcap=15.0))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_softcap_decode_matches_oracle(rng):
+    b, h, hkv, n, d = 2, 4, 2, 256, 64
+    q = rng.standard_normal((b, h, d)).astype(np.float32) * 2
+    kc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    lens = np.asarray([256, 100], np.int32)
+    got = np.asarray(flash_decode(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(lens), block_k=128, softcap=8.0,
+    ))
+    for bi in range(b):
+        for hi in range(h):
+            nn_ = int(lens[bi])
+            want = _oracle(q[bi, hi][None], kc[bi, hi // 2, :nn_],
+                           vc[bi, hi // 2, :nn_], 8.0)[0]
+            np.testing.assert_allclose(got[bi, hi], want, atol=2e-5,
+                                       err_msg=f"b{bi} h{hi}")
+
+
+def test_softcap_int8_decode_close_to_fp(rng):
+    b, h, hkv, n, d = 2, 4, 2, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    fp = np.asarray(flash_decode(q, kc, vc, 200, block_k=128, softcap=8.0))
+    q8 = np.asarray(flash_decode_quantized(
+        q, quantize_kv(kc, vc), 200, block_k=128, softcap=8.0
+    ), np.float32)
+    np.testing.assert_allclose(q8, fp, atol=0.02)
+
+
+@pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_softcap_gradients_match_dense_reference(rng, bwd_impl, causal):
+    m, d = 192, 32
+    q = jnp.asarray(rng.standard_normal((m, d)) * 2, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    cap = 10.0
+
+    def loss_flash(q, k, v):
+        out = flash_attention_diff(q, k, v, causal=causal,
+                                   bwd_impl=bwd_impl, softcap=cap)
+        return jnp.sum(out * out)
+
+    def loss_dense(q, k, v):
+        s = (q @ k.T) / jnp.sqrt(jnp.float32(d))
+        s = cap * jnp.tanh(s / cap)
+        if causal:
+            mask = (jnp.arange(m)[None, :] <= jnp.arange(m)[:, None])
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = p @ v
+        return jnp.sum(out * out)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gd, "dq dk dv".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-4, rtol=1e-3, err_msg=name)
+
+
+def test_softcap_validation():
+    q = jnp.zeros((8, 16), jnp.float32)
+    with pytest.raises(ValueError, match="softcap"):
+        flash_attention(q, q, q, softcap=0.0)
+    with pytest.raises(ValueError, match="softcap"):
+        flash_attention(q, q, q, softcap=-1.0)
+
+
+def test_softcap_model_cached_decode_matches_full_forward(rng):
+    """Softcap through the model family: step-by-step decode (flash
+    decode kernel + int8-free path) == full causal forward."""
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        softcap=10.0, rope=True)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 9)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = model.apply({"params": params}, tokens)
+
+    caches = model.init_caches(batch=2, capacity=128)
+    stepwise = []
+    for t in range(tokens.shape[1]):
+        logits, caches = model.apply(
+            {"params": params}, tokens[:, t : t + 1], caches
+        )
+        stepwise.append(logits[:, 0])
+    got = jnp.stack(stepwise, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_softcap_model_impls_agree(rng):
+    from attention_tpu.models import TinyDecoder
+
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 8)), jnp.int32)
+    mk = lambda impl: TinyDecoder(vocab=31, dim=32, depth=1,
+                                  num_q_heads=4, num_kv_heads=2,
+                                  impl=impl, dtype=jnp.float32,
+                                  softcap=5.0)
+    params = mk("flash").init(jax.random.PRNGKey(0), tokens)["params"]
+    a = mk("flash").apply({"params": params}, tokens)
+    b = mk("xla").apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("backend", ["kv", "q", "ring", "ulysses"])
+def test_softcap_distributed_paths_match_single_device(rng, backend):
+    """Every distributed strategy must honor softcap (silently running
+    uncapped would diverge from the single-device result)."""
+    from attention_tpu.parallel import (
+        kv_sharded_attention,
+        ring_attention,
+        ulysses_attention,
+    )
+    from attention_tpu.parallel.kv_sharded import (
+        q_sharded_attention as _q,
+    )
+
+    cap = 8.0
+    if backend == "ulysses":
+        q = jnp.asarray(rng.standard_normal((8, 128, 64)), jnp.float32)
+        want = np.asarray(flash_attention(q, q, q, softcap=cap))
+        got = np.asarray(ulysses_attention(q, q, q, softcap=cap))
+    else:
+        q = jnp.asarray(rng.standard_normal((256, 64)), jnp.float32)
+        want = np.asarray(flash_attention(q, q, q, softcap=cap))
+        fn = {"kv": kv_sharded_attention, "q": _q,
+              "ring": ring_attention}[backend]
+        got = np.asarray(fn(q, q, q, softcap=cap))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
+def test_softcap_sharded_serving_matches_plain_decode(rng):
+    from attention_tpu.parallel import (
+        cache_sharded_decode,
+        head_sharded_decode,
+    )
+
+    b, h, hkv, n, d = 2, 16, 8, 1024, 64
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    want = np.asarray(flash_decode(q, kc, vc, 700, softcap=6.0))
+    hs = np.asarray(head_sharded_decode(q, kc, vc, 700, softcap=6.0))  # 8 kv heads over the 8-dev tp mesh
+    cs = np.asarray(cache_sharded_decode(q, kc, vc, 700, softcap=6.0))
+    np.testing.assert_allclose(hs, want, atol=2e-4, rtol=1e-3)
+    np.testing.assert_allclose(cs, want, atol=2e-4, rtol=1e-3)
+
+
+def test_softcap_decode_entry_points_validate(rng):
+    q = jnp.zeros((1, 2, 64), jnp.float32)
+    kc = jnp.zeros((1, 2, 128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="softcap"):
+        flash_decode(q, kc, kc, 10, softcap=0.0)
+    with pytest.raises(ValueError, match="softcap"):
+        flash_decode_quantized(q, quantize_kv(kc, kc), 10, softcap=-2.0)
+    with pytest.raises(ValueError, match="softcap"):
+        attention_xla(q[0], kc[0, 0], kc[0, 0], softcap=0.0)
