@@ -34,6 +34,7 @@ class GQACrossAttention(nn.Module):
     head_dim: int
     impl: str = "flash"
     dtype: jnp.dtype = jnp.bfloat16
+    softcap: float | None = None  # logit soft-capping (Gemma-2 style)
 
     def _dense(self, name, heads):
         return nn.DenseGeneral(
@@ -69,7 +70,8 @@ class GQACrossAttention(nn.Module):
                 f"impl {self.impl!r} has no cross-attention path "
                 f"(supported: {sorted(ATTN_IMPLS)})"
             )
-        out = ATTN_IMPLS[self.impl](q, k, v, causal=False)
+        out = ATTN_IMPLS[self.impl](q, k, v, causal=False,
+                                    softcap=self.softcap)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], -1)
         return nn.DenseGeneral(
             features=x.shape[-1], use_bias=False, dtype=self.dtype,

@@ -1,0 +1,159 @@
+"""Speculative decoding: draft-model lookahead, target-model verify.
+
+Serving capability beyond the reference: a small draft model proposes
+``gamma`` tokens autoregressively; the target model scores all of them
+in ONE chunked-prefill forward (the flash kernel's S>1 cached path);
+the longest prefix agreeing with the target's own greedy choices is
+accepted plus one corrected token.  Greedy speculative decoding is
+EXACT: emitted tokens equal target-only greedy decoding, token for
+token — verified by test.
+
+TPU shape discipline: the whole loop is one ``lax.while_loop`` whose
+carry holds both models' KV caches; every iteration runs exactly
+``gamma + 1`` draft steps (the +1 keeps the draft cache's rows aligned
+through full-acceptance rollbacks) and one (gamma+1)-token target
+chunk — all static shapes, acceptance handled with masked writes into
+an over-allocated output buffer.  Cache rollback is free: ``length``
+is part of the cache carry, and stale rows past it are overwritten by
+later writes and masked out of attention reads.
+
+Batch = 1 (per-sequence acceptance lengths would rag the uniform
+cache ``length``); batch serving composes by vmapping the whole
+function or running requests independently.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from attention_tpu.models.transformer import TinyDecoder
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("target", "draft", "steps", "gamma", "capacity"),
+)
+def generate_speculative(
+    target: TinyDecoder,
+    target_params,
+    draft: TinyDecoder,
+    draft_params,
+    prompt: jax.Array,  # (1, S) int32
+    *,
+    steps: int,
+    gamma: int = 4,
+    capacity: int | None = None,
+) -> jax.Array:
+    """Greedy speculative generation: (1, S) prompt -> (1, steps).
+
+    Exactly equals ``generate(target, ...)``'s greedy output.  ``gamma``
+    is the draft lookahead per verify step; speedup comes from the
+    target scoring gamma+1 positions per forward instead of one.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative decoding is per-sequence (batch 1), got batch "
+            f"{prompt.shape[0]}"
+        )
+    if target.vocab != draft.vocab:
+        raise ValueError(
+            f"vocab mismatch: target {target.vocab} != draft {draft.vocab}"
+        )
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    s = prompt.shape[1]
+    # target consumes up to gamma+1 rows per iteration past the prompt;
+    # worst case every iteration accepts 0 drafts (1 token emitted, but
+    # ctx still advances by a+1 <= steps); +gamma+1 slack for the last
+    # chunk, rounded to the decode kernel's 128-row granule
+    need = s + steps + gamma + 1
+    if capacity is None:
+        capacity = -(-need // 128) * 128
+    if capacity < need or capacity % 128:
+        raise ValueError(
+            f"capacity {capacity} must be a 128-multiple >= {need}"
+        )
+
+    t_caches = target.init_caches(1, capacity)
+    d_caches = draft.init_caches(1, capacity)
+    t_logits, t_caches = target.apply(
+        {"params": target_params}, prompt, t_caches
+    )
+    d_logits, d_caches = draft.apply(
+        {"params": draft_params}, prompt, d_caches
+    )
+    t_next = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (1,)
+    ctx0 = jnp.asarray(s, jnp.int32)
+
+    buf = jnp.zeros((steps + gamma + 1,), jnp.int32)
+    buf = buf.at[0].set(t_next[0])  # first token comes from the prefill
+
+    def set_len(caches, length):
+        return tuple(c._replace(length=length) for c in caches)
+
+    def cond(carry):
+        _, _, _, _, _, count = carry
+        return count < steps
+
+    def body(carry):
+        t_next, ctx, t_caches, d_caches, buf, count = carry
+        # --- draft gamma+1 tokens (last one only fills the cache row) ---
+        d_caches = set_len(d_caches, ctx)
+
+        def d_step(c, _):
+            tok, caches = c
+            logits, caches = draft.apply(
+                {"params": draft_params}, tok[:, None], caches
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, caches), nxt
+
+        (_, d_caches), drafts = lax.scan(
+            d_step, (t_next, d_caches), None, length=gamma + 1
+        )
+        drafts = drafts[:, 0]  # (gamma+1,); drafts[gamma] is discarded
+
+        # --- one target chunk over [t_next, d1..d_gamma] ---
+        t_caches = set_len(t_caches, ctx)
+        chunk = jnp.concatenate([t_next, drafts[:gamma]])[None]  # (1, g+1)
+        logits, t_caches = target.apply(
+            {"params": target_params}, chunk, t_caches
+        )
+        preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # (g+1,)
+
+        # --- longest accepted prefix: preds[i] == drafts[i] ---
+        agree = preds[:gamma] == drafts[:gamma]
+        accepted = jnp.argmin(
+            jnp.concatenate([agree, jnp.asarray([False])])
+        ).astype(jnp.int32)  # first disagreement == count of agreements
+
+        # emit drafts[0..accepted-1] then the correction preds[accepted]
+        idx = jnp.arange(gamma + 1)
+        emit = jnp.where(idx < accepted, drafts, preds[accepted])
+        # masked window write at `count` (buffer has gamma+1 slack)
+        window = lax.dynamic_slice(buf, (count,), (gamma + 1,))
+        keep = idx <= accepted
+        buf = lax.dynamic_update_slice(
+            buf, jnp.where(keep, emit, window), (count,)
+        )
+
+        new_ctx = ctx + accepted + 1
+        return (
+            preds[accepted][None],
+            new_ctx,
+            set_len(t_caches, new_ctx),
+            set_len(d_caches, new_ctx),
+            buf,
+            count + accepted + 1,
+        )
+
+    # the prefill already emitted one token at buf[0]; both caches hold
+    # exactly the prompt's S rows (t_next's KV enters next iteration)
+    carry = (t_next, ctx0, t_caches, d_caches, buf,
+             jnp.asarray(1, jnp.int32))
+    *_, buf, _ = lax.while_loop(cond, body, carry)
+    return buf[None, :steps]

@@ -49,6 +49,7 @@ from attention_tpu.ops.flash import (
     _compiler_params,
     _flash_tile,
     _should_interpret,
+    check_softcap,
 )
 
 
@@ -115,8 +116,7 @@ def flash_decode(
     """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv).
 
     ``softcap`` applies Gemma-2-style logit capping before softmax."""
-    if softcap is not None and softcap <= 0.0:
-        raise ValueError(f"softcap must be > 0, got {softcap}")
+    check_softcap(softcap)
     if q.ndim != 3 or k_cache.ndim != 4 or v_cache.ndim != 4:
         raise ValueError(
             f"expected q (B,H,d), caches (B,Hkv,N,d): got "

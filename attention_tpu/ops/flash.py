@@ -323,8 +323,7 @@ def _flash_call(
             )
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-    if softcap is not None and softcap <= 0.0:
-        raise ValueError(f"softcap must be > 0, got {softcap}")
+    check_softcap(softcap)
 
     # Fold softmax scale * log2(e) into Q once (an (m, d) multiply in
     # fp32) so the kernel never scales the (m, n) score matrix and all
@@ -511,6 +510,12 @@ def segment_masks(q_seg, kv_seg, m: int, n: int, m_pad: int, n_pad: int):
     q_rep = jnp.broadcast_to(q_seg[:, None], (m_pad, _STAT_LANES))
     kv_rep = jnp.broadcast_to(kv_seg[None, :], (8, n_pad))
     return q_rep, kv_rep
+
+
+def check_softcap(softcap) -> None:
+    """Shared entry-point validation for the softcap knob."""
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
 
 
 def _should_interpret() -> bool:

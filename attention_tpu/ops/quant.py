@@ -51,6 +51,7 @@ from attention_tpu.ops.flash import (
     _compiler_params,
     _online_softmax_update,
     _should_interpret,
+    check_softcap,
 )
 
 
@@ -180,8 +181,7 @@ def flash_decode_quantized(
     """softmax(q K[:len]^T * scale) V[:len] against an int8 cache.
 
     ``softcap`` applies Gemma-2-style logit capping before softmax."""
-    if softcap is not None and softcap <= 0.0:
-        raise ValueError(f"softcap must be > 0, got {softcap}")
+    check_softcap(softcap)
     b, h, d = q.shape
     bk_, hkv, n, dk_ = cache.k_q.shape
     if bk_ != b or dk_ != d or cache.v_q.shape != (b, hkv, n, d):

@@ -20,6 +20,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from attention_tpu.ops.flash import check_softcap
+
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "precision", "softcap"))
@@ -40,8 +42,7 @@ def attention_xla(
     with its d2f/f2d converters (`attention-mpi.c:31-101`): narrow compute
     inside, wider type at the edges.
     """
-    if softcap is not None and softcap <= 0.0:
-        raise ValueError(f"softcap must be > 0, got {softcap}")
+    check_softcap(softcap)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     scores = jnp.einsum(
@@ -81,8 +82,7 @@ def attention_xla_partials(
     ``causal`` with ``q_offset``/``kv_offset`` applies the global causal
     triangle over shards — both mirror the flash kernel's masking.
     """
-    if softcap is not None and softcap <= 0.0:
-        raise ValueError(f"softcap must be > 0, got {softcap}")
+    check_softcap(softcap)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     grouped = (

@@ -98,3 +98,20 @@ def test_cross_attention_differentiable(rng):
     flat = jax.tree_util.tree_leaves(g)
     assert all(bool(jnp.all(jnp.isfinite(t))) for t in flat)
     assert any(float(jnp.max(jnp.abs(t))) > 0 for t in flat)
+
+
+def test_cross_attention_softcap(rng):
+    x = jnp.asarray(rng.standard_normal((1, 6, 64)), jnp.float32)
+    mem = jnp.asarray(rng.standard_normal((1, 14, 64)), jnp.float32)
+    mk = lambda impl: GQACrossAttention(num_q_heads=4, num_kv_heads=2,
+                                        head_dim=16, impl=impl,
+                                        dtype=jnp.float32, softcap=5.0)
+    params = mk("flash").init(jax.random.PRNGKey(0), x, mem)["params"]
+    a = mk("flash").apply({"params": params}, x, mem)
+    b = mk("xla").apply({"params": params}, x, mem)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=2e-4, rtol=1e-3)
+    plain = GQACrossAttention(num_q_heads=4, num_kv_heads=2, head_dim=16,
+                              impl="flash", dtype=jnp.float32)
+    c = plain.apply({"params": params}, x, mem)
+    assert not np.allclose(np.asarray(a), np.asarray(c), atol=1e-4)

@@ -1,0 +1,71 @@
+"""Speculative decoding tests: greedy exactness against target-only
+generation, across draft quality, gamma, and model features."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.models import TinyDecoder, generate
+from attention_tpu.models.speculative import generate_speculative
+
+
+def _models(vocab=41, seed=0, **kw):
+    target = TinyDecoder(vocab=vocab, dim=64, depth=2, num_q_heads=4,
+                         num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                         **kw)
+    draft = TinyDecoder(vocab=vocab, dim=32, depth=1, num_q_heads=2,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        **kw)
+    prompt = jnp.asarray(
+        np.random.default_rng(seed).integers(0, vocab, (1, 7)), jnp.int32
+    )
+    tp = target.init(jax.random.PRNGKey(seed), prompt)["params"]
+    dp = draft.init(jax.random.PRNGKey(seed + 1), prompt)["params"]
+    return target, tp, draft, dp, prompt
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_speculative_matches_greedy_random_draft(rng, gamma):
+    """A random (useless) draft must still give EXACT greedy output —
+    correctness cannot depend on draft quality."""
+    target, tp, draft, dp, prompt = _models()
+    want = np.asarray(generate(target, tp, prompt, steps=12))
+    got = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=12, gamma=gamma
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_matches_greedy_perfect_draft(rng):
+    """Draft == target: every draft accepted, output still exact."""
+    target, tp, _, _, prompt = _models()
+    got = np.asarray(generate_speculative(
+        target, tp, target, tp, prompt, steps=10, gamma=4
+    ))
+    want = np.asarray(generate(target, tp, prompt, steps=10))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_with_rope_and_softcap(rng):
+    target, tp, draft, dp, prompt = _models(rope=True, softcap=10.0)
+    want = np.asarray(generate(target, tp, prompt, steps=8))
+    got = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=8, gamma=3
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_validations(rng):
+    target, tp, draft, dp, prompt = _models()
+    with pytest.raises(ValueError, match="batch 1"):
+        generate_speculative(target, tp, draft, dp,
+                             jnp.zeros((2, 4), jnp.int32), steps=4)
+    with pytest.raises(ValueError, match="gamma"):
+        generate_speculative(target, tp, draft, dp, prompt, steps=4,
+                             gamma=0)
+    bad_draft = TinyDecoder(vocab=99, dim=32, depth=1, num_q_heads=2,
+                            num_kv_heads=2, impl="flash",
+                            dtype=jnp.float32)
+    with pytest.raises(ValueError, match="vocab"):
+        generate_speculative(target, tp, bad_draft, dp, prompt, steps=4)
