@@ -2,6 +2,7 @@ from attention_tpu.models.attention_layer import (  # noqa: F401
     GQASelfAttention,
     KVCache,
     QuantKVCache,
+    RaggedKVCache,
     RollingKVCache,
 )
 from attention_tpu.models.cross_attention import GQACrossAttention  # noqa: F401
@@ -12,4 +13,9 @@ from attention_tpu.models.pipeline import (  # noqa: F401
 )
 from attention_tpu.models.speculative import generate_speculative  # noqa: F401
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
-from attention_tpu.models.decode import decode_step, generate, prefill  # noqa: F401
+from attention_tpu.models.decode import (  # noqa: F401
+    decode_step,
+    generate,
+    generate_ragged,
+    prefill,
+)

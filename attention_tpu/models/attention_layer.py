@@ -109,6 +109,33 @@ class RollingKVCache(NamedTuple):
         return self.k.shape[2]
 
 
+class RaggedKVCache(NamedTuple):
+    """Decode cache with PER-SEQUENCE valid lengths (B,) — the ragged
+    serving cache: one batch mixes prompts of different lengths with no
+    host-side bucketing.
+
+    Built from a padded-prompt prefill on the scalar `KVCache` (causal
+    masking keeps pad keys invisible to valid queries), then decode
+    steps write each sequence's new row at its own ``lengths[b]`` and
+    attend over its own valid prefix (`flash_decode` takes (B,) lens
+    natively).  Pad rows are progressively overwritten by decode.
+    """
+
+    k: jax.Array  # (B, Hkv, N, dh)
+    v: jax.Array
+    lengths: jax.Array  # (B,) int32 valid rows per sequence
+
+    @property
+    def length(self):
+        """Per-sequence lengths (named like the other caches so shared
+        code — RoPE offsets — treats caches uniformly)."""
+        return self.lengths
+
+    @classmethod
+    def from_prefill(cls, cache: KVCache, lengths) -> "RaggedKVCache":
+        return cls(cache.k, cache.v, jnp.asarray(lengths, jnp.int32))
+
+
 def _xla_mha(q, k, v, *, causal, window=None, softcap=None):
     """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
     and auto-partitionable by XLA under pjit shardings."""
@@ -200,8 +227,14 @@ class GQASelfAttention(nn.Module):
             # rotate BEFORE caching: keys are stored already-rotated at
             # their absolute positions (scores depend only on relative
             # position, so cached history never needs re-rotation)
-            offset = 0 if cache is None else cache.length
-            pos = offset + jnp.arange(x.shape[1], dtype=jnp.int32)
+            off = jnp.asarray(
+                0 if cache is None else cache.length, jnp.int32
+            )
+            base = jnp.arange(x.shape[1], dtype=jnp.int32)
+            if off.ndim:  # ragged: (B,) offsets -> (B, 1, S) positions
+                pos = (off[:, None] + base[None, :])[:, None, :]
+            else:
+                pos = off + base
             q = apply_rope(q, pos, self.rope_theta)
             k = apply_rope(k, pos, self.rope_theta)
         if self.window is not None:
@@ -215,6 +248,8 @@ class GQASelfAttention(nn.Module):
                                         softcap=self.softcap)
         elif isinstance(cache, QuantKVCache):
             out, cache = self._quantized_decode(q, k, v, cache)
+        elif isinstance(cache, RaggedKVCache):
+            out, cache = self._ragged_attention(q, k, v, cache)
         elif isinstance(cache, RollingKVCache):
             out, cache = self._rolling_attention(q, k, v, cache)
         else:
@@ -337,6 +372,40 @@ class GQASelfAttention(nn.Module):
                     vc, rows_v[:, :, first:], (zero, zero, zero, zero)
                 )
         return out, RollingKVCache(kc, vc, cache.length + s_new)
+
+    def _ragged_attention(self, q, k, v, cache: RaggedKVCache):
+        """One decode step per sequence at per-sequence positions."""
+        if self.impl != "flash":
+            raise ValueError(
+                f"impl {self.impl!r} has no ragged-cache path "
+                "(supported: ['flash'])"
+            )
+        if q.shape[2] != 1:
+            raise ValueError(
+                "RaggedKVCache supports single-token decode steps; "
+                "prefill padded prompts on a KVCache, then "
+                "RaggedKVCache.from_prefill"
+            )
+        if self.window is not None:
+            raise ValueError(
+                "sliding-window decode is not supported on the ragged "
+                "cache"
+            )
+        write = jax.vmap(
+            lambda buf, row, i: jax.lax.dynamic_update_slice(
+                buf, row, (jnp.int32(0), i, jnp.int32(0))
+            )
+        )
+        kc = write(cache.k, k.astype(cache.k.dtype), cache.lengths)
+        vc = write(cache.v, v.astype(cache.v.dtype), cache.lengths)
+        new_lengths = cache.lengths + 1
+        out = flash_decode(
+            q[:, :, 0, :], kc, vc, new_lengths, softcap=self.softcap
+        )[:, :, None, :]
+        # per-sequence overflow poison (same loud-overflow contract)
+        over = new_lengths > cache.k.shape[2]
+        out = jnp.where(over[:, None, None, None], jnp.nan, out)
+        return out.astype(q.dtype), RaggedKVCache(kc, vc, new_lengths)
 
     def _quantized_decode(self, q, k, v, cache: QuantKVCache):
         """One decode step against an int8 cache: quantize the new KV

@@ -18,7 +18,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from attention_tpu.models.attention_layer import RaggedKVCache
 from attention_tpu.models.transformer import TinyDecoder
 
 
@@ -71,6 +73,31 @@ def _select_token(logits, rng, *, temperature, top_k, top_p):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _validate_sampling(model, temperature, top_k, top_p, rng):
+    """Shared sampling-knob contract for generate/generate_ragged.
+    Returns the (possibly dropped) rng: greedy discards it so the
+    sampling machinery never enters the trace."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and not (1 <= top_k <= model.vocab):
+        raise ValueError(
+            f"top_k must be in [1, vocab={model.vocab}], got {top_k}"
+        )
+    if temperature == 0.0:
+        if top_k is not None or top_p is not None:
+            # would otherwise be silently ignored — fail loudly instead
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature == 0 "
+                "is greedy argmax)"
+            )
+        rng = None
+    return rng
+
+
 def generate(
     model: TinyDecoder,
     params,
@@ -97,25 +124,7 @@ def generate(
     shape), the greedy/sampled split, and toggling top_p between None
     and a float (a pytree-structure change) recompile.
     """
-    if temperature < 0.0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
-    if temperature > 0.0 and rng is None:
-        raise ValueError("temperature > 0 requires an rng key")
-    if top_p is not None and not (0.0 < top_p <= 1.0):
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_k is not None and not (1 <= top_k <= model.vocab):
-        raise ValueError(
-            f"top_k must be in [1, vocab={model.vocab}], got {top_k}"
-        )
-    if temperature == 0.0:
-        if top_k is not None or top_p is not None:
-            # would otherwise be silently ignored — fail loudly instead
-            raise ValueError(
-                "top_k/top_p require temperature > 0 (temperature == 0 "
-                "is greedy argmax)"
-            )
-        # greedy: drop the sampling machinery from the trace entirely
-        rng = None
+    rng = _validate_sampling(model, temperature, top_k, top_p, rng)
     return _generate_jit(
         model, params, prompt, jnp.float32(temperature), top_p, rng,
         steps=steps, capacity=capacity, int8_cache=int8_cache,
@@ -180,6 +189,111 @@ def _generate_jit(
     pick = functools.partial(_select_token, temperature=temperature,
                              top_k=top_k, top_p=top_p)
     first = pick(last_logits, key0)
+
+    def step(carry, step_key):
+        tok, caches = carry
+        logits, caches = decode_step(model, params, tok, caches)
+        nxt = pick(logits, step_key)
+        return (nxt, caches), tok
+
+    keys = jax.random.split(key_loop, steps) if sampled else None
+    (_, _), toks = jax.lax.scan(step, (first, caches), keys, length=steps)
+    return jnp.moveaxis(toks, 0, 1)  # (B, steps)
+
+
+def generate_ragged(
+    model: TinyDecoder,
+    params,
+    prompt: jax.Array,          # (B, S_max) int32, right-padded
+    prompt_lengths: jax.Array,  # (B,) int32 true prompt lengths
+    *,
+    steps: int,
+    capacity: int | None = None,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Batched generation over prompts of DIFFERENT lengths — no
+    host-side bucketing.  Returns (B, steps); sequence b's continuation
+    starts right after its ``prompt_lengths[b]``-th token.
+
+    One padded causal prefill fills a scalar `KVCache` (pad keys sit at
+    positions valid queries never attend to), then the ragged decode
+    loop writes each sequence's rows at its own positions and attends
+    over its own prefix.  Greedy output per sequence equals batch-1
+    `generate` on the trimmed prompt (tested).  Sampling knobs match
+    :func:`generate`.
+    """
+    rng = _validate_sampling(model, temperature, top_k, top_p, rng)
+    if model.impl != "flash":
+        raise ValueError(
+            f"generate_ragged requires impl='flash' (got {model.impl!r})"
+        )
+    if model.window is not None:
+        raise ValueError("generate_ragged does not support windowed models")
+    b, s_max = prompt.shape
+    lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    try:
+        # eager callers (the normal case): fail loudly on out-of-range
+        # lengths instead of selecting wrong logits / attending over
+        # never-written cache rows.  Under an outer jit the lengths are
+        # traced and this check is skipped (documented best-effort).
+        bad = bool(jnp.any((lengths < 1) | (lengths > s_max)))
+    except jax.errors.TracerBoolConversionError:
+        bad = False
+    if bad:
+        raise ValueError(
+            f"prompt_lengths must be in [1, {s_max}], got "
+            f"{np.asarray(lengths)}"
+        )
+    if capacity is None:
+        capacity = -(-(s_max + steps) // 128) * 128
+    if capacity < s_max + steps or capacity % 128:
+        raise ValueError(
+            f"capacity {capacity} must be a 128-multiple >= "
+            f"{s_max + steps}"
+        )
+    return _generate_ragged_jit(
+        model, params, prompt, lengths,
+        jnp.float32(temperature), top_p, rng,
+        steps=steps, capacity=capacity, top_k=top_k,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "steps", "capacity", "top_k"),
+)
+def _generate_ragged_jit(
+    model: TinyDecoder,
+    params,
+    prompt: jax.Array,
+    prompt_lengths: jax.Array,
+    temperature: jax.Array,
+    top_p,
+    rng,
+    *,
+    steps: int,
+    capacity: int,
+    top_k: int | None,
+) -> jax.Array:
+    b = prompt.shape[0]
+    caches = model.init_caches(b, capacity)
+    logits, caches = model.apply({"params": params}, prompt, caches)
+    # last VALID position's logits per sequence
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    caches = tuple(
+        RaggedKVCache.from_prefill(c, prompt_lengths) for c in caches
+    )
+
+    sampled = rng is not None
+    key0, key_loop = jax.random.split(rng) if sampled else (None, None)
+    pick = functools.partial(_select_token, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+    first = pick(last, key0)
 
     def step(carry, step_key):
         tok, caches = carry
