@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.paged import PagedKV, paged_append, paged_flash_decode
 from attention_tpu.ops.flash import flash_attention
 from attention_tpu.ops.flash_vjp import flash_attention_diff
 from attention_tpu.ops.quant import (
@@ -250,6 +251,8 @@ class GQASelfAttention(nn.Module):
             out, cache = self._quantized_decode(q, k, v, cache)
         elif isinstance(cache, RaggedKVCache):
             out, cache = self._ragged_attention(q, k, v, cache)
+        elif isinstance(cache, PagedKV):
+            out, cache = self._paged_attention(q, k, v, cache)
         elif isinstance(cache, RollingKVCache):
             out, cache = self._rolling_attention(q, k, v, cache)
         else:
@@ -406,6 +409,28 @@ class GQASelfAttention(nn.Module):
         over = new_lengths > cache.k.shape[2]
         out = jnp.where(over[:, None, None, None], jnp.nan, out)
         return out.astype(q.dtype), RaggedKVCache(kc, vc, new_lengths)
+
+    def _paged_attention(self, q, k, v, cache: PagedKV):
+        """One decode step per sequence through the page table."""
+        if self.impl != "flash":
+            raise ValueError(
+                f"impl {self.impl!r} has no paged-cache path "
+                "(supported: ['flash'])"
+            )
+        if q.shape[2] != 1:
+            raise ValueError(
+                "PagedKV supports single-token decode steps; prefill on "
+                "a dense KVCache, then ops.paged.paged_from_dense"
+            )
+        if self.window is not None:
+            raise ValueError(
+                "sliding-window decode is not supported on the paged cache"
+            )
+        cache = paged_append(cache, k, v)
+        out = paged_flash_decode(
+            q[:, :, 0, :], cache, softcap=self.softcap
+        )[:, :, None, :]
+        return out.astype(q.dtype), cache
 
     def _quantized_decode(self, q, k, v, cache: QuantKVCache):
         """One decode step against an int8 cache: quantize the new KV

@@ -201,6 +201,24 @@ def _generate_jit(
     return jnp.moveaxis(toks, 0, 1)  # (B, steps)
 
 
+def _validate_lengths(prompt_lengths, s_max: int) -> jax.Array:
+    """Eager callers (the normal case): fail loudly on out-of-range
+    lengths instead of selecting wrong logits / attending over
+    never-written cache rows.  Under an outer jit the lengths are
+    traced and the check is skipped (documented best-effort)."""
+    lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    try:
+        bad = bool(jnp.any((lengths < 1) | (lengths > s_max)))
+    except jax.errors.TracerBoolConversionError:
+        bad = False
+    if bad:
+        raise ValueError(
+            f"prompt_lengths must be in [1, {s_max}], got "
+            f"{np.asarray(lengths)}"
+        )
+    return lengths
+
+
 def generate_ragged(
     model: TinyDecoder,
     params,
@@ -233,20 +251,7 @@ def generate_ragged(
     if model.window is not None:
         raise ValueError("generate_ragged does not support windowed models")
     b, s_max = prompt.shape
-    lengths = jnp.asarray(prompt_lengths, jnp.int32)
-    try:
-        # eager callers (the normal case): fail loudly on out-of-range
-        # lengths instead of selecting wrong logits / attending over
-        # never-written cache rows.  Under an outer jit the lengths are
-        # traced and this check is skipped (documented best-effort).
-        bad = bool(jnp.any((lengths < 1) | (lengths > s_max)))
-    except jax.errors.TracerBoolConversionError:
-        bad = False
-    if bad:
-        raise ValueError(
-            f"prompt_lengths must be in [1, {s_max}], got "
-            f"{np.asarray(lengths)}"
-        )
+    lengths = _validate_lengths(prompt_lengths, s_max)
     if capacity is None:
         capacity = -(-(s_max + steps) // 128) * 128
     if capacity < s_max + steps or capacity % 128:
@@ -304,3 +309,85 @@ def _generate_ragged_jit(
     keys = jax.random.split(key_loop, steps) if sampled else None
     (_, _), toks = jax.lax.scan(step, (first, caches), keys, length=steps)
     return jnp.moveaxis(toks, 0, 1)  # (B, steps)
+
+
+def generate_paged(
+    model: TinyDecoder,
+    params,
+    prompt: jax.Array,          # (B, S_max) int32, right-padded
+    prompt_lengths: jax.Array,  # (B,) int32 true prompt lengths
+    *,
+    steps: int,
+    num_pages: int | None = None,
+    page_size: int = 128,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng: jax.Array | None = None,
+):
+    """Ragged batched generation on PAGED KV caches (vLLM-style block
+    tables): (B, S_max) padded prompts -> ((B, steps) tokens,
+    final per-layer `PagedKV` caches, per-layer `PagePool`s).
+
+    Prefill runs on dense caches, which are then scattered into one
+    page pool per layer (`ops.paged.paged_from_dense`); the decode
+    scan writes through the page table.  Greedy output equals
+    `generate_ragged` (and therefore per-sequence `generate`).  The
+    final caches carry each sequence's page-table row — when sequence
+    b completes, free its pages with
+    ``pools[l].free([p for p in caches[l].page_table[b] if p >= 0])``.
+    """
+    from attention_tpu.ops.paged import PagePool, paged_from_dense
+
+    rng = _validate_sampling(model, temperature, top_k, top_p, rng)
+    if model.impl != "flash":
+        raise ValueError(
+            f"generate_paged requires impl='flash' (got {model.impl!r})"
+        )
+    if model.window is not None:
+        raise ValueError("generate_paged does not support windowed models")
+    b, s_max = prompt.shape
+    lengths = _validate_lengths(prompt_lengths, s_max)
+    capacity = -(-(s_max + steps) // page_size) * page_size
+    if capacity % 128:
+        raise ValueError(f"page_size {page_size} must be a 128-multiple")
+    pages_per_seq = capacity // page_size
+    if num_pages is None:
+        num_pages = b * pages_per_seq
+
+    caches = model.init_caches(b, capacity)
+    logits, caches = model.apply({"params": params}, prompt, caches)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+
+    pools = []
+    paged = []
+    for c in caches:
+        pool = PagePool(num_pages)
+        # claim every page each sequence can touch during this call
+        # (prompt + steps) up front; the pooling win is across calls
+        pg = paged_from_dense(c.k, c.v, lengths, pool,
+                              num_pages=num_pages, page_size=page_size,
+                              total_pages_per_seq=pages_per_seq)
+        pools.append(pool)
+        paged.append(pg)
+    caches = tuple(paged)
+
+    sampled = rng is not None
+    key0, key_loop = jax.random.split(rng) if sampled else (None, None)
+    pick = functools.partial(_select_token, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+    first = pick(last, key0)
+
+    def step(carry, step_key):
+        tok, caches = carry
+        logits, caches = decode_step(model, params, tok, caches)
+        nxt = pick(logits, step_key)
+        return (nxt, caches), tok
+
+    keys = jax.random.split(key_loop, steps) if sampled else None
+    (_, final_caches), toks = jax.lax.scan(
+        step, (first, caches), keys, length=steps
+    )
+    return jnp.moveaxis(toks, 0, 1), final_caches, pools

@@ -7,3 +7,11 @@ from attention_tpu.ops.quant import (  # noqa: F401
     quantize_kv,
     update_quantized_kv,
 )
+from attention_tpu.ops.paged import (  # noqa: F401
+    PagedKV,
+    PagePool,
+    paged_append,
+    paged_flash_decode,
+    paged_from_dense,
+)
+from attention_tpu.ops.rope import apply_rope, rope_angles  # noqa: F401

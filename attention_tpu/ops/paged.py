@@ -1,0 +1,319 @@
+"""Paged KV cache: block-table attention over a shared page pool.
+
+vLLM's core memory idea, built the TPU way: KV lives in a pool of
+fixed-size pages (``(num_pages, Hkv, page_size, d)``) shared by every
+sequence; a per-sequence page table maps logical cache blocks to
+physical pages.  Capacity is pooled — no per-sequence contiguous
+reservation, no fragmentation between long and short requests, pages
+recycle the moment a sequence finishes.
+
+The kernel is the fused flash-decode kernel with ONE change: the KV
+BlockSpec's index map reads the physical page id from the
+scalar-prefetched page table instead of computing ``j`` directly —
+page translation costs nothing at kernel time because Pallas index
+maps already run on prefetched scalars (the same mechanism the ragged
+decode uses for per-sequence lengths).  Past-the-prefix grid steps
+clamp to the last valid page so their DMAs elide.
+
+Host-side allocation is a free-list (`PagePool`); the jitted decode
+loop only ever sees a fixed-shape table, so paging composes with
+`lax.scan` token loops (pages for prompt+steps are claimed up front;
+the pooling win is ACROSS requests over time).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu.ops.flash import (
+    _LOG2E,
+    _STAT_LANES,
+    NEG_INF,
+    _ceil_to,
+    _compiler_params,
+    _flash_tile,
+    _should_interpret,
+    check_softcap,
+)
+
+
+class PagedKV(NamedTuple):
+    """Paged KV state: shared pools + per-sequence translation.
+
+    ``k_pool``/``v_pool``: (P, Hkv, page_size, d).  ``page_table``:
+    (B, max_pages) int32 physical page ids (entries past the used
+    prefix are ignored).  ``lengths``: (B,) int32 valid tokens.
+    """
+
+    k_pool: jax.Array
+    v_pool: jax.Array
+    page_table: jax.Array
+    lengths: jax.Array
+
+    @property
+    def length(self):
+        """Per-sequence lengths (uniform name across cache types so
+        shared code — RoPE offsets — needs no special-casing)."""
+        return self.lengths
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pool.shape[2]
+
+    @property
+    def max_tokens(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+
+class PagePool:
+    """Host-side free-list allocator over ``num_pages`` physical pages.
+
+    Lives OUTSIDE jit (allocation happens between requests, not between
+    tokens); hands out page-id lists that become fixed-shape table rows.
+    """
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: want {n}, free {len(self._free)}"
+            )
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if not (0 <= p < self.num_pages):
+                raise ValueError(f"bad page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+    def table_row(self, pages: list[int], max_pages: int) -> jnp.ndarray:
+        """Fixed-width table row; unused entries hold the -1 sentinel
+        (the kernel's clamp never reads them; `paged_append` treats a
+        -1 target as unclaimed and NaN-poisons loudly)."""
+        if len(pages) > max_pages:
+            raise ValueError(f"{len(pages)} pages > max_pages {max_pages}")
+        return jnp.asarray(pages + [-1] * (max_pages - len(pages)),
+                           jnp.int32)
+
+
+def _paged_kernel(
+    lens_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
+    *, hkv: int, page: int, softcap2,
+):
+    """One (batch*kv-head, logical-page) grid step."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    valid = lens_ref[bh // hkv]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * page < valid)
+    def _tile():
+        _flash_tile(
+            q_ref, k_ref[0], v_ref[0], acc_scr, m_scr, l_scr,
+            valid=valid, q_offset=0, kv_offset=0,
+            kv_idx=j, q_idx=0,
+            n_true=num_j * page, block_k=page, causal=False,
+            block_q=q_ref.shape[1], softcap2=softcap2,
+        )
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "softcap")
+)
+def paged_flash_decode(
+    q: jax.Array,       # (B, H, d)
+    cache: PagedKV,
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+) -> jax.Array:
+    """softmax(q K[:len]^T * scale) V[:len] through the page table."""
+    check_softcap(softcap)
+    b, h, d = q.shape
+    p_, hkv, page, dk = cache.k_pool.shape
+    dv = cache.v_pool.shape[-1]
+    bt, max_pages = cache.page_table.shape
+    if dk != d or cache.v_pool.shape[:3] != (p_, hkv, page) or bt != b:
+        raise ValueError(
+            f"paged cache shapes inconsistent: Q{q.shape} "
+            f"K{cache.k_pool.shape} V{cache.v_pool.shape} "
+            f"table{cache.page_table.shape}"
+        )
+    if page % 128:
+        raise ValueError(f"page_size {page} must be a multiple of 128")
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(cache.lengths, jnp.int32), (b,))
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    qs = qs.reshape(b * hkv, group, d)
+    group_pad = _ceil_to(group, 16)
+    if group_pad != group:
+        qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
+
+    def kv_index(bh, j, lens_ref, tbl_ref):
+        # page translation AND past-the-prefix clamp, both on prefetched
+        # scalars: repeated physical indices make Pallas elide the DMA
+        bi = bh // hkv
+        valid = lens_ref[bi]
+        last = jnp.maximum((valid + page - 1) // page - 1, 0)
+        return (tbl_ref[bi, jnp.minimum(j, last)], bh % hkv, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * hkv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, group_pad, d),
+                         lambda bh, j, lr, tr: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, page, d), kv_index),
+            pl.BlockSpec((1, 1, page, dv), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, group_pad, dv), lambda bh, j, lr, tr: (bh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, dv), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, hkv=hkv, page=page,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (b * hkv, group_pad, dv), cache.v_pool.dtype
+        ),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * h * max_pages * page * (d + dv),
+            bytes_accessed=b * hkv * max_pages * page * (d + dv)
+            * cache.k_pool.dtype.itemsize + qs.size * qs.dtype.itemsize,
+            transcendentals=b * h * max_pages * page,
+        ),
+        interpret=interpret,
+    )(lens, cache.page_table, qs, cache.k_pool, cache.v_pool)
+
+    return out[:, :group].reshape(b, h, dv)
+
+
+def paged_append(cache: PagedKV, k_new: jax.Array,
+                 v_new: jax.Array) -> PagedKV:
+    """Write one new token per sequence (k/v (B, Hkv, 1, d)) at each
+    sequence's next slot; returns the updated cache (lengths + 1).
+
+    The slot's physical page must already be in the table (claimed by
+    the host-side `PagePool` up front).  Writing past the table's
+    capacity OR into an unclaimed (-1) table entry NaN-poisons the
+    sequence's own first page instead of corrupting a neighbor — loud
+    failure, contained to the offender.
+    """
+    page = cache.page_size
+    logical = cache.lengths // page                      # (B,)
+    slot = cache.lengths % page                          # (B,)
+    max_pages = cache.page_table.shape[1]
+    phys = jnp.take_along_axis(
+        cache.page_table, jnp.minimum(logical, max_pages - 1)[:, None],
+        axis=1,
+    )[:, 0]                                              # (B,)
+    bad = jnp.logical_or(cache.lengths >= cache.max_tokens, phys < 0)
+    # bad writes land (as NaN) in the sequence's OWN page 0 — never in
+    # another sequence's memory
+    phys = jnp.where(bad, cache.page_table[:, 0], phys)
+    k_row = jnp.where(bad[:, None, None], jnp.nan,
+                      k_new[:, :, 0, :]).astype(cache.k_pool.dtype)
+    v_row = jnp.where(bad[:, None, None], jnp.nan,
+                      v_new[:, :, 0, :]).astype(cache.v_pool.dtype)
+    k_pool = cache.k_pool.at[phys, :, slot].set(k_row)
+    v_pool = cache.v_pool.at[phys, :, slot].set(v_row)
+    return cache._replace(k_pool=k_pool, v_pool=v_pool,
+                          lengths=cache.lengths + 1)
+
+
+def paged_from_dense(k_cache: jax.Array, v_cache: jax.Array,
+                     lengths, pool: PagePool, *, num_pages: int,
+                     page_size: int = 128,
+                     total_pages_per_seq: int | None = None) -> PagedKV:
+    """Scatter dense (B, Hkv, N, d) prefill caches into a fresh page
+    pool: each sequence claims ceil(len/page) pages — or exactly
+    ``total_pages_per_seq`` (>= used) to reserve decode headroom up
+    front.  Unused table entries hold -1.  One batched scatter per
+    pool; the caller keeps the `PagePool` (and the returned table) for
+    later free()."""
+    import numpy as np
+
+    b, hkv, n, d = k_cache.shape
+    if n % page_size:
+        raise ValueError(f"capacity {n} not a multiple of {page_size}")
+    if page_size % 128:
+        raise ValueError(f"page_size {page_size} must be a 128-multiple")
+    max_pages = n // page_size
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    host_lens = np.asarray(lengths)
+    rows = np.full((b, max_pages), -1, np.int64)
+    phys_ids, src_bi, src_lp = [], [], []
+    for bi in range(b):
+        used = max(int(-(-int(host_lens[bi]) // page_size)), 1)
+        total = used if total_pages_per_seq is None else total_pages_per_seq
+        if total < used or total > max_pages:
+            raise ValueError(
+                f"total_pages_per_seq {total} outside [{used}, {max_pages}]"
+            )
+        pages = pool.alloc(total)
+        rows[bi, :total] = pages
+        phys_ids.extend(pages[:used])
+        src_bi.extend([bi] * used)
+        src_lp.extend(range(used))
+
+    # (b, max_pages, hkv, page, d) views -> one gather + one scatter
+    src_k = k_cache.reshape(b, hkv, max_pages, page_size, d).transpose(
+        0, 2, 1, 3, 4
+    )
+    src_v = v_cache.reshape(b, hkv, max_pages, page_size, d).transpose(
+        0, 2, 1, 3, 4
+    )
+    ids = jnp.asarray(phys_ids, jnp.int32)
+    sb = jnp.asarray(src_bi, jnp.int32)
+    sl = jnp.asarray(src_lp, jnp.int32)
+    k_pool = jnp.zeros((num_pages, hkv, page_size, d), k_cache.dtype)
+    v_pool = jnp.zeros((num_pages, hkv, page_size, d), v_cache.dtype)
+    k_pool = k_pool.at[ids].set(src_k[sb, sl])
+    v_pool = v_pool.at[ids].set(src_v[sb, sl])
+    return PagedKV(k_pool, v_pool, jnp.asarray(rows, jnp.int32), lengths)

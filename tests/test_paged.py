@@ -1,0 +1,154 @@
+"""Paged KV cache tests: block-table decode vs dense decode, pool
+allocation/recycling, and end-to-end paged generation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.models import TinyDecoder, generate
+from attention_tpu.models.decode import generate_paged
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.paged import (
+    PagedKV,
+    PagePool,
+    paged_append,
+    paged_flash_decode,
+    paged_from_dense,
+)
+
+
+def test_paged_decode_matches_dense(rng):
+    """Block-table reads == contiguous reads, ragged lengths, shuffled
+    physical pages."""
+    b, h, hkv, n, d = 3, 4, 2, 512, 64
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    lens = jnp.asarray([512, 129, 300], jnp.int32)
+    want = np.asarray(flash_decode(q, kc, vc, lens, block_k=128))
+
+    # scramble the allocation order so physical != logical pages
+    pool = PagePool(num_pages=16)
+    pool._free = pool._free[::-1]  # allocate high ids first
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=16)
+    assert int(cache.page_table[0, 0]) != 0  # genuinely non-identity map
+    got = np.asarray(paged_flash_decode(q, cache))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_paged_decode_softcap(rng):
+    b, h, hkv, n, d = 2, 4, 2, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    lens = jnp.asarray([256, 100], jnp.int32)
+    want = np.asarray(flash_decode(q, kc, vc, lens, block_k=128,
+                                   softcap=8.0))
+    pool = PagePool(num_pages=8)
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=8)
+    got = np.asarray(paged_flash_decode(q, cache, softcap=8.0))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_paged_append_then_decode(rng):
+    """Appending tokens through the page table == dense append."""
+    b, h, hkv, n, d = 2, 2, 2, 256, 32
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    lens = jnp.asarray([127, 130], jnp.int32)  # one about to cross a page
+    pool = PagePool(num_pages=8)
+    # reserve decode headroom up front (both sequences own both pages)
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=8,
+                             total_pages_per_seq=2)
+
+    kd, vd, dense_lens = np.asarray(kc).copy(), np.asarray(vc).copy(), lens
+    for t in range(3):
+        k_new = jnp.asarray(rng.standard_normal((b, hkv, 1, d)), jnp.float32)
+        v_new = jnp.asarray(rng.standard_normal((b, hkv, 1, d)), jnp.float32)
+        cache = paged_append(cache, k_new, v_new)
+        for bi in range(b):
+            pos = int(dense_lens[bi]) + t
+            kd[bi, :, pos] = np.asarray(k_new[bi, :, 0])
+            vd[bi, :, pos] = np.asarray(v_new[bi, :, 0])
+    new_lens = dense_lens + 3
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    want = np.asarray(flash_decode(q, jnp.asarray(kd), jnp.asarray(vd),
+                                   new_lens, block_k=128))
+    got = np.asarray(paged_flash_decode(q, cache))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_page_pool_alloc_free_recycles():
+    pool = PagePool(4)
+    a = pool.alloc(3)
+    assert pool.free_pages == 1
+    pool.free(a[:2])
+    assert pool.free_pages == 3
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc(4)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a[0]])
+
+
+@pytest.mark.parametrize("extra", [{}, dict(rope=True, softcap=8.0)])
+def test_generate_paged_matches_per_sequence_generate(rng, extra):
+    """Gold test: paged ragged generation == per-sequence generation."""
+    model = TinyDecoder(vocab=43, dim=64, depth=2, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        **extra)
+    lengths = np.asarray([12, 5, 9], np.int32)
+    prompt = rng.integers(1, 43, (3, 12)).astype(np.int32)
+    for i, ln in enumerate(lengths):
+        prompt[i, ln:] = 0
+    prompt = jnp.asarray(prompt)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    steps = 6
+    got, final_caches, pools = generate_paged(
+        model, params, prompt, jnp.asarray(lengths), steps=steps
+    )
+    got = np.asarray(got)
+    assert all(p.free_pages == 0 for p in pools)  # fully claimed
+    # completing sequence 0: its table row frees its pages back
+    row0 = [int(p) for p in np.asarray(final_caches[0].page_table[0])
+            if int(p) >= 0]
+    pools[0].free(row0)
+    assert pools[0].free_pages == len(row0)
+    for i in range(3):
+        solo = np.asarray(generate(
+            model, params, prompt[i : i + 1, : int(lengths[i])],
+            steps=steps,
+        ))
+        np.testing.assert_array_equal(got[i : i + 1], solo,
+                                      err_msg=f"sequence {i}")
+
+
+def test_paged_append_overflow_poisons(rng):
+    b, hkv, d = 1, 2, 32
+    kc = jnp.asarray(rng.standard_normal((b, hkv, 128, d)), jnp.float32)
+    pool = PagePool(2)
+    cache = paged_from_dense(kc, kc, jnp.asarray([128], jnp.int32),
+                             pool, num_pages=2)
+    new = jnp.ones((b, hkv, 1, d), jnp.float32)
+    cache = paged_append(cache, new, new)  # past max_tokens (1 page)
+    assert bool(jnp.any(jnp.isnan(cache.k_pool)))
+
+
+def test_paged_append_unclaimed_page_poisons_own_sequence(rng):
+    """Crossing into a -1 (unclaimed) table entry NaN-poisons the
+    sequence's OWN page — never a neighbor's memory."""
+    b, hkv, d = 2, 2, 32
+    kc = jnp.asarray(rng.standard_normal((b, hkv, 256, d)), jnp.float32)
+    pool = PagePool(4)
+    # seq 0 sits exactly at a page boundary with NO second page claimed
+    cache = paged_from_dense(kc, kc, jnp.asarray([128, 100], jnp.int32),
+                             pool, num_pages=4)
+    assert int(cache.page_table[0, 1]) == -1
+    neighbor_page = int(cache.page_table[1, 0])
+    before = np.asarray(cache.k_pool[neighbor_page]).copy()
+    new = jnp.ones((b, hkv, 1, d), jnp.float32)
+    cache = paged_append(cache, new, new)
+    own_page = int(cache.page_table[0, 0])
+    assert bool(jnp.any(jnp.isnan(cache.k_pool[own_page])))  # loud
+    # the healthy neighbor's page holds its append, no NaN
+    assert not bool(jnp.any(jnp.isnan(cache.k_pool[neighbor_page])))
