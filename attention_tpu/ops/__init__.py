@@ -12,6 +12,7 @@ from attention_tpu.ops.paged import (  # noqa: F401
     PagePool,
     paged_append,
     paged_flash_decode,
+    paged_fork,
     paged_from_dense,
 )
 from attention_tpu.ops.rope import apply_rope, rope_angles  # noqa: F401

@@ -72,15 +72,20 @@ class PagedKV(NamedTuple):
 
 
 class PagePool:
-    """Host-side free-list allocator over ``num_pages`` physical pages.
+    """Host-side refcounted free-list allocator over ``num_pages``
+    physical pages.
 
     Lives OUTSIDE jit (allocation happens between requests, not between
-    tokens); hands out page-id lists that become fixed-shape table rows.
+    tokens); hands out page-id lists that become fixed-shape table
+    rows.  ``incref`` supports prefix sharing: a full page referenced
+    by several sequences returns to the free list only when every
+    reference is freed.
     """
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, -1, -1))
+        self._refs = [0] * num_pages
 
     @property
     def free_pages(self) -> int:
@@ -91,15 +96,28 @@ class PagePool:
             raise RuntimeError(
                 f"page pool exhausted: want {n}, free {len(self._free)}"
             )
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def incref(self, pages) -> None:
+        """Add a reference to already-allocated pages (prefix sharing)."""
+        for p in pages:
+            if not (0 <= p < self.num_pages) or self._refs[p] == 0:
+                raise ValueError(f"incref of unallocated page {p}")
+            self._refs[p] += 1
 
     def free(self, pages) -> None:
+        """Drop one reference per page; recycle at refcount zero."""
         for p in pages:
             if not (0 <= p < self.num_pages):
                 raise ValueError(f"bad page id {p}")
-            if p in self._free:
+            if self._refs[p] == 0:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
 
     def table_row(self, pages: list[int], max_pages: int) -> jnp.ndarray:
         """Fixed-width table row; unused entries hold the -1 sentinel
@@ -177,7 +195,8 @@ def paged_flash_decode(
         interpret = _should_interpret()
     group = h // hkv
 
-    lens = jnp.broadcast_to(jnp.asarray(cache.lengths, jnp.int32), (b,))
+    lens_raw = jnp.broadcast_to(jnp.asarray(cache.lengths, jnp.int32), (b,))
+    lens = jnp.maximum(lens_raw, 0)  # poisoned rows read nothing
     qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     qs = qs.reshape(b * hkv, group, d)
     group_pad = _ceil_to(group, 16)
@@ -230,7 +249,10 @@ def paged_flash_decode(
         interpret=interpret,
     )(lens, cache.page_table, qs, cache.k_pool, cache.v_pool)
 
-    return out[:, :group].reshape(b, h, dv)
+    out = out[:, :group].reshape(b, h, dv)
+    # poisoned sequences (negative length, set by a bad append) are NaN
+    return jnp.where(lens_raw[:, None, None] < 0, jnp.nan,
+                     out.astype(jnp.float32)).astype(out.dtype)
 
 
 def paged_append(cache: PagedKV, k_new: jax.Array,
@@ -240,30 +262,34 @@ def paged_append(cache: PagedKV, k_new: jax.Array,
 
     The slot's physical page must already be in the table (claimed by
     the host-side `PagePool` up front).  Writing past the table's
-    capacity OR into an unclaimed (-1) table entry NaN-poisons the
-    sequence's own first page instead of corrupting a neighbor — loud
-    failure, contained to the offender.
+    capacity OR into an unclaimed (-1) table entry writes NOTHING
+    (drop-mode scatter — shared prefix pages stay read-only by
+    construction) and marks the sequence's length -1; the decode
+    kernel wrapper turns negative lengths into NaN outputs.  Loud,
+    contained to the offender, and sticky across further appends.
     """
     page = cache.page_size
-    logical = cache.lengths // page                      # (B,)
-    slot = cache.lengths % page                          # (B,)
+    poisoned = cache.lengths < 0
+    logical = jnp.maximum(cache.lengths, 0) // page      # (B,)
+    slot = jnp.maximum(cache.lengths, 0) % page          # (B,)
     max_pages = cache.page_table.shape[1]
     phys = jnp.take_along_axis(
         cache.page_table, jnp.minimum(logical, max_pages - 1)[:, None],
         axis=1,
     )[:, 0]                                              # (B,)
-    bad = jnp.logical_or(cache.lengths >= cache.max_tokens, phys < 0)
-    # bad writes land (as NaN) in the sequence's OWN page 0 — never in
-    # another sequence's memory
-    phys = jnp.where(bad, cache.page_table[:, 0], phys)
-    k_row = jnp.where(bad[:, None, None], jnp.nan,
-                      k_new[:, :, 0, :]).astype(cache.k_pool.dtype)
-    v_row = jnp.where(bad[:, None, None], jnp.nan,
-                      v_new[:, :, 0, :]).astype(cache.v_pool.dtype)
-    k_pool = cache.k_pool.at[phys, :, slot].set(k_row)
-    v_pool = cache.v_pool.at[phys, :, slot].set(v_row)
+    bad = (poisoned
+           | (cache.lengths >= cache.max_tokens)
+           | (phys < 0))
+    # drop-mode scatter: bad rows target one-past-the-end (a positive
+    # sentinel — negative indices would WRAP before the bounds check)
+    phys = jnp.where(bad, cache.k_pool.shape[0], phys)
+    k_row = k_new[:, :, 0, :].astype(cache.k_pool.dtype)
+    v_row = v_new[:, :, 0, :].astype(cache.v_pool.dtype)
+    k_pool = cache.k_pool.at[phys, :, slot].set(k_row, mode="drop")
+    v_pool = cache.v_pool.at[phys, :, slot].set(v_row, mode="drop")
+    new_lengths = jnp.where(bad, -1, cache.lengths + 1)
     return cache._replace(k_pool=k_pool, v_pool=v_pool,
-                          lengths=cache.lengths + 1)
+                          lengths=new_lengths)
 
 
 def paged_from_dense(k_cache: jax.Array, v_cache: jax.Array,
@@ -316,4 +342,82 @@ def paged_from_dense(k_cache: jax.Array, v_cache: jax.Array,
     v_pool = jnp.zeros((num_pages, hkv, page_size, d), v_cache.dtype)
     k_pool = k_pool.at[ids].set(src_k[sb, sl])
     v_pool = v_pool.at[ids].set(src_v[sb, sl])
+    return PagedKV(k_pool, v_pool, jnp.asarray(rows, jnp.int32), lengths)
+
+
+def paged_fork(cache: PagedKV, pool: PagePool, src_row: int,
+               n_copies: int, *, reserve_pages: int = 0) -> PagedKV:
+    """Fork sequence ``src_row`` into ``n_copies`` new sequences that
+    SHARE its full prefix pages (vLLM-style prefix sharing).
+
+    Full pages are shared by reference (``pool.incref``); the partial
+    tail page — the only page future appends can touch — is physically
+    copied per fork, so no copy-on-write is ever needed in the decode
+    loop: shared pages are read-only by construction.  Returns a cache
+    whose batch is the ``n_copies`` forks (the source row stays valid
+    in the original cache and keeps its own references).
+    ``reserve_pages`` claims that many extra private pages per fork up
+    front so decode appends have headroom.
+    """
+    import numpy as np
+
+    if n_copies < 1:
+        raise ValueError(f"n_copies must be >= 1, got {n_copies}")
+    b = cache.page_table.shape[0]
+    if not (0 <= src_row < b):
+        raise ValueError(f"src_row {src_row} outside [0, {b})")
+    page = cache.page_size
+    length = int(np.asarray(cache.lengths)[src_row])
+    if length < 0:
+        raise ValueError(f"src_row {src_row} is poisoned (length < 0)")
+    row = np.asarray(cache.page_table[src_row])
+    full = length // page
+    has_partial = (length % page) != 0
+    shared = [int(p) for p in row[:full]]
+    max_pages = cache.page_table.shape[1]
+    tail_after = full + (1 if has_partial else 0)
+    if tail_after + reserve_pages > max_pages:
+        raise ValueError(
+            f"reserve_pages {reserve_pages} overflows the table "
+            f"({tail_after} + {reserve_pages} > {max_pages})"
+        )
+
+    # claim everything first WITH rollback, so a mid-fork pool
+    # exhaustion cannot leak references or pages
+    increfs, allocs = [], []
+    rows = np.full((n_copies, max_pages), -1, np.int64)
+    try:
+        for c in range(n_copies):
+            pool.incref(shared)
+            increfs.append(shared)
+            rows[c, :full] = shared
+            nxt = full
+            if has_partial:
+                tail = pool.alloc(1)[0]
+                allocs.append(tail)
+                rows[c, full] = tail
+                nxt = full + 1
+            if reserve_pages:
+                extra = pool.alloc(reserve_pages)
+                allocs.extend(extra)
+                rows[c, nxt : nxt + reserve_pages] = extra
+    except Exception:
+        for pages in increfs:
+            pool.free(pages)
+        for p_ in allocs:
+            pool.free([p_])
+        raise
+
+    k_pool, v_pool = cache.k_pool, cache.v_pool
+    if has_partial:
+        # one batched scatter: every fork's private tail = src's tail
+        src_page = int(row[full])
+        ids = jnp.asarray(rows[:, full], jnp.int32)
+        k_pool = k_pool.at[ids].set(
+            jnp.broadcast_to(k_pool[src_page], (n_copies, *k_pool.shape[1:]))
+        )
+        v_pool = v_pool.at[ids].set(
+            jnp.broadcast_to(v_pool[src_page], (n_copies, *v_pool.shape[1:]))
+        )
+    lengths = jnp.full((n_copies,), length, jnp.int32)
     return PagedKV(k_pool, v_pool, jnp.asarray(rows, jnp.int32), lengths)

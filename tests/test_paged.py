@@ -124,14 +124,24 @@ def test_generate_paged_matches_per_sequence_generate(rng, extra):
 
 
 def test_paged_append_overflow_poisons(rng):
+    """Appending past capacity writes NOTHING and marks the sequence
+    poisoned (length -1); decode outputs NaN for it, and the state is
+    sticky across further appends."""
     b, hkv, d = 1, 2, 32
     kc = jnp.asarray(rng.standard_normal((b, hkv, 128, d)), jnp.float32)
     pool = PagePool(2)
     cache = paged_from_dense(kc, kc, jnp.asarray([128], jnp.int32),
                              pool, num_pages=2)
+    before = np.asarray(cache.k_pool).copy()
     new = jnp.ones((b, hkv, 1, d), jnp.float32)
     cache = paged_append(cache, new, new)  # past max_tokens (1 page)
-    assert bool(jnp.any(jnp.isnan(cache.k_pool)))
+    assert int(cache.lengths[0]) == -1
+    np.testing.assert_array_equal(np.asarray(cache.k_pool), before)
+    q = jnp.asarray(rng.standard_normal((b, 2, d)), jnp.float32)
+    out = paged_flash_decode(q, cache)
+    assert bool(jnp.all(jnp.isnan(out)))
+    cache = paged_append(cache, new, new)  # sticky
+    assert int(cache.lengths[0]) == -1
 
 
 def test_paged_append_unclaimed_page_poisons_own_sequence(rng):
@@ -147,8 +157,81 @@ def test_paged_append_unclaimed_page_poisons_own_sequence(rng):
     neighbor_page = int(cache.page_table[1, 0])
     before = np.asarray(cache.k_pool[neighbor_page]).copy()
     new = jnp.ones((b, hkv, 1, d), jnp.float32)
+    pool_before = np.asarray(cache.k_pool).copy()
     cache = paged_append(cache, new, new)
-    own_page = int(cache.page_table[0, 0])
-    assert bool(jnp.any(jnp.isnan(cache.k_pool[own_page])))  # loud
-    # the healthy neighbor's page holds its append, no NaN
+    # seq 0 is poisoned (nothing written anywhere on its behalf)...
+    assert int(cache.lengths[0]) == -1
+    q = jnp.asarray(rng.standard_normal((b, 2, d)), jnp.float32)
+    out = paged_flash_decode(q, cache)
+    assert bool(jnp.all(jnp.isnan(out[0])))
+    # ...while the healthy neighbor's append landed and stays clean
+    assert int(cache.lengths[1]) == 101
+    assert not bool(jnp.any(jnp.isnan(out[1])))
     assert not bool(jnp.any(jnp.isnan(cache.k_pool[neighbor_page])))
+
+
+def test_paged_fork_shares_prefix_and_isolates_appends(rng):
+    """Forked sequences share full prefix pages, copy the partial tail,
+    and appends never touch shared memory."""
+    from attention_tpu.ops.paged import paged_fork
+
+    hkv, d, page = 2, 32, 128
+    n_ctx = 300  # 2 full pages + 1 partial (44 tokens)
+    kc = jnp.asarray(rng.standard_normal((1, hkv, 512, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((1, hkv, 512, d)), jnp.float32)
+    pool = PagePool(num_pages=16)
+    base = paged_from_dense(kc, vc, jnp.asarray([n_ctx], jnp.int32),
+                            pool, num_pages=16)
+    used_before = 16 - pool.free_pages  # 3 pages
+    assert used_before == 3
+
+    forked = paged_fork(base, pool, 0, 3, reserve_pages=1)
+    # 3 forks: each copies 1 partial page + reserves 1 -> 6 new pages,
+    # full pages shared (refcounted, not duplicated)
+    assert 16 - pool.free_pages == used_before + 6
+    t0, t1 = np.asarray(base.page_table[0]), np.asarray(forked.page_table)
+    assert all((t1[c, :2] == t0[:2]).all() for c in range(3))  # shared
+    assert len({int(t1[c, 2]) for c in range(3)} | {int(t0[2])}) == 4
+
+    # forked decode == dense decode of the same 300-token context
+    q = jnp.asarray(rng.standard_normal((3, 4, d)), jnp.float32)
+    want = np.asarray(flash_decode(
+        q,
+        jnp.broadcast_to(kc, (3, hkv, 512, d)),
+        jnp.broadcast_to(vc, (3, hkv, 512, d)),
+        jnp.full((3,), n_ctx, jnp.int32), block_k=128,
+    ))
+    got = np.asarray(paged_flash_decode(q, forked))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+    # divergent appends stay private: shared pages bit-identical after
+    shared_ids = [int(p) for p in t0[:2]]
+    before = np.asarray(forked.k_pool[jnp.asarray(shared_ids)]).copy()
+    steps = 2
+    cache = forked
+    for t in range(steps):
+        k_new = jnp.asarray(rng.standard_normal((3, hkv, 1, d)), jnp.float32)
+        cache = paged_append(cache, k_new, k_new)
+    after = np.asarray(cache.k_pool[jnp.asarray(shared_ids)])
+    np.testing.assert_array_equal(before, after)
+    assert not bool(jnp.any(jnp.isnan(cache.k_pool)))
+
+    # freeing two forks keeps shared pages alive; freeing all + source
+    # recycles everything
+    for c in range(3):
+        pool.free([int(p) for p in np.asarray(cache.page_table[c])
+                   if int(p) >= 0])
+    pool.free([int(p) for p in t0 if int(p) >= 0])
+    assert pool.free_pages == 16
+
+
+def test_page_pool_incref_guards():
+    pool = PagePool(4)
+    pages = pool.alloc(2)
+    pool.incref(pages)
+    pool.free(pages)           # drops the extra ref
+    assert pool.free_pages == 2
+    pool.free(pages)           # drops the original ref -> recycled
+    assert pool.free_pages == 4
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.incref([pages[0]])
