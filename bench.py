@@ -105,6 +105,43 @@ def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
     )
 
 
+def _bench_paged_decode_s(batch: int, heads: int, kv_heads: int,
+                          cache_len: int, dim: int, repeats: int,
+                          *, page_size: int = 2048):
+    """Per-step seconds of paged flash-decode (block-table translation)
+    at a full KV cache, physical pages scrambled."""
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.paged import PagePool, paged_from_dense, \
+        paged_flash_decode
+    from attention_tpu.utils.timing import benchmark_amortized
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, heads, dim), jnp.bfloat16)
+    kc = jax.random.normal(kk, (batch, kv_heads, cache_len, dim),
+                           jnp.bfloat16)
+    vc = jax.random.normal(kv, (batch, kv_heads, cache_len, dim),
+                           jnp.bfloat16)
+    import random
+
+    num_pages = batch * (cache_len // page_size)
+    pool = PagePool(num_pages)
+    # genuine fragmentation via the public API: claim every page, then
+    # free in seeded-shuffled order so later allocs interleave
+    ids = pool.alloc(num_pages)
+    random.Random(0).shuffle(ids)
+    pool.free(ids)
+    cache = paged_from_dense(
+        kc, vc, jnp.full((batch,), cache_len, jnp.int32), pool,
+        num_pages=num_pages, page_size=page_size,
+    )
+    return benchmark_amortized(
+        lambda x, c: paged_flash_decode(x, c).astype(x.dtype),
+        q, repeats=repeats, operands=(cache,),
+    )
+
+
 def _time_serial_once(seq: int, dim: int) -> float:
     import numpy as np
 
@@ -244,6 +281,13 @@ def main(argv=None) -> int:
             "tokens_per_s": round(dec_b / dq_s, 1),
             # int8 values + 32B/row replicated fp32 scales vs bf16 values
             "hbm_vs_bf16": round((dec_d + 32) / (2 * dec_d), 2),
+        }
+        pg_s = _bench_paged_decode_s(dec_b, dec_h, dec_hkv, dec_len,
+                                     dec_d, args.repeats)
+        ladder["decode_paged_cache32k"] = {
+            "ms": round(pg_s * 1e3, 3),
+            "tokens_per_s": round(dec_b / pg_s, 1),
+            "cache_read_gb_per_s": round(cache_bytes / pg_s / 1e9, 1),
         }
         result["detail"]["ladder"] = ladder
 

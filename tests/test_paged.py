@@ -29,8 +29,13 @@ def test_paged_decode_matches_dense(rng):
     want = np.asarray(flash_decode(q, kc, vc, lens, block_k=128))
 
     # scramble the allocation order so physical != logical pages
+    # (public API: claim all, free in shuffled order)
+    import random
+
     pool = PagePool(num_pages=16)
-    pool._free = pool._free[::-1]  # allocate high ids first
+    ids = pool.alloc(16)
+    random.Random(3).shuffle(ids)
+    pool.free(ids)
     cache = paged_from_dense(kc, vc, lens, pool, num_pages=16)
     assert int(cache.page_table[0, 0]) != 0  # genuinely non-identity map
     got = np.asarray(paged_flash_decode(q, cache))
