@@ -121,16 +121,60 @@ def loss_fn(params, model: TinyDecoder, batch: jax.Array) -> jax.Array:
     return ce + aux
 
 
-def make_train_step(model: TinyDecoder, optimizer, mesh: Mesh):
+def make_train_step(model: TinyDecoder, optimizer, mesh: Mesh,
+                    *, accum_steps: int = 1):
     """Build the jitted sharded train step: (params, opt_state, batch) ->
-    (params, opt_state, loss)."""
+    (params, opt_state, loss).
+
+    ``accum_steps > 1`` splits the batch into that many microbatches
+    and accumulates gradients in a `lax.scan` before ONE optimizer
+    update — the effective batch no longer has to fit activations in
+    HBM at once.  Equal-sized microbatches keep the mean-loss gradient
+    exactly equal to the unaccumulated step (up to fp summation order)
+    for dense models; MoE aux losses are computed per microbatch (their
+    router statistics are nonlinear in the batch), so accumulation
+    regularizes per-microbatch balance rather than full-batch balance.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     batch_spec = NamedSharding(mesh, P("dp", "sp"))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch):
         batch = jax.lax.with_sharding_constraint(batch, batch_spec)
-        loss, grads = jax.value_and_grad(loss_fn)(params, model, batch)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, model, batch)
+        else:
+            b = batch.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum_steps}"
+                )
+            micro = batch.reshape(accum_steps, b // accum_steps,
+                                  *batch.shape[1:])
+
+            def acc_one(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, model, mb)
+                grad_sum = jax.tree_util.tree_map(
+                    jnp.add, grad_sum, grads
+                )
+                return (loss_sum + loss, grad_sum), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                acc_one, (jnp.float32(0.0), zeros), micro
+            )
+            loss = loss_sum / accum_steps
+            # back to each param leaf's grad dtype, matching what the
+            # unaccumulated path hands the optimizer
+            grads = jax.tree_util.tree_map(
+                lambda g, p_: (g / accum_steps).astype(p_.dtype),
+                grad_sum, params,
+            )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

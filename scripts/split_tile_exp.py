@@ -1,0 +1,131 @@
+"""Experiment: split-tile flash kernel — issue both half-tile QK dots
+before the softmax updates so Mosaic can overlap VPU softmax work with
+the second MXU matmul.  Compares against the production kernel at the
+headline shape.  Not wired into the library; promoted only if it wins
+reliably."""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu.ops.flash import (
+    _LOG2E,
+    _STAT_LANES,
+    NEG_INF,
+    _compiler_params,
+)
+from attention_tpu.utils.timing import benchmark_amortized
+
+
+def _softmax_update(s, m_scr, l_scr):
+    m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)
+    l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    corr = jnp.exp2(m_prev - m_next)
+    p = jnp.exp2(s - m_next)
+    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+    return p, corr
+
+
+def _split_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
+                  *, block_k: int, halves: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    q = q_ref[...]
+    half = block_k // halves
+    ks = [k_ref[i * half:(i + 1) * half] for i in range(halves)]
+    vs = [v_ref[i * half:(i + 1) * half] for i in range(halves)]
+    # issue ALL the score matmuls first: they are mutually independent,
+    # so the scheduler may overlap softmax (VPU) of half i with the
+    # dot (MXU) of half i+1
+    ss = [
+        jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        for kk in ks
+    ]
+    for s, vv in zip(ss, vs):
+        p, corr = _softmax_update(s, m_scr, l_scr)
+        pv = jax.lax.dot_general(
+            p.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[...] = acc[...] * corr + pv
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _fin():
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        o_ref[...] = (acc[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def split_flash(q, k, v, *, block_q=256, block_k=1024, halves=2):
+    m, d = q.shape
+    n = k.shape[0]
+    scale = 1.0 / d ** 0.5
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    grid = (m // block_q, n // block_k)
+    return pl.pallas_call(
+        functools.partial(_split_kernel, block_k=block_k, halves=halves),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_k, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_k, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, d), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, d), v.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+    )(qs, k, v)
+
+
+def main():
+    from attention_tpu.ops.flash import flash_attention
+    from attention_tpu.utils.flops import attention_flops, peak_flops
+
+    seq, d = 32768, 128
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (seq, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (seq, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (seq, d), jnp.bfloat16)
+    fl = attention_flops(seq, seq, d, d)
+    peak = peak_flops()
+
+    import numpy as np
+    base = np.asarray(flash_attention(q, k, v), np.float32)
+    for halves in (1, 2, 4):
+        got = np.asarray(split_flash(q, k, v, halves=halves), np.float32)
+        err = float(np.max(np.abs(got - base)))
+        t = benchmark_amortized(
+            lambda a, b, c: split_flash(a, b, c, halves=halves),
+            q, repeats=10, operands=(k, v),
+        )
+        print(f"halves={halves}: {t*1e3:.3f} ms util {fl/t/peak:.3f} "
+              f"(err vs prod {err:.2e})")
+    t = benchmark_amortized(lambda a, b, c: flash_attention(a, b, c),
+                            q, repeats=10, operands=(k, v))
+    print(f"production: {t*1e3:.3f} ms util {fl/t/peak:.3f}")
+
+
+if __name__ == "__main__":
+    main()
