@@ -86,6 +86,25 @@ def _param_spec(path: tuple, value: Any) -> P:
     return P()
 
 
+def _fsdp_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Additionally shard the largest still-unsharded dim over 'dp'
+    (ZeRO-3 style fully-sharded params: each dp replica holds a slice;
+    XLA all-gathers at use and reduce-scatters the grads)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if "dp" in entries:
+        return spec
+    cands = [
+        (shape[i], i)
+        for i, ax in enumerate(entries)
+        if ax is None and shape[i] % mesh.shape["dp"] == 0
+    ]
+    if not cands:
+        return spec
+    _, i = max(cands)
+    entries[i] = "dp"
+    return P(*entries)
+
+
 def _legal_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
     """Drop sharding on any dim the mesh axis doesn't divide (e.g. a
     single shared KV head can't be split over tp) — replicate instead."""
@@ -97,13 +116,20 @@ def _legal_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
     return P(*fixed)
 
 
-def shard_params(params, mesh: Mesh):
-    return jax.tree_util.tree_map_with_path(
-        lambda path, x: jax.device_put(
-            x, NamedSharding(mesh, _legal_spec(_param_spec(path, x), x.shape, mesh))
-        ),
-        params,
-    )
+def shard_params(params, mesh: Mesh, *, fsdp: bool = False):
+    """Lay params out per the tp table; ``fsdp=True`` additionally
+    shards each param's largest free dim over 'dp' (ZeRO-3 style:
+    per-replica parameter/optimizer memory drops ~dp-fold; XLA inserts
+    the use-site all-gathers and grad reduce-scatters)."""
+
+    def place(path, x):
+        spec = _legal_spec(_param_spec(path, x), x.shape, mesh)
+        if fsdp:
+            # _fsdp_spec only adds 'dp' on dims it verified divisible
+            spec = _fsdp_spec(spec, x.shape, mesh)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
 
 
 def loss_fn(params, model: TinyDecoder, batch: jax.Array) -> jax.Array:
@@ -190,12 +216,15 @@ def init_sharded(
     seq: int = 128,
     seed: int = 0,
     lr: float = 1e-3,
+    fsdp: bool = False,
 ):
-    """Initialize params + optimizer state, both mesh-sharded."""
+    """Initialize params + optimizer state, both mesh-sharded.
+    ``fsdp=True`` fully shards params (and thus the adamw moments)
+    over the dp axis as well — see :func:`shard_params`."""
     rng = jax.random.PRNGKey(seed)
     tokens = jnp.zeros((batch, seq), jnp.int32)
     params = model.init(rng, tokens)["params"]
-    params = shard_params(params, mesh)
+    params = shard_params(params, mesh, fsdp=fsdp)
     optimizer = optax.adamw(lr)
     opt_state = optimizer.init(params)
     # moment buffers (zeros_like(params)) inherit the params shardings;

@@ -22,20 +22,9 @@ from attention_tpu.ops.flash import (
     _STAT_LANES,
     NEG_INF,
     _compiler_params,
+    _online_softmax_update,
 )
 from attention_tpu.utils.timing import benchmark_amortized
-
-
-def _softmax_update(s, m_scr, l_scr):
-    m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)
-    l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
-    m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    corr = jnp.exp2(m_prev - m_next)
-    p = jnp.exp2(s - m_next)
-    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
-    return p, corr
 
 
 def _split_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
@@ -61,7 +50,7 @@ def _split_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr,
         for kk in ks
     ]
     for s, vv in zip(ss, vs):
-        p, corr = _softmax_update(s, m_scr, l_scr)
+        p, corr = _online_softmax_update(s, m_scr, l_scr, masked=False)
         pv = jax.lax.dot_general(
             p.astype(vv.dtype), vv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
