@@ -204,8 +204,6 @@ def test_grad_accumulation_validates(rng):
 def test_fsdp_sharding_trains_and_matches_replicated(rng):
     """fsdp=True: params sharded over dp too; the train step still
     produces the same loss trajectory as replicated params."""
-    from jax.sharding import NamedSharding
-
     from attention_tpu.models.train import (
         init_sharded,
         make_mesh_3d,
