@@ -25,6 +25,33 @@ _lib: ctypes.CDLL | None = None
 _build_error: str | None = None
 
 
+def _compile(srcs: list[str], out_path: str, *, shared: bool) -> bool:
+    """Try cc/gcc/clang in order; build to a pid-private temp and
+    atomically rename.  Returns False (and cleans the temp) when no
+    compiler works, a compiler hangs, or it errors."""
+    tmp_path = f"{out_path}.{os.getpid()}.tmp"
+    flags = ["-O3", "-march=native"]
+    if shared:
+        flags += ["-shared", "-fPIC"]
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            subprocess.run(
+                [cc, *flags, *srcs, "-o", tmp_path, "-lm"],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp_path, out_path)
+            return True
+        except (FileNotFoundError, subprocess.CalledProcessError,
+                subprocess.TimeoutExpired):
+            if os.path.exists(tmp_path):
+                try:
+                    os.remove(tmp_path)
+                except OSError:
+                    pass
+            continue
+    return False
+
+
 def _build_and_load() -> ctypes.CDLL | None:
     global _lib, _build_error
     with _lock:
@@ -37,29 +64,11 @@ def _build_and_load() -> ctypes.CDLL | None:
             if not os.path.exists(lib_path) or os.path.getmtime(
                 lib_path
             ) < os.path.getmtime(src):
-                # build to a process-private temp path, then atomically
-                # rename: concurrent builders never load a half-written .so
-                tmp_path = f"{lib_path}.{os.getpid()}.tmp"
-                for cc in ("cc", "gcc", "clang"):
-                    try:
-                        subprocess.run(
-                            [
-                                cc, "-O3", "-march=native", "-shared", "-fPIC",
-                                src, "-o", tmp_path, "-lm",
-                            ],
-                            check=True,
-                            capture_output=True,
-                            timeout=120,
-                        )
-                        os.replace(tmp_path, lib_path)
-                        break
-                    except (FileNotFoundError, subprocess.CalledProcessError):
-                        continue
-                else:
+                if not _compile([src], lib_path, shared=True):
                     _build_error = "no working C compiler found"
                     return None
             lib = ctypes.CDLL(lib_path)
-        except OSError as e:  # load failure
+        except OSError as e:  # load failure / missing sources
             _build_error = str(e)
             return None
 
@@ -161,3 +170,24 @@ def read_testcase_native(path: str):
     if rc != 0:
         raise ValueError(f"invalid testcase data in {path} (rc={rc})")
     return TestCase(q=q, k=k, v=v, expected=expected)
+
+
+_CLI_NAME = "attention_serial_cli"
+
+
+def native_cli_path() -> str | None:
+    """Build (if needed) and return the standalone native harness binary
+    (`csrc/attention_main.c` — the reference's `./attention <case.bin>`
+    CLI contract).  None when sources or a working C compiler are
+    unavailable."""
+    csrc = os.path.abspath(_CSRC)
+    src_main = os.path.join(csrc, "attention_main.c")
+    src_lib = os.path.join(csrc, "attention_serial.c")
+    out = os.path.join(csrc, _CLI_NAME)
+    try:
+        newest = max(os.path.getmtime(src_main), os.path.getmtime(src_lib))
+    except OSError:
+        return None
+    if os.path.exists(out) and os.path.getmtime(out) >= newest:
+        return out
+    return out if _compile([src_main, src_lib], out, shared=False) else None

@@ -1,0 +1,81 @@
+/* Standalone native harness: the reference CLI contract in C.
+ *
+ * Mirrors `attention.c:164-196` exactly: `./attention_serial <case.bin>`
+ * reads the binary testcase (4x int32 dims header, then Q/K/V fp64, then
+ * the expected output appended after V — attention.c:84-121,139), runs
+ * the serial fp64 online-softmax attention, verifies elementwise against
+ * |delta| <= 0.02 (attention.c:143; every element NaN-checked — the
+ * reference's column-1-only quirk at attention.c:150 is fixed here), and
+ * prints "Correct!"/"Wrong!" plus elapsed microseconds
+ * (clock_gettime(CLOCK_MONOTONIC), attention.c:179-186).
+ *
+ * Build: cc -O3 -march=native attention_main.c attention_serial.c -lm
+ *        -o attention_serial_cli       (done by core/native.py on use)
+ */
+
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+/* from attention_serial.c */
+void attn_serial(const double *Q, const double *K, const double *V,
+                 double *out, int64_t m, int64_t n, int64_t dk, int64_t dv,
+                 double scale);
+int attn_read_testcase(const char *path, int32_t *dims, double *Q,
+                       double *K, double *V, double *expected);
+int64_t attn_verify(const double *result, const double *expected,
+                    int64_t count, double tol);
+
+int main(int argc, char **argv) {
+    if (argc != 2) {
+        fprintf(stderr, "usage: %s <testcase.bin>\n", argv[0]);
+        return 2;
+    }
+    /* pass 1: header only (NULL buffers skip the data sections) */
+    int32_t dims[4];
+    int rc = attn_read_testcase(argv[1], dims, NULL, NULL, NULL, NULL);
+    if (rc != 0) {
+        fprintf(stderr, "failed to read %s (rc=%d)\n", argv[1], rc);
+        return 1;
+    }
+    size_t m = (size_t)dims[0], n = (size_t)dims[1];
+    size_t dk = (size_t)dims[2], dv = (size_t)dims[3];
+    /* reject header dims whose element counts would wrap size_t (a
+     * corrupt/hostile file): each section must stay under SIZE_MAX/8 */
+    size_t limit = ((size_t)-1) / sizeof(double);
+    if (m > limit / (dk ? dk : 1) || n > limit / (dk ? dk : 1) ||
+        n > limit / (dv ? dv : 1) || m > limit / (dv ? dv : 1)) {
+        fprintf(stderr, "unreasonable dims in %s\n", argv[1]);
+        return 1;
+    }
+    double *q = malloc(m * dk * sizeof(double));
+    double *k = malloc(n * dk * sizeof(double));
+    double *v = malloc(n * dv * sizeof(double));
+    double *expected = malloc(m * dv * sizeof(double));
+    double *out = malloc(m * dv * sizeof(double));
+    if (!q || !k || !v || !expected || !out) {
+        fprintf(stderr, "alloc failure\n");
+        return 1;
+    }
+    rc = attn_read_testcase(argv[1], dims, q, k, v, expected);
+    if (rc != 0) {
+        fprintf(stderr, "failed to read %s (rc=%d)\n", argv[1], rc);
+        return 1;
+    }
+
+    struct timespec beg, end;
+    clock_gettime(CLOCK_MONOTONIC, &beg);
+    attn_serial(q, k, v, out, (int64_t)m, (int64_t)n, (int64_t)dk,
+                (int64_t)dv, -1.0 /* default 1/sqrt(dk) */);
+    clock_gettime(CLOCK_MONOTONIC, &end);
+
+    int64_t bad = attn_verify(out, expected, (int64_t)(m * dv), 0.02);
+    double us = (end.tv_sec - beg.tv_sec) * 1e6 +
+                (end.tv_nsec - beg.tv_nsec) * 1e-3;
+    printf(bad < 0 ? "Correct!\n" : "Wrong!\n");
+    printf("Elapsed time: %.2f us\n", us);
+    free(q); free(k); free(v); free(expected); free(out);
+    return bad < 0 ? 0 : 1;
+}

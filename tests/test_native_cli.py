@@ -137,3 +137,38 @@ def test_cli_backends_list():
     r = _run_cli("backends")
     assert r.returncode == 0
     assert "flash" in r.stdout and "kv-sharded" in r.stdout
+
+
+def test_standalone_native_binary_matches_reference_contract(tmp_path):
+    """The compiled C harness (csrc/attention_main.c) runs the full
+    reference CLI contract: read .bin -> serial fp64 attention ->
+    verify +-0.02 -> "Correct!" + elapsed us."""
+    import subprocess
+
+    from attention_tpu.core import generate_testcase, write_testcase
+    from attention_tpu.core.native import native_cli_path
+
+    path = native_cli_path()
+    if path is None:
+        pytest.skip("no C compiler available")
+    case = generate_testcase(48, 80, 24, 40, seed=11)
+    f = tmp_path / "case.bin"
+    write_testcase(f, case)
+    out = subprocess.run([path, str(f)], capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Correct!" in out.stdout
+    assert "Elapsed time:" in out.stdout
+
+    # corrupting the expected section must flip the verdict
+    import numpy as np
+
+    raw = bytearray(f.read_bytes())
+    # last fp64 of the file belongs to the expected output: break it
+    raw[-8:] = np.float64(1e9).tobytes()
+    g = tmp_path / "bad.bin"
+    g.write_bytes(bytes(raw))
+    out = subprocess.run([path, str(g)], capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 1
+    assert "Wrong!" in out.stdout
