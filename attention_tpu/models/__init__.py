@@ -11,6 +11,7 @@ from attention_tpu.models.pipeline import (  # noqa: F401
     make_pipelined_train_step,
     pipelined_forward,
 )
+from attention_tpu.models.resilient import train_with_recovery  # noqa: F401
 from attention_tpu.models.speculative import generate_speculative  # noqa: F401
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
 from attention_tpu.models.decode import (  # noqa: F401

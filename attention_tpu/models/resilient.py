@@ -1,0 +1,84 @@
+"""Resumable training: the failure-recovery loop the reference lacks.
+
+SURVEY §5 marks failure detection/elastic recovery absent upstream
+(`exit(1)` on bad input is the reference's entire failure story).  This
+supplies the standard single-controller recovery pattern: train from
+the latest checkpoint (or scratch), checkpoint every ``ckpt_every``
+steps, and after ANY process death simply re-invoke — the loop detects
+the newest checkpoint and continues exactly where it left off.
+Determinism comes from ``batch_fn(step)``: data is a pure function of
+the global step, so an interrupted-and-resumed run reproduces the
+uninterrupted one bit-for-bit on the same hardware.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import jax
+
+from attention_tpu.models.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from attention_tpu.models.train import init_sharded, make_train_step
+from attention_tpu.models.transformer import TinyDecoder
+
+
+def train_with_recovery(
+    model: TinyDecoder,
+    mesh,
+    batch_fn: Callable[[int], jax.Array],  # step -> (B, S+1) int32
+    *,
+    steps: int,
+    ckpt_dir: str | os.PathLike,
+    ckpt_every: int = 10,
+    batch: int = 8,
+    seq: int = 128,
+    seed: int = 0,
+    lr: float = 1e-3,
+    accum_steps: int = 1,
+    fsdp: bool = False,
+    on_step: Callable[[int, float], None] | None = None,
+):
+    """Run (or resume) training to ``steps``; returns
+    ``(params, opt_state, losses)`` where ``losses`` covers only the
+    steps executed by THIS invocation.
+
+    ``on_step(step, loss)`` fires after each optimizer update (fault
+    injection in tests, logging/metrics in real use).  Crash anywhere —
+    including between a checkpoint and the next — and re-invoking
+    replays from the last checkpoint; with step-deterministic
+    ``batch_fn`` the final state matches the uninterrupted run (exactly,
+    up to any nondeterminism in the backend's reductions — the test
+    asserts tight allclose).  ``fsdp`` must match the value the
+    checkpoints were written with, or restored params lose (or gain)
+    their dp-axis sharding.
+    """
+    if ckpt_every < 1:
+        raise ValueError(f"ckpt_every must be >= 1, got {ckpt_every}")
+    params, optimizer, opt_state = init_sharded(
+        model, mesh, batch=batch, seq=seq, seed=seed, lr=lr, fsdp=fsdp
+    )
+    start = 0
+    last = latest_step(ckpt_dir)
+    if last is not None:
+        params, opt_state, start = restore_checkpoint(
+            ckpt_dir, params, opt_state, step=last
+        )
+    step_fn = make_train_step(model, optimizer, mesh,
+                              accum_steps=accum_steps)
+    losses = []
+    for step in range(start, steps):
+        tokens = batch_fn(step)
+        params, opt_state, loss = step_fn(params, opt_state, tokens)
+        loss = float(loss)
+        losses.append(loss)
+        done = step + 1
+        if done % ckpt_every == 0 or done == steps:
+            save_checkpoint(ckpt_dir, done, params, opt_state)
+        if on_step is not None:
+            on_step(step, loss)
+    return params, opt_state, losses

@@ -1,0 +1,66 @@
+"""Worker for the crash-resume test: trains with recovery, optionally
+dying ABRUPTLY (os._exit — no cleanup, no final checkpoint) after N
+steps of this invocation.  Launched by tests/test_resilient.py; not
+collected by pytest (no test_ prefix).
+
+argv: ckpt_dir steps ckpt_every crash_after out_npz
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from attention_tpu.models.resilient import train_with_recovery  # noqa: E402
+from attention_tpu.models.train import make_mesh_3d  # noqa: E402
+from attention_tpu.models.transformer import TinyDecoder  # noqa: E402
+
+
+def main() -> int:
+    ckpt_dir, steps, every, crash_after, out_npz = sys.argv[1:6]
+    steps, every = int(steps), int(every)
+    crash_after = int(crash_after)
+
+    mesh = make_mesh_3d(8)
+    model = TinyDecoder(vocab=64, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="xla", dtype=jnp.float32)
+    batch = max(4, mesh.shape["dp"])
+    seq = 32 * mesh.shape["sp"]
+
+    def batch_fn(step: int) -> jax.Array:
+        rng = np.random.default_rng(1000 + step)  # pure function of step
+        return jnp.asarray(rng.integers(0, 64, (batch, seq + 1)), jnp.int32)
+
+    executed = [0]
+
+    def on_step(step: int, loss: float) -> None:
+        executed[0] += 1
+        if crash_after > 0 and executed[0] >= crash_after:
+            os._exit(17)  # simulated hard crash: no cleanup, no ckpt
+
+    params, _, losses = train_with_recovery(
+        model, mesh, batch_fn, steps=steps, ckpt_dir=ckpt_dir,
+        ckpt_every=every, batch=batch, seq=seq, seed=5, on_step=on_step,
+    )
+    flat = np.concatenate(
+        [np.ravel(np.asarray(x))
+         for x in jax.tree_util.tree_leaves(params)]
+    )
+    np.savez(out_npz, losses=np.asarray(losses), params=flat)
+    print("worker done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

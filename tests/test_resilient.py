@@ -1,0 +1,53 @@
+"""Crash-resume test: a REAL process death (os._exit, no cleanup) mid-
+training, then a fresh invocation that detects the latest checkpoint
+and continues — final state must match an uninterrupted run bit-for-bit
+(step-deterministic data on CPU)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _run(ckpt_dir, steps, every, crash_after, out_npz):
+    worker = os.path.join(os.path.dirname(__file__), "resilient_worker.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, worker, str(ckpt_dir), str(steps), str(every),
+         str(crash_after), str(out_npz)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+
+
+def test_crash_midway_then_resume_matches_uninterrupted(tmp_path):
+    steps, every = 6, 2
+
+    # reference: uninterrupted run
+    ref = _run(tmp_path / "ref_ckpt", steps, every, 0,
+               tmp_path / "ref.npz")
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+
+    # crashed run: dies abruptly after 3 steps (last checkpoint: step 2)
+    crashed = _run(tmp_path / "ckpt", steps, every, 3, tmp_path / "x.npz")
+    assert crashed.returncode == 17, crashed.stdout + crashed.stderr
+    assert not (tmp_path / "x.npz").exists()
+    assert os.path.isdir(tmp_path / "ckpt" / "2")
+    assert not os.path.isdir(tmp_path / "ckpt" / "4")
+
+    # re-invoke: resumes from step 2 and finishes
+    resumed = _run(tmp_path / "ckpt", steps, every, 0, tmp_path / "r.npz")
+    assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+
+    ref_d = np.load(tmp_path / "ref.npz")
+    res_d = np.load(tmp_path / "r.npz")
+    # the resumed invocation executed steps 2..5; its losses must equal
+    # the tail of the uninterrupted run's
+    assert len(res_d["losses"]) == steps - 2
+    np.testing.assert_allclose(res_d["losses"], ref_d["losses"][2:],
+                               rtol=1e-6)
+    np.testing.assert_allclose(res_d["params"], ref_d["params"],
+                               rtol=1e-6, atol=1e-7)
