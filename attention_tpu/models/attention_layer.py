@@ -70,18 +70,18 @@ class QuantKVCache(NamedTuple):
 
 
 class RollingKVCache(NamedTuple):
-    """Ring-buffer cache for sliding-window models: capacity is the
-    window (rounded up to 128), NOT the sequence length, so decode
-    memory is bounded however long generation runs.
+    """Ring-buffer cache for sliding-window models (optionally with
+    StreamingLLM attention sinks): memory is bounded by sinks + window,
+    NOT the sequence length, however long generation runs.
 
-    Correctness rests on softmax being permutation-invariant over KV
-    rows: slots hold the last ``capacity`` tokens in wrapped order, and
-    the decode kernel attends over every valid slot without caring
-    about their order.  The effective window is the capacity (the
-    requested window rounded up to the 128-slot granule).
-
-    ``length`` counts total tokens seen (it keeps growing past
-    capacity; the slot for the next token is ``length % capacity``).
+    Slot layout: pinned sink slots ``[0, sinks)`` hold the first
+    ``sinks`` tokens forever; ring slots ``[sinks, sinks + window)``
+    hold the last ``window`` tokens in wrapped order (token t sits at
+    ``sinks + (t - sinks) % window`` once past the sinks).  Capacity
+    rounds ``sinks + window`` up to the decode kernel's 128-row
+    granule; tail slots are never written and reads mask by the valid
+    count.  Correctness rests on softmax being permutation-invariant
+    over KV rows.  ``length`` counts total tokens seen.
     """
 
     k: jax.Array  # (B, Hkv, C, dh)
@@ -90,14 +90,15 @@ class RollingKVCache(NamedTuple):
 
     @classmethod
     def create(cls, batch: int, num_kv_heads: int, window: int,
-               head_dim: int, dtype=jnp.bfloat16) -> "RollingKVCache":
+               head_dim: int, dtype=jnp.bfloat16,
+               sinks: int = 0) -> "RollingKVCache":
         if window % 128:
             raise ValueError(
                 f"rolling caches require window % 128 == 0 (got {window}): "
                 "a rounded-up capacity would give prefill and decode "
                 "different effective windows"
             )
-        cap = window
+        cap = cls.capacity_for(window, sinks)
         shape = (batch, num_kv_heads, cap, head_dim)
         return cls(
             k=jnp.zeros(shape, dtype),
@@ -108,6 +109,13 @@ class RollingKVCache(NamedTuple):
     @property
     def capacity(self) -> int:
         return self.k.shape[2]
+
+    @staticmethod
+    def capacity_for(window: int, sinks: int = 0) -> int:
+        """sinks pinned slots + window ring slots, rounded up to the
+        decode kernel's 128-row granule (tail slots stay unused — reads
+        mask by the valid count, which never exceeds sinks + window)."""
+        return -(-(window + sinks) // 128) * 128
 
 
 class RaggedKVCache(NamedTuple):
@@ -137,7 +145,7 @@ class RaggedKVCache(NamedTuple):
         return cls(cache.k, cache.v, jnp.asarray(lengths, jnp.int32))
 
 
-def _xla_mha(q, k, v, *, causal, window=None, softcap=None):
+def _xla_mha(q, k, v, *, causal, window=None, softcap=None, sinks=0):
     """Dense attention on (B, H, S, dh) with GQA head repeat; differentiable
     and auto-partitionable by XLA under pjit shardings."""
     if not causal:
@@ -149,16 +157,37 @@ def _xla_mha(q, k, v, *, causal, window=None, softcap=None):
     # causal = the start=0, fully-valid instance of the cached mask
     return _xla_cached_attention(q, k, v, start=0, new_len=k.shape[2],
                                  causal=True, window=window,
-                                 softcap=softcap)
+                                 softcap=softcap, sinks=sinks)
 
 
-def _flash_mha(q, k, v, *, causal, window=None, softcap=None):
+def _flash_mha(q, k, v, *, causal, window=None, softcap=None, sinks=0):
+    if sinks:
+        # inference-side feature: the backward kernels do not implement
+        # the sink mask.  Forward works; differentiating raises a CLEAR
+        # error instead of pallas' opaque NotImplementedError.
+        @jax.custom_vjp
+        def fwd_only(q, k, v):
+            return flash_attention(q, k, v, causal=causal, window=window,
+                                   softcap=softcap, sinks=sinks)
+
+        def _f(q, k, v):
+            return fwd_only(q, k, v), None
+
+        def _b(_res, _g):
+            raise ValueError(
+                "attn_sinks are inference-only: the flash backward "
+                "kernels do not implement the sink mask (use "
+                "impl='xla' to train a sink model)"
+            )
+
+        fwd_only.defvjp(_f, _b)
+        return fwd_only(q, k, v)
     return flash_attention_diff(q, k, v, causal=causal, window=window,
                                 softcap=softcap)
 
 
 def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
-                          window=None, softcap=None):
+                          window=None, softcap=None, sinks=0):
     """Dense cached attention over (B, H, S, dh) vs full-capacity caches
     (B, Hkv, N, dh), masked to the valid prefix.  Pure einsums — XLA
     auto-partitions it under pjit shardings, the serving analog of
@@ -178,7 +207,10 @@ def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
         row = jnp.arange(q.shape[2])[:, None]
         mask = jnp.logical_and(mask, col <= row + start)
         if window is not None:
-            mask = jnp.logical_and(mask, col >= row + start - (window - 1))
+            win = col >= row + start - (window - 1)
+            if sinks:
+                win = jnp.logical_or(win, col < sinks)
+            mask = jnp.logical_and(mask, win)
     s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
     return jnp.einsum("bhmn,bhnd->bhmd", p, vc)
@@ -202,6 +234,7 @@ class GQASelfAttention(nn.Module):
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     window: int | None = None  # sliding-window attention (requires causal)
+    attn_sinks: int = 0  # StreamingLLM sinks: first k positions stay attendable
     rope: bool = False  # rotary position embeddings on Q/K
     rope_theta: float = 10000.0
     softcap: float | None = None  # logit soft-capping (Gemma-2 style)
@@ -243,10 +276,17 @@ class GQASelfAttention(nn.Module):
                 raise ValueError("window requires causal=True")
             if self.window < 1:
                 raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.attn_sinks and self.window is None:
+            raise ValueError("attn_sinks require a windowed model")
+        if self.attn_sinks < 0:
+            raise ValueError(
+                f"attn_sinks must be >= 0, got {self.attn_sinks}"
+            )
         if cache is None:
             out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,
                                         window=self.window,
-                                        softcap=self.softcap)
+                                        softcap=self.softcap,
+                                        sinks=self.attn_sinks)
         elif isinstance(cache, QuantKVCache):
             out, cache = self._quantized_decode(q, k, v, cache)
         elif isinstance(cache, RaggedKVCache):
@@ -290,7 +330,7 @@ class GQASelfAttention(nn.Module):
             out = _xla_cached_attention(
                 q, kc, vc, start=cache.length, new_len=new_len,
                 causal=self.causal, window=self.window,
-                softcap=self.softcap,
+                softcap=self.softcap, sinks=self.attn_sinks,
             )
         elif s_new == 1 and self.window is None:
             out = flash_decode(q[:, :, 0, :], kc, vc, new_len,
@@ -303,6 +343,7 @@ class GQASelfAttention(nn.Module):
                 q, kc, vc, causal=self.causal,
                 q_offset=cache.length, kv_valid=new_len, window=self.window,
                 softcap=self.softcap,
+                sinks=self.attn_sinks or None,
             )
         # Overflowing the cache would silently clamp the write index
         # (dynamic_update_slice semantics) and corrupt attention; make it
@@ -311,13 +352,15 @@ class GQASelfAttention(nn.Module):
         return out, KVCache(kc, vc, new_len)
 
     def _rolling_attention(self, q, k, v, cache: RollingKVCache):
-        """Bounded-memory sliding-window serving on the ring buffer.
+        """Bounded-memory sliding-window (+sinks) serving on the ring
+        buffer — see `RollingKVCache` for the slot layout.
 
-        S == 1 (decode): write the new row at ``length % capacity`` and
-        attend over the valid slots with the fused decode kernel (slot
-        order is irrelevant to softmax).  S > 1 (prefill) assumes a
-        FRESH cache: the chunk attends only to itself (causal +
-        window), and its last ``capacity`` rows seed the buffer.
+        S == 1 (decode): write the new row at its slot (pinned for the
+        first ``sinks`` tokens, ring otherwise) and attend over the
+        valid slots with the fused decode kernel (slot order is
+        irrelevant to softmax).  S > 1 (prefill) assumes a FRESH cache:
+        the chunk attends only to itself (causal + window + sinks);
+        the first ``sinks`` and last ``window`` rows seed the buffer.
         """
         if self.impl != "flash":
             raise ValueError(
@@ -326,21 +369,30 @@ class GQASelfAttention(nn.Module):
             )
         if self.window is None:
             raise ValueError("RollingKVCache requires a windowed model")
-        cap = cache.capacity
-        if cap != self.window:
+        sinks = self.attn_sinks
+        ring = self.window
+        expect_cap = RollingKVCache.capacity_for(ring, sinks)
+        if cache.capacity != expect_cap:
             raise ValueError(
-                f"rolling capacity {cap} != window {self.window}"
+                f"rolling capacity {cache.capacity} != expected "
+                f"{expect_cap} (window {ring} + sinks {sinks}, rounded "
+                "to the 128-slot granule)"
             )
         s_new = q.shape[2]
+        zero = jnp.zeros((), jnp.int32)
         if s_new == 1:
-            slot = jnp.mod(cache.length, cap)
+            t = cache.length
+            # pinned sink slots [0, sinks); ring slots [sinks, sinks+ring)
+            slot = jnp.where(
+                t < sinks, t, sinks + jnp.mod(t - sinks, ring)
+            ) if sinks else jnp.mod(t, ring)
             kc = jax.lax.dynamic_update_slice(
                 cache.k, k.astype(cache.k.dtype), (0, 0, slot, 0)
             )
             vc = jax.lax.dynamic_update_slice(
                 cache.v, v.astype(cache.v.dtype), (0, 0, slot, 0)
             )
-            valid = jnp.minimum(cache.length + 1, cap)
+            valid = jnp.minimum(cache.length + 1, sinks + ring)
             out = flash_decode(q[:, :, 0, :], kc, vc, valid,
                                softcap=self.softcap)[:, :, None, :]
         else:
@@ -348,32 +400,46 @@ class GQASelfAttention(nn.Module):
             # non-fresh cache would silently drop in-window history, so
             # poison that case loudly (the convention of this module).
             out = flash_attention(q, k, v, causal=True, window=self.window,
-                                  softcap=self.softcap)
+                                  softcap=self.softcap,
+                                  sinks=sinks or None)
             out = jnp.where(cache.length == 0, out, jnp.nan).astype(out.dtype)
-            keep = min(s_new, cap)
-            # rows land rotated so the invariant 'next slot = length %
-            # cap' holds: token j sits at slot j % cap.  split is static
-            # (fresh cache), giving 1-2 contiguous dynamic_update_slice
-            # writes instead of a TPU-hostile index-array scatter.
-            rows_k = k[:, :, -keep:].astype(cache.k.dtype)
-            rows_v = v[:, :, -keep:].astype(cache.v.dtype)
-            split = (s_new - keep) % cap
-            zero = jnp.zeros((), jnp.int32)
             kc, vc = cache.k, cache.v
-            first = cap - split
-            kc = jax.lax.dynamic_update_slice(
-                kc, rows_k[:, :, :first], (zero, zero, jnp.int32(split), zero)
-            )
-            vc = jax.lax.dynamic_update_slice(
-                vc, rows_v[:, :, :first], (zero, zero, jnp.int32(split), zero)
-            )
-            if split:
+            sink_keep = min(s_new, sinks)
+            if sink_keep:
                 kc = jax.lax.dynamic_update_slice(
-                    kc, rows_k[:, :, first:], (zero, zero, zero, zero)
+                    kc, k[:, :, :sink_keep].astype(kc.dtype),
+                    (zero, zero, zero, zero),
                 )
                 vc = jax.lax.dynamic_update_slice(
-                    vc, rows_v[:, :, first:], (zero, zero, zero, zero)
+                    vc, v[:, :, :sink_keep].astype(vc.dtype),
+                    (zero, zero, zero, zero),
                 )
+            keep = min(max(s_new - sinks, 0), ring)
+            if keep:
+                # ring rows land rotated so the invariant 'slot(t) =
+                # sinks + (t - sinks) % ring' holds; split is static
+                # (fresh cache): 1-2 contiguous writes, no scatter
+                rows_k = k[:, :, s_new - keep:].astype(kc.dtype)
+                rows_v = v[:, :, s_new - keep:].astype(vc.dtype)
+                split = (s_new - keep - sinks) % ring
+                first = ring - split
+                kc = jax.lax.dynamic_update_slice(
+                    kc, rows_k[:, :, :first],
+                    (zero, zero, jnp.int32(sinks + split), zero),
+                )
+                vc = jax.lax.dynamic_update_slice(
+                    vc, rows_v[:, :, :first],
+                    (zero, zero, jnp.int32(sinks + split), zero),
+                )
+                if split:
+                    kc = jax.lax.dynamic_update_slice(
+                        kc, rows_k[:, :, first:],
+                        (zero, zero, jnp.int32(sinks), zero),
+                    )
+                    vc = jax.lax.dynamic_update_slice(
+                        vc, rows_v[:, :, first:],
+                        (zero, zero, jnp.int32(sinks), zero),
+                    )
         return out, RollingKVCache(kc, vc, cache.length + s_new)
 
     def _ragged_attention(self, q, k, v, cache: RaggedKVCache):

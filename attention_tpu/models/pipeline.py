@@ -50,6 +50,7 @@ def _block_module(model: TinyDecoder) -> TransformerBlock:
         impl=model.impl,
         dtype=model.dtype,
         window=model.window,
+        attn_sinks=model.attn_sinks,
         rope=model.rope,
         rope_theta=model.rope_theta,
         softcap=model.softcap,

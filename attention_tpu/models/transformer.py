@@ -40,6 +40,7 @@ class TransformerBlock(nn.Module):
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     window: int | None = None
+    attn_sinks: int = 0
     rope: bool = False
     rope_theta: float = 10000.0
     softcap: float | None = None
@@ -59,6 +60,7 @@ class TransformerBlock(nn.Module):
             causal=self.causal,
             dtype=self.dtype,
             window=self.window,
+            attn_sinks=self.attn_sinks,
             rope=self.rope,
             rope_theta=self.rope_theta,
             softcap=self.softcap,
@@ -99,6 +101,7 @@ class TinyDecoder(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     window: int | None = None  # sliding-window attention in every block
+    attn_sinks: int = 0  # StreamingLLM sinks (requires window)
     rope: bool = False  # rotary position embeddings in every block
     rope_theta: float = 10000.0
     softcap: float | None = None  # attention logit soft-capping
@@ -127,6 +130,7 @@ class TinyDecoder(nn.Module):
                 impl=self.impl,
                 dtype=self.dtype,
                 window=self.window,
+                attn_sinks=self.attn_sinks,
                 rope=self.rope,
                 rope_theta=self.rope_theta,
                 softcap=self.softcap,
@@ -159,7 +163,8 @@ class TinyDecoder(nn.Module):
             return tuple(
                 RollingKVCache.create(batch, self.num_kv_heads,
                                       self.window, head_dim,
-                                      cache_dtype or self.dtype)
+                                      cache_dtype or self.dtype,
+                                      sinks=self.attn_sinks)
                 for _ in range(self.depth)
             )
         return tuple(

@@ -88,6 +88,8 @@ def _flash_kernel(
     window: int | None,
     n_true_blocks: int,
     softcap2: float | None = None,
+    sinks: int | None = None,
+    sink_blocks: int = 0,
 ):
     """One (head, q-block, kv-block) grid step of online-softmax attention.
 
@@ -119,12 +121,19 @@ def _flash_kernel(
         # grid with per-step skip guards is NOT free — each skipped step
         # still pays un-overlapped DMA latency (~10 us measured), which
         # made a w=1024 window 5x SLOWER than full causal at seq=32k.
+        # with sinks, the first sink_blocks grid steps visit blocks
+        # [0, sink_blocks) and the band starts no earlier than that
+        # (no block is ever visited twice)
         base = jnp.maximum(
             (q_idx * block_q + offsets_ref[0] - offsets_ref[1]
              - (window - 1)) // block_k,
-            0,
+            sink_blocks,
         )
-        kv_idx = base + jb
+        if sink_blocks:
+            kv_idx = jnp.where(jb < sink_blocks, jb,
+                               base + jb - sink_blocks)
+        else:
+            kv_idx = base + jb
 
     @pl.when(jb == 0)
     def _init():
@@ -168,7 +177,7 @@ def _flash_kernel(
             n_true=n_true, block_k=block_k, causal=causal,
             block_q=block_q,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
-            window=window, softcap2=softcap2,
+            window=window, softcap2=softcap2, sinks=sinks,
         )
 
     @pl.when(jb == pl.num_programs(2) - 1)
@@ -193,6 +202,7 @@ def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
     block_q, q_seg_ref=None, kv_seg_ref=None, window=None, softcap2=None,
+    sinks=None,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
@@ -236,11 +246,12 @@ def _flash_tile(
                 mask, col + kv_offset <= row + q_offset
             )
             if window is not None:
-                # keep only the last `window` positions per row
-                mask = jnp.logical_and(
-                    mask,
-                    col + kv_offset >= row + q_offset - (window - 1),
-                )
+                # keep the last `window` positions per row, plus the
+                # pinned first `sinks` positions (StreamingLLM)
+                win = col + kv_offset >= row + q_offset - (window - 1)
+                if sinks is not None:
+                    win = jnp.logical_or(win, col + kv_offset < sinks)
+                mask = jnp.logical_and(mask, win)
         if segmented:
             # (block_q, 1) vs (1, block_k): all lanes/sublanes of the
             # replicated id blocks are equal, so max() is just a reshape.
@@ -307,6 +318,7 @@ def _flash_call(
     kv_segment_ids=None,
     window=None,
     softcap=None,
+    sinks=None,
 ):
     h, m, d = q.shape
     hkv, n, dv = v.shape
@@ -323,6 +335,14 @@ def _flash_call(
             )
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if sinks is not None:
+        if window is None:
+            raise ValueError(
+                "sinks (attention sinks) require window= (without a "
+                "window every past position is attended anyway)"
+            )
+        if sinks < 1:
+            raise ValueError(f"sinks must be >= 1, got {sinks}")
     check_softcap(softcap)
 
     # Fold softmax scale * log2(e) into Q once (an (m, d) multiply in
@@ -346,15 +366,18 @@ def _flash_call(
         v = jnp.pad(v, ((0, 0), (0, n_pad - n), (0, 0)))
 
     num_kv_blocks = n_pad // block_k
+    sink_blocks = 0 if sinks is None else min(
+        -(-sinks // block_k), num_kv_blocks
+    )
     if window is None:
         band_blocks = num_kv_blocks
     else:
         # blocks covering [row - (window-1), row] for a block_q row span,
-        # +1 for block misalignment
+        # +1 for block misalignment; sink blocks prepend the band
         band_blocks = min(
             num_kv_blocks, -(-(window - 1 + block_q) // block_k) + 1
         )
-    grid = (h, m_pad // block_q, band_blocks)
+    grid = (h, m_pad // block_q, sink_blocks + band_blocks)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -369,6 +392,8 @@ def _flash_call(
         window=window,
         n_true_blocks=num_kv_blocks,
         softcap2=None if softcap is None else softcap * _LOG2E,
+        sinks=sinks,
+        sink_blocks=sink_blocks,
     )
 
     offsets = jnp.stack(
@@ -392,12 +417,19 @@ def _flash_call(
             jj = j
         else:
             # banded grid: absolute block = band start + j, clipped to
-            # the last real block (compute is guarded in-kernel)
+            # the last real block (compute is guarded in-kernel);
+            # mirrors the sink/band split in `_flash_kernel`
             base = jnp.maximum(
                 (i * block_q + off[0] - off[1] - (window - 1)) // block_k,
-                0,
+                sink_blocks,
             )
-            jj = jnp.minimum(base + j, num_kv_blocks - 1)
+            if sink_blocks:
+                jj = jnp.where(
+                    j < sink_blocks, j,
+                    jnp.minimum(base + j - sink_blocks, num_kv_blocks - 1),
+                )
+            else:
+                jj = jnp.minimum(base + j, num_kv_blocks - 1)
         if causal:
             causal_last = (
                 i * block_q + block_q - 1 + off[0] - off[1]
@@ -562,6 +594,7 @@ def _canon(q, k, v):
         "interpret",
         "window",
         "softcap",
+        "sinks",
     ),
 )
 def flash_attention(
@@ -580,6 +613,7 @@ def flash_attention(
     kv_segment_ids=None,
     window: int | None = None,
     softcap: float | None = None,
+    sinks: int | None = None,
 ) -> jax.Array:
     """Fused single-device attention: softmax(q k^T * scale) v.
 
@@ -593,7 +627,9 @@ def flash_attention(
     (static int, requires causal) keeps the last ``window`` positions per
     query — sliding-window attention; skipped tiles cost no FLOPs.
     ``softcap`` (static float) applies Gemma-2-style logit capping
-    ``cap * tanh(scores / cap)`` before masking and softmax.
+    ``cap * tanh(scores / cap)`` before masking and softmax.  ``sinks``
+    (static int, requires window) keeps the first ``sinks`` positions
+    attendable alongside the window (StreamingLLM attention sinks).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -623,6 +659,7 @@ def flash_attention(
         kv_segment_ids=kv_segment_ids,
         window=window,
         softcap=softcap,
+        sinks=sinks,
     )
     return unbatch(out)
 

@@ -1,0 +1,136 @@
+"""Attention-sink (StreamingLLM) tests: window + pinned first-k
+positions through the kernel, the model family, and the rolling cache.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.models import TinyDecoder, generate
+from attention_tpu.ops.flash import flash_attention
+
+
+def _oracle(q, k, v, window, sinks):
+    m, d = q.shape
+    s = (q.astype(np.float64) @ k.astype(np.float64).T) / np.sqrt(d)
+    row = np.arange(m)[:, None]
+    col = np.arange(k.shape[0])[None, :]
+    mask = (col <= row) & ((col >= row - (window - 1)) | (col < sinks))
+    s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return p @ v.astype(np.float64)
+
+
+@pytest.mark.parametrize("m,window,sinks", [(512, 128, 4), (640, 256, 130),
+                                            (384, 128, 1)])
+def test_sinks_forward_matches_oracle(rng, m, window, sinks):
+    d = 64
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    k = rng.standard_normal((m, d)).astype(np.float32)
+    v = rng.standard_normal((m, d)).astype(np.float32)
+    got = np.asarray(flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=True, window=window, sinks=sinks,
+    ))
+    want = _oracle(q, k, v, window, sinks)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_sinks_change_output_vs_plain_window(rng):
+    m, d = 512, 32
+    q = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    a = np.asarray(flash_attention(q, k, v, causal=True, window=128))
+    b = np.asarray(flash_attention(q, k, v, causal=True, window=128,
+                                   sinks=8))
+    # early rows (inside the window) identical; late rows differ
+    np.testing.assert_allclose(a[:64], b[:64], atol=1e-6)
+    assert not np.allclose(a[300:], b[300:], atol=1e-4)
+
+
+def test_sinks_validation():
+    q = jnp.zeros((128, 32), jnp.float32)
+    with pytest.raises(ValueError, match="sinks"):
+        flash_attention(q, q, q, causal=True, sinks=4)  # no window
+    with pytest.raises(ValueError, match="sinks"):
+        flash_attention(q, q, q, causal=True, window=64, sinks=0)
+
+
+def _model(**kw):
+    return TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                       num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                       window=128, attn_sinks=4, **kw)
+
+
+def test_sinks_model_impls_agree(rng):
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 200)), jnp.int32)
+    params = _model().init(jax.random.PRNGKey(0), tokens)["params"]
+    a = _model().apply({"params": params}, tokens)
+    b = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                    num_kv_heads=2, impl="xla", dtype=jnp.float32,
+                    window=128, attn_sinks=4).apply({"params": params},
+                                                    tokens)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_sinks_rolling_cache_matches_full_cache_past_wrap(rng):
+    """Bounded-memory streaming: ring slots + pinned sinks must match
+    the full-capacity cache token-for-token well past the wrap."""
+    model = _model()
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 200)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.init_caches(batch=2, capacity=256)
+    roll = model.init_caches(batch=2, capacity=0, rolling=True)
+    assert roll[0].capacity == 256  # ceil((128+4)/128)*128
+    for t in range(tokens.shape[1]):
+        step = tokens[:, t : t + 1]
+        lf, full = model.apply({"params": params}, step, full)
+        lr, roll = model.apply({"params": params}, step, roll)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"t={t}")
+    assert int(roll[0].length) == 200
+
+
+def test_sinks_rolling_prefill_then_decode(rng):
+    """Prompt longer than sinks+window seeds the buffer correctly, and
+    subsequent decode matches the full-cache model."""
+    model = _model()
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 180)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.init_caches(batch=2, capacity=256)
+    lf, full = model.apply({"params": params}, tokens[:, :160], full)
+    roll = model.init_caches(batch=2, capacity=0, rolling=True)
+    lr, roll = model.apply({"params": params}, tokens[:, :160], roll)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                               atol=2e-4, rtol=1e-3)
+    for t in range(160, 180):
+        step = tokens[:, t : t + 1]
+        lf, full = model.apply({"params": params}, step, full)
+        lr, roll = model.apply({"params": params}, step, roll)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"t={t}")
+
+
+def test_sinks_generate_rolling_matches_full(rng):
+    model = _model()
+    prompt = jnp.asarray(rng.integers(0, 31, (2, 20)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    a = np.asarray(generate(model, params, prompt, steps=6))
+    b = np.asarray(generate(model, params, prompt, steps=6,
+                            rolling_cache=True))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sinks_require_window_at_model_level(rng):
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        attn_sinks=4)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="attn_sinks"):
+        model.init(jax.random.PRNGKey(0), tokens)
