@@ -92,12 +92,8 @@ class RollingKVCache(NamedTuple):
     def create(cls, batch: int, num_kv_heads: int, window: int,
                head_dim: int, dtype=jnp.bfloat16,
                sinks: int = 0) -> "RollingKVCache":
-        if window % 128:
-            raise ValueError(
-                f"rolling caches require window % 128 == 0 (got {window}): "
-                "a rounded-up capacity would give prefill and decode "
-                "different effective windows"
-            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
         cap = cls.capacity_for(window, sinks)
         shape = (batch, num_kv_heads, cap, head_dim)
         return cls(

@@ -343,6 +343,14 @@ def _flash_call(
             )
         if sinks < 1:
             raise ValueError(f"sinks must be >= 1, got {sinks}")
+        if q_segment_ids is not None:
+            # the sink mask pins ABSOLUTE buffer positions; in a packed
+            # buffer only the first segment would get its sinks — reject
+            # rather than silently diverge
+            raise ValueError(
+                "sinks do not compose with segment_ids (sink positions "
+                "are absolute, not per-segment); unpack the batch"
+            )
     check_softcap(softcap)
 
     # Fold softmax scale * log2(e) into Q once (an (m, d) multiply in
@@ -667,7 +675,7 @@ def flash_attention(
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "causal", "block_sizes", "interpret",
-                     "window", "softcap"),
+                     "window", "softcap", "sinks"),
 )
 def flash_attention_partials(
     q: jax.Array,
@@ -685,6 +693,7 @@ def flash_attention_partials(
     kv_segment_ids=None,
     window: int | None = None,
     softcap: float | None = None,
+    sinks: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention over a local KV shard.
 
@@ -720,6 +729,7 @@ def flash_attention_partials(
         kv_segment_ids=kv_segment_ids,
         window=window,
         softcap=softcap,
+        sinks=sinks,
     )
     if q.ndim == 2:
         return out[0], row_max[0], row_sum[0]

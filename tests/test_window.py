@@ -297,8 +297,14 @@ def test_rolling_nonfresh_prefill_poisons(rng):
     assert bool(jnp.all(jnp.isnan(logits)))
 
 
-def test_rolling_requires_128_multiple_window():
+def test_rolling_window_any_size_capacity_rounds():
+    """Any window >= 1 is legal: ring size is exactly the window and
+    capacity rounds up to the decode kernel's 128-slot granule (tail
+    slots stay unused; reads mask by the valid count)."""
     from attention_tpu.models import RollingKVCache
 
-    with pytest.raises(ValueError, match="window % 128"):
-        RollingKVCache.create(1, 2, 100, 16)
+    c = RollingKVCache.create(1, 2, 100, 16)
+    assert c.capacity == 128
+    assert RollingKVCache.capacity_for(100, sinks=30) == 256
+    with pytest.raises(ValueError, match="window"):
+        RollingKVCache.create(1, 2, 0, 16)
