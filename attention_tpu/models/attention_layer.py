@@ -157,29 +157,32 @@ def _xla_mha(q, k, v, *, causal, window=None, softcap=None, sinks=0):
 
 
 def _flash_mha(q, k, v, *, causal, window=None, softcap=None, sinks=0):
-    if sinks:
-        # inference-side feature: the backward kernels do not implement
-        # the sink mask.  Forward works; differentiating raises a CLEAR
-        # error instead of pallas' opaque NotImplementedError.
-        @jax.custom_vjp
-        def fwd_only(q, k, v):
-            return flash_attention(q, k, v, causal=causal, window=window,
-                                   softcap=softcap, sinks=sinks)
-
-        def _f(q, k, v):
-            return fwd_only(q, k, v), None
-
-        def _b(_res, _g):
-            raise ValueError(
-                "attn_sinks are inference-only: the flash backward "
-                "kernels do not implement the sink mask (use "
-                "impl='xla' to train a sink model)"
-            )
-
-        fwd_only.defvjp(_f, _b)
-        return fwd_only(q, k, v)
     return flash_attention_diff(q, k, v, causal=causal, window=window,
-                                softcap=softcap)
+                                softcap=softcap, sinks=sinks or None)
+
+
+def _sink_read_keys(kc, new_total, window, sinks, theta):
+    """StreamingLLM positional convention for RoPE'd sink keys, applied
+    at read time.
+
+    Keys are cached already-rotated at their absolute positions, which
+    is exact for window keys (query-to-key distance stays < window) but
+    lets the query-to-SINK distance grow without bound once the stream
+    passes ``sinks + window`` — outside the rotation range the model was
+    trained on.  The paper assigns positions *within the cache* instead.
+    Equivalent formulation used here: shift only the ``sinks`` pinned
+    keys forward by ``delta = max(new_total - (window + sinks), 0)``
+    (RoPE rotations compose additively), which pins every sink at a
+    constant relative distance just before the window start, while the
+    query and window keys keep their absolute rotations.  Cost per step:
+    a rope over ``sinks`` rows; the stored cache stays absolute.
+    """
+    delta = jnp.maximum(new_total - (window + sinks), 0)
+    rot = apply_rope(kc[:, :, :sinks], delta, theta).astype(kc.dtype)
+    # in-place-aliasable write of just the sink rows (a concatenate
+    # would copy the whole capacity-sized cache every decode step)
+    zero = jnp.zeros((), jnp.int32)
+    return jax.lax.dynamic_update_slice(kc, rot, (zero, zero, zero, zero))
 
 
 def _xla_cached_attention(q, kc, vc, *, start, new_len, causal,
@@ -322,9 +325,19 @@ class GQASelfAttention(nn.Module):
                 f"impl {self.impl!r} has no cached-attention path "
                 f"(supported: ['flash', 'xla'])"
             )
+        # Single-token decode on a RoPE'd sink model reads the sink keys
+        # re-rotated to their in-cache positions (see _sink_read_keys);
+        # chunked appends (s_new > 1) keep absolute rotations — the
+        # per-query shift is not uniform there, and chunked decode on a
+        # sink model is a prefill-style operation anyway.
+        kr = kc
+        if (self.rope and self.attn_sinks and self.window is not None
+                and s_new == 1):
+            kr = _sink_read_keys(kc, new_len, self.window, self.attn_sinks,
+                                 self.rope_theta)
         if self.impl == "xla":
             out = _xla_cached_attention(
-                q, kc, vc, start=cache.length, new_len=new_len,
+                q, kr, vc, start=cache.length, new_len=new_len,
                 causal=self.causal, window=self.window,
                 softcap=self.softcap, sinks=self.attn_sinks,
             )
@@ -336,7 +349,7 @@ class GQASelfAttention(nn.Module):
             # kernel applies the window over the cache (a rolling-buffer
             # cache that frees out-of-window rows is future work)
             out = flash_attention(
-                q, kc, vc, causal=self.causal,
+                q, kr, vc, causal=self.causal,
                 q_offset=cache.length, kv_valid=new_len, window=self.window,
                 softcap=self.softcap,
                 sinks=self.attn_sinks or None,
@@ -389,7 +402,11 @@ class GQASelfAttention(nn.Module):
                 cache.v, v.astype(cache.v.dtype), (0, 0, slot, 0)
             )
             valid = jnp.minimum(cache.length + 1, sinks + ring)
-            out = flash_decode(q[:, :, 0, :], kc, vc, valid,
+            kr = kc
+            if self.rope and sinks:
+                kr = _sink_read_keys(kc, cache.length + 1, ring, sinks,
+                                     self.rope_theta)
+            out = flash_decode(q[:, :, 0, :], kr, vc, valid,
                                softcap=self.softcap)[:, :, None, :]
         else:
             # fresh-cache prefill: the chunk sees only itself.  A

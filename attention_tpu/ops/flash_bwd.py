@@ -241,6 +241,58 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[...]
 
 
+def _sink_patch(q, k, v, out, lse, dout, *, scale, window, sinks, softcap):
+    """Gradient contributions of sink pairs OUTSIDE the window band.
+
+    The visible set of a windowed+sinks forward partitions exactly into
+    window pairs (col within the last `window` positions — covered by
+    the banded Pallas kernels with their window-only mask) and sink
+    pairs past the window (col < sinks and col < row - (window-1) —
+    covered here).  P is recomputed from the saved lse exactly like the
+    kernels (same pre-scaled, re-rounded Q; see `flash.py::_flash_call`),
+    so each pair is counted once with the forward's probabilities.  The
+    sliver is (m x sinks<=window start) — O(m·sinks·d) FLOPs, a few
+    fused XLA einsums; no Pallas variant needed.
+    """
+    h, m, d = q.shape
+    hkv, n, dv = v.shape
+    group = h // hkv
+    se = min(sinks, n)
+    kx = _gqa_repeat(k[:, :se], group)
+    vx = _gqa_repeat(v[:, :se], group)
+    q32 = q.astype(jnp.float32)
+    k32 = kx.astype(jnp.float32)
+    do32 = dout.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), -1)  # (h, m)
+    qsi = (q32 * (scale * _LOG2E)).astype(q.dtype).astype(jnp.float32)
+    s = jnp.einsum("hmd,hsd->hms", qsi, k32) * _LN2
+    dcap = None
+    if softcap is not None:
+        t = jnp.tanh(s / softcap)
+        s = softcap * t
+        dcap = 1.0 - t * t
+    lse32 = lse.astype(jnp.float32)[..., None]
+    mask = (jnp.arange(se)[None, :]
+            < jnp.arange(m)[:, None] - (window - 1))[None]
+    mask = jnp.logical_and(mask, lse32 != NEG_INF)
+    p = jnp.where(mask, jnp.exp(s - jnp.where(mask, lse32, 0.0)), 0.0)
+    dp = jnp.einsum("hme,hse->hms", do32, vx.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    if dcap is not None:
+        ds = ds * dcap
+    dq_s = jnp.einsum("hms,hsd->hmd", ds, k32) * scale
+    dk_s = jnp.einsum("hms,hmd->hsd", ds, q32) * scale
+    dv_s = jnp.einsum("hms,hme->hse", p, do32)
+    if group > 1:
+        dk_s = dk_s.reshape(hkv, group, se, d).sum(axis=1)
+        dv_s = dv_s.reshape(hkv, group, se, dv).sum(axis=1)
+    return dq_s, dk_s, dv_s, se
+
+
+def _gqa_repeat(x, group):
+    return jnp.repeat(x, group, axis=0) if group > 1 else x
+
+
 def flash_backward(
     q: jax.Array,  # (h, m, d)
     k: jax.Array,  # (hkv, n, d)
@@ -257,16 +309,25 @@ def flash_backward(
     kv_segment_ids=None,
     window: int | None = None,
     softcap: float | None = None,
+    sinks: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """dQ, dK, dV via the two Pallas backward kernels.
 
     ``softcap`` must match the forward's: P is recomputed from capped
-    scores and dS picks up the 1 - tanh^2 chain factor."""
+    scores and dS picks up the 1 - tanh^2 chain factor.  ``sinks``
+    (StreamingLLM, requires ``window``) adds the out-of-window sink
+    pairs via the XLA sliver `_sink_patch` on top of the banded
+    window-masked kernels."""
     segmented = q_segment_ids is not None
     if segmented != (kv_segment_ids is not None):
         raise ValueError("q_segment_ids and kv_segment_ids go together")
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
+    if sinks is not None:
+        if window is None:
+            raise ValueError("sinks require window= (see flash_attention)")
+        if segmented:
+            raise ValueError("sinks do not compose with segment_ids")
     # Backward default pinned independently of the forward's (256, 1024):
     # scripts/bwd_sweep.py on the real chip put block_q=512 clearly ahead
     # of 256 for the combined dQ+dKdV pass (~2.2 ms vs ~4 ms at seq=8k,
@@ -452,4 +513,13 @@ def flash_backward(
         ),
         interpret=interpret,
     )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)
-    return dq, dk[:, :n].astype(k.dtype), dvg[:, :n].astype(v.dtype)
+    dk, dvg = dk[:, :n], dvg[:, :n]
+    if sinks is not None:
+        dq_s, dk_s, dv_s, se = _sink_patch(
+            q, k[:, :n], v[:, :n], out, lse, dout,
+            scale=scale, window=window, sinks=sinks, softcap=softcap,
+        )
+        dq = (dq.astype(jnp.float32) + dq_s).astype(q.dtype)
+        dk = dk.at[:, :se].add(dk_s)
+        dvg = dvg.at[:, :se].add(dv_s)
+    return dq, dk.astype(k.dtype), dvg.astype(v.dtype)

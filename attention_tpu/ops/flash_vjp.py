@@ -47,11 +47,11 @@ def _gqa_expand(k, group):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
 def _flash_diff(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                bwd_chunk, bwd_impl, window, softcap):
+                bwd_chunk, bwd_impl, window, softcap, sinks):
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                             q_seg, kv_seg, window, softcap)
+                             q_seg, kv_seg, window, softcap, sinks)
     return out
 
 
@@ -66,11 +66,11 @@ def _seg_zeros(seg):
 
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
-                    kv_seg=None, window=None, softcap=None):
+                    kv_seg=None, window=None, softcap=None, sinks=None):
     out_un, row_max, row_sum = flash_attention_partials(
         q, k, v, scale=scale, causal=causal, block_sizes=block_sizes,
         q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
-        softcap=softcap,
+        softcap=softcap, sinks=sinks,
     )
     l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = (out_un / l_safe[..., None]).astype(q.dtype)
@@ -81,14 +81,14 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
 
 
 def _flash_diff_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                    bwd_chunk, bwd_impl, window, softcap):
+                    bwd_chunk, bwd_impl, window, softcap, sinks):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                               q_seg, kv_seg, window, softcap)
+                               q_seg, kv_seg, window, softcap, sinks)
     return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
-                    window, softcap, res, dout):
+                    window, softcap, sinks, res, dout):
     q, k, v, q_seg, kv_seg, out, lse = res
     seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg))
     if bwd_impl == "pallas":
@@ -100,7 +100,7 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
             scale=scale, causal=causal, block_sizes=block_sizes,
             interpret=_should_interpret(),
             q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
-            softcap=softcap,
+            softcap=softcap, sinks=sinks,
         ) + seg_cots
     h, m, dk = q.shape
     hkv, n, dv = v.shape
@@ -162,9 +162,13 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
             rows = base + jnp.arange(chunk)
             mask = jnp.arange(n)[None, :] <= rows[:, None]
             if window is not None:
-                mask = jnp.logical_and(
-                    mask, jnp.arange(n)[None, :] >= rows[:, None] - (window - 1)
-                )
+                win = jnp.arange(n)[None, :] >= rows[:, None] - (window - 1)
+                if sinks is not None:
+                    # pinned StreamingLLM sink positions stay visible
+                    win = jnp.logical_or(
+                        win, jnp.arange(n)[None, :] < sinks
+                    )
+                mask = jnp.logical_and(mask, win)
             s = jnp.where(mask, s, NEG_INF)
         if segmented:
             s = jnp.where(qsegi[:, None] == kvseg_arr[None, :], s, NEG_INF)
@@ -208,6 +212,7 @@ def flash_attention_diff(
     kv_segment_ids=None,
     window: int | None = None,
     softcap: float | None = None,
+    sinks: int | None = None,
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
@@ -217,7 +222,10 @@ def flash_attention_diff(
     (``bwd_impl="xla"``), both from the saved log-sum-exp.  Segment ids
     ((m,)/(n,) int32, shared across heads; 2D/3D inputs only) mask
     attention across packed-sequence boundaries in both directions of
-    the VJP.
+    the VJP.  ``sinks`` (StreamingLLM pinned positions; requires
+    ``window``) is differentiable too: the banded backward kernels
+    handle the window pairs and `flash_bwd._sink_patch` the sink
+    sliver.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -235,18 +243,18 @@ def flash_attention_diff(
     if q.ndim == 2:
         return _flash_diff(
             q[None], k[None], v[None], qseg, kvseg, scale, causal, bs,
-            bwd_chunk, bwd_impl, window, softcap,
+            bwd_chunk, bwd_impl, window, softcap, sinks,
         )[0]
     if q.ndim == 3:
         return _flash_diff(q, k, v, qseg, kvseg, scale, causal, bs,
-                           bwd_chunk, bwd_impl, window, softcap)
+                           bwd_chunk, bwd_impl, window, softcap, sinks)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
         vf = v.reshape(b * v.shape[1], *v.shape[2:])
         out = _flash_diff(
             q.reshape(b * hq, m, d), kf, vf, None, None, scale, causal, bs,
-            bwd_chunk, bwd_impl, window, softcap,
+            bwd_chunk, bwd_impl, window, softcap, sinks,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")

@@ -209,7 +209,12 @@ def paged_flash_decode(
         bi = bh // hkv
         valid = lens_ref[bi]
         last = jnp.maximum((valid + page - 1) // page - 1, 0)
-        return (tbl_ref[bi, jnp.minimum(j, last)], bh % hkv, 0, 0)
+        # max(..., 0): a length-0 row lands on page_table[bi, 0], which a
+        # hand-built PagedKV may legitimately leave as the -1 free-slot
+        # sentinel; the output is masked anyway, but the DMA index must
+        # stay in bounds.
+        return (jnp.maximum(tbl_ref[bi, jnp.minimum(j, last)], 0),
+                bh % hkv, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -1,0 +1,370 @@
+"""One-command real-TPU smoke sweep of every kernel variant.
+
+CPU tests run the Pallas kernels in interpreter mode; commit e8ed27d
+proved interpret-green does not imply Mosaic-green.  This script runs
+each kernel variant ONCE on the real chip with tiny shapes and checks it
+against a dense oracle — the analog of the course grader running every
+testcase (reference spec: run the frozen harness on the full ladder).
+
+Run: python scripts/tpu_smoke.py        (uses the env's default TPU)
+Exit status 0 iff every variant lowered and agreed with its oracle.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from attention_tpu.ops.decode import flash_decode
+from attention_tpu.ops.flash import flash_attention, flash_attention_partials
+from attention_tpu.ops.flash_vjp import flash_attention_diff
+from attention_tpu.ops.paged import PagePool, paged_flash_decode, paged_from_dense
+from attention_tpu.ops.quant import flash_decode_quantized, quantize_kv
+
+RNG = np.random.default_rng(7)
+
+
+def _arr(*shape):
+    return jnp.asarray(RNG.standard_normal(shape), jnp.float32)
+
+
+def _dense(q, k, v, *, causal=False, window=None, sinks=None, softcap=None,
+           q_seg=None, kv_seg=None, q_offset=0, kv_valid=None):
+    """fp32 XLA oracle for every mask combination — an independent code
+    path from the kernels, with matmuls forced to full fp32 precision
+    (the chip's default fp32 matmul precision is bf16 passes, which
+    would blur the oracle by the same ~1e-2 the kernels show)."""
+    with jax.default_matmul_precision("highest"):
+        return _dense_inner(q, k, v, causal=causal, window=window,
+                            sinks=sinks, softcap=softcap, q_seg=q_seg,
+                            kv_seg=kv_seg, q_offset=q_offset,
+                            kv_valid=kv_valid)
+
+
+def _dense_inner(q, k, v, *, causal, window, sinks, softcap,
+                 q_seg, kv_seg, q_offset, kv_valid):
+    group = q.shape[0] // k.shape[0] if q.ndim == 3 else 1
+    if q.ndim == 3 and group > 1:
+        k = jnp.repeat(k, group, axis=0)
+        v = jnp.repeat(v, group, axis=0)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("...md,...nd->...mn", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+    m, n = s.shape[-2:]
+    col = jnp.arange(n)[None, :]
+    mask = jnp.ones((m, n), bool)
+    if kv_valid is not None:
+        mask = jnp.logical_and(mask, col < kv_valid)
+    if causal:
+        row = jnp.arange(m)[:, None] + q_offset
+        mask = jnp.logical_and(mask, col <= row)
+        if window is not None:
+            win = col >= row - (window - 1)
+            if sinks:
+                win = jnp.logical_or(win, col < sinks)
+            mask = jnp.logical_and(mask, win)
+    if q_seg is not None:
+        mask = jnp.logical_and(mask, q_seg[:, None] == kv_seg[None, :])
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
+    return jnp.einsum("...mn,...nd->...md", p, v.astype(jnp.float32))
+
+
+CASES = []
+
+
+def case(name):
+    def deco(fn):
+        CASES.append((name, fn))
+        return fn
+
+    return deco
+
+
+# ----------------------------- forward -----------------------------
+
+@case("fwd/causal")
+def _():
+    q, k, v = _arr(4, 384, 64), _arr(4, 384, 64), _arr(4, 384, 64)
+    got = flash_attention(q, k, v, causal=True)
+    return got, _dense(q, k, v, causal=True)
+
+
+@case("fwd/cross-attention (m!=n, dv!=dk, non-causal)")
+def _():
+    q, k, v = _arr(2, 256, 64), _arr(2, 384, 64), _arr(2, 384, 128)
+    got = flash_attention(q, k, v)
+    return got, _dense(q, k, v)
+
+
+@case("fwd/gqa 8q2kv")
+def _():
+    q, k, v = _arr(8, 256, 64), _arr(2, 256, 64), _arr(2, 256, 64)
+    got = flash_attention(q, k, v, causal=True)
+    return got, _dense(q, k, v, causal=True)
+
+
+@case("fwd/window")
+def _():
+    q, k, v = _arr(2, 512, 64), _arr(2, 512, 64), _arr(2, 512, 64)
+    got = flash_attention(q, k, v, causal=True, window=160)
+    return got, _dense(q, k, v, causal=True, window=160)
+
+
+@case("fwd/window+sinks")
+def _():
+    q, k, v = _arr(2, 512, 64), _arr(2, 512, 64), _arr(2, 512, 64)
+    got = flash_attention(q, k, v, causal=True, window=160, sinks=4)
+    return got, _dense(q, k, v, causal=True, window=160, sinks=4)
+
+
+@case("fwd/softcap")
+def _():
+    q, k, v = _arr(2, 256, 64), _arr(2, 256, 64), _arr(2, 256, 64)
+    got = flash_attention(q, k, v, causal=True, softcap=20.0)
+    return got, _dense(q, k, v, causal=True, softcap=20.0)
+
+
+@case("fwd/segments")
+def _():
+    q, k, v = _arr(1, 384, 64), _arr(1, 384, 64), _arr(1, 384, 64)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(150), np.ones(234)]).astype(np.int32)
+    )
+    got = flash_attention(q[0], k[0], v[0], causal=True,
+                          q_segment_ids=seg, kv_segment_ids=seg)
+    return got, _dense(q, k, v, causal=True, q_seg=seg, kv_seg=seg)[0]
+
+
+@case("fwd/q_offset+kv_valid (chunked decode shape)")
+def _():
+    q, k, v = _arr(2, 128, 64), _arr(2, 512, 64), _arr(2, 512, 64)
+    got = flash_attention(q, k, v, causal=True, q_offset=200,
+                          kv_valid=328)
+    return got, _dense(q, k, v, causal=True, q_offset=200, kv_valid=328)
+
+
+@case("fwd/4d batched")
+def _():
+    q, k, v = _arr(2, 4, 256, 64), _arr(2, 4, 256, 64), _arr(2, 4, 256, 64)
+    got = flash_attention(q, k, v, causal=True)
+    return got, _dense(q, k, v, causal=True)
+
+
+@case("fwd/bf16 in, fp32 accum")
+def _():
+    q, k, v = (x.astype(jnp.bfloat16) for x in
+               (_arr(2, 256, 64), _arr(2, 256, 64), _arr(2, 256, 64)))
+    got = flash_attention(q, k, v, causal=True).astype(jnp.float32)
+    want = _dense(q.astype(jnp.float32), k.astype(jnp.float32),
+                  v.astype(jnp.float32), causal=True)
+    return got, want, 2e-2  # the +-0.02 contract for bf16
+
+
+@case("fwd/partials 2-shard merge == full")
+def _():
+    q, k, v = _arr(2, 256, 64), _arr(2, 256, 64), _arr(2, 256, 64)
+    want = flash_attention(q, k, v, causal=True)
+    acc = m_run = l_run = None
+    for off in (0, 128):
+        o, lm, ls = flash_attention_partials(
+            q, k[:, off:off + 128], v[:, off:off + 128], causal=True,
+            kv_offset=jnp.int32(off),
+        )
+        o, lm, ls = (np.asarray(x, np.float64) for x in (o, lm, ls))
+        if acc is None:
+            acc, m_run, l_run = o, lm, ls
+        else:
+            m_new = np.maximum(m_run, lm)
+            c_old = np.where(np.isneginf(m_run), 0.0, np.exp(m_run - m_new))
+            c_new = np.where(np.isneginf(lm), 0.0, np.exp(lm - m_new))
+            acc = acc * c_old[..., None] + o * c_new[..., None]
+            l_run = l_run * c_old + ls * c_new
+            m_run = m_new
+    got = acc / np.where(l_run == 0.0, 1.0, l_run)[..., None]
+    return jnp.asarray(got, jnp.float32), want
+
+
+# ----------------------------- backward -----------------------------
+
+def _grad_case(**kw):
+    h, hkv = (4, 2) if kw.pop("gqa", False) else (2, 2)
+    m, d = 320, 64
+    q, k, v = _arr(h, m, d), _arr(hkv, m, d), _arr(hkv, m, d)
+    wt = _arr(h, m, d)
+
+    def floss(q, k, v):
+        return jnp.sum(flash_attention_diff(
+            q, k, v, causal=True, bwd_impl="pallas", **kw) * wt)
+
+    def dloss(q, k, v):
+        return jnp.sum(_dense(q, k, v, causal=True,
+                              window=kw.get("window"),
+                              sinks=kw.get("sinks"),
+                              softcap=kw.get("softcap"),
+                              q_seg=kw.get("q_segment_ids"),
+                              kv_seg=kw.get("kv_segment_ids")) * wt)
+
+    gf = jax.grad(floss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dloss, argnums=(0, 1, 2))(q, k, v)
+    got = jnp.concatenate([g.reshape(-1) for g in gf])
+    want = jnp.concatenate([g.reshape(-1) for g in gd])
+    return got, want, 5e-2
+
+
+@case("bwd/causal (dq + dkdv kernels)")
+def _():
+    return _grad_case()
+
+
+@case("bwd/gqa grouped dkdv")
+def _():
+    return _grad_case(gqa=True)
+
+
+@case("bwd/window banded")
+def _():
+    return _grad_case(window=96)
+
+
+@case("bwd/window+sinks")
+def _():
+    return _grad_case(window=96, sinks=5)
+
+
+@case("bwd/softcap")
+def _():
+    return _grad_case(softcap=15.0)
+
+
+@case("bwd/segments")
+def _():
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(130), np.ones(190)]).astype(np.int32)
+    )
+    h, m, d = 2, 320, 64
+    q, k, v = _arr(h, m, d), _arr(h, m, d), _arr(h, m, d)
+    wt = _arr(h, m, d)
+
+    def floss(q, k, v):
+        return jnp.sum(flash_attention_diff(
+            q, k, v, causal=True, bwd_impl="pallas",
+            q_segment_ids=seg, kv_segment_ids=seg) * wt)
+
+    def dloss(q, k, v):
+        return jnp.sum(_dense(q, k, v, causal=True, q_seg=seg,
+                              kv_seg=seg) * wt)
+
+    gf = jax.grad(floss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dloss, argnums=(0, 1, 2))(q, k, v)
+    got = jnp.concatenate([g.reshape(-1) for g in gf])
+    want = jnp.concatenate([g.reshape(-1) for g in gd])
+    return got, want, 5e-2
+
+
+# ----------------------------- decode -----------------------------
+
+def _decode_setup(b=3, h=4, hkv=2, n=512, d=64):
+    q = _arr(b, h, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    lens = jnp.asarray([n, 129, 300][:b], jnp.int32)
+    group = h // hkv
+    # dense oracle: per sequence, the q row attends its valid prefix
+    with jax.default_matmul_precision("highest"):
+        kx = jnp.repeat(kc, group, axis=1)
+        vx = jnp.repeat(vc, group, axis=1)
+        s = jnp.einsum("bhd,bhnd->bhn", q, kx) / (d ** 0.5)
+        mask = jnp.arange(n)[None, None, :] < lens[:, None, None]
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), axis=-1)
+        want = jnp.einsum("bhn,bhnd->bhd", p, vx)
+    return q, kc, vc, lens, want
+
+
+@case("decode/bf16-cache ragged lens")
+def _():
+    q, kc, vc, lens, want = _decode_setup()
+    got = flash_decode(q, kc, vc, lens, block_k=256)
+    return got, want
+
+
+@case("decode/scalar len")
+def _():
+    q, kc, vc, lens, want = _decode_setup(b=2)
+    got = flash_decode(q, kc, vc, jnp.int32(300), block_k=256)
+    with jax.default_matmul_precision("highest"):
+        s = jnp.einsum("bhd,bhnd->bhn", q, jnp.repeat(kc, 2, axis=1)) / 8.0
+        mask = jnp.arange(kc.shape[2])[None, None, :] < 300
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), axis=-1)
+        want = jnp.einsum("bhn,bhnd->bhd", p, jnp.repeat(vc, 2, axis=1))
+    return got, want
+
+
+@case("decode/int8 quantized cache")
+def _():
+    q, kc, vc, lens, want = _decode_setup()
+    got = flash_decode_quantized(q, quantize_kv(kc, vc), lens, block_k=256)
+    return got, want, 3e-2  # int8 quantization error dominates
+
+
+@case("decode/paged block-table")
+def _():
+    q, kc, vc, lens, want = _decode_setup()
+    pool = PagePool(num_pages=16)
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=16)
+    got = paged_flash_decode(q, cache)
+    return got, want
+
+
+@case("decode/softcap")
+def _():
+    q, kc, vc, lens, _ = _decode_setup()
+    got = flash_decode(q, kc, vc, lens, block_k=256, softcap=10.0)
+    with jax.default_matmul_precision("highest"):
+        kx = jnp.repeat(kc, 2, axis=1)
+        vx = jnp.repeat(vc, 2, axis=1)
+        s = jnp.einsum("bhd,bhnd->bhn", q, kx) / 8.0
+        s = 10.0 * jnp.tanh(s / 10.0)
+        mask = jnp.arange(kc.shape[2])[None, None, :] < lens[:, None, None]
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), axis=-1)
+        want = jnp.einsum("bhn,bhnd->bhd", p, vx)
+    return got, want
+
+
+def main() -> int:
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform} ({jax.devices()[0]})")
+    if platform not in ("tpu", "axon"):
+        print("WARNING: not on TPU — this sweep validates Mosaic "
+              "lowering and only proves that on a real chip")
+    failures = []
+    for name, fn in CASES:
+        try:
+            res = fn()
+            got, want = res[0], res[1]
+            atol = res[2] if len(res) > 2 else 2e-2
+            got = np.asarray(jax.block_until_ready(got), np.float64)
+            want = np.asarray(want, np.float64)
+            err = float(np.max(np.abs(got - want)))
+            ok = err <= atol
+            print(f"{'PASS' if ok else 'FAIL'} {name}: max|err|={err:.2e} "
+                  f"(atol {atol:g})")
+            if not ok:
+                failures.append(name)
+        except Exception as e:  # lowering failures land here
+            print(f"FAIL {name}: {type(e).__name__}: {e}")
+            failures.append(name)
+    print(f"\n{len(CASES) - len(failures)}/{len(CASES)} variants green"
+          + (f"; FAILED: {failures}" if failures else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,27 @@ def test_paged_decode_matches_dense(rng):
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
 
 
+def test_paged_decode_zero_length_sentinel_row(rng):
+    """A hand-built PagedKV (public NamedTuple) may leave a length-0
+    sequence's page_table row entirely -1 (the free-slot sentinel).  The
+    translated DMA index must be clamped in bounds; the row's output is
+    fully masked to zeros either way."""
+    b, h, hkv, n, d = 2, 4, 2, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    lens = jnp.asarray([256, 0], jnp.int32)
+    pool = PagePool(num_pages=8)
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=8)
+    table = np.array(cache.page_table)
+    table[1, :] = -1  # row claims nothing at all
+    cache = cache._replace(page_table=jnp.asarray(table))
+    got = np.asarray(paged_flash_decode(q, cache))
+    want = np.asarray(flash_decode(q, kc, vc, lens, block_k=128))
+    np.testing.assert_allclose(got[0], want[0], atol=2e-5, rtol=1e-5)
+    np.testing.assert_array_equal(got[1], np.zeros_like(got[1]))
+
+
 def test_paged_decode_softcap(rng):
     b, h, hkv, n, d = 2, 4, 2, 256, 64
     q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)

@@ -127,6 +127,61 @@ def test_sinks_generate_rolling_matches_full(rng):
     np.testing.assert_array_equal(a, b)
 
 
+def test_sinks_rope_rolling_and_full_and_xla_agree_past_wrap(rng):
+    """RoPE + sinks streaming: the in-cache sink re-rotation
+    (_sink_read_keys) must be applied identically by the rolling ring
+    buffer, the full-capacity flash decode, and the xla cached decode."""
+    kw = dict(vocab=31, dim=32, depth=1, num_q_heads=4, num_kv_heads=2,
+              dtype=jnp.float32, window=128, attn_sinks=4, rope=True)
+    model = TinyDecoder(impl="flash", **kw)
+    xmodel = TinyDecoder(impl="xla", **kw)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 200)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.init_caches(batch=2, capacity=256)
+    xfull = model.init_caches(batch=2, capacity=256)
+    roll = model.init_caches(batch=2, capacity=0, rolling=True)
+    for t in range(tokens.shape[1]):
+        step = tokens[:, t : t + 1]
+        lf, full = model.apply({"params": params}, step, full)
+        lx, xfull = xmodel.apply({"params": params}, step, xfull)
+        lr, roll = model.apply({"params": params}, step, roll)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"t={t}")
+        np.testing.assert_allclose(np.asarray(lx), np.asarray(lf),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"t={t}")
+
+
+def test_sinks_rope_uses_in_cache_positions(rng):
+    """The StreamingLLM positional contract itself: decode at step t must
+    equal a FRESH forward over the kept token set (first `sinks` + last
+    `window` tokens) — whose positions 0..S-1 ARE the paper's in-cache
+    positions — at its last row.  With absolute sink rotations (the
+    pre-fix behavior) this diverges as soon as t >= sinks + window."""
+    window, sinks = 128, 4
+    model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        rope=True, window=window, attn_sinks=sinks)
+    tokens = jnp.asarray(rng.integers(0, 31, (1, 200)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    roll = model.init_caches(batch=1, capacity=0, rolling=True)
+    steps = {}
+    for t in range(tokens.shape[1]):
+        lr, roll = model.apply({"params": params}, tokens[:, t : t + 1],
+                               roll)
+        steps[t] = np.asarray(lr)[:, 0]
+    for t in (160, 199):  # well past sinks + window = 132
+        kept = jnp.concatenate(
+            [tokens[:, :sinks], tokens[:, t - window + 1 : t + 1]], axis=1
+        )
+        fresh = model.apply({"params": params}, kept)
+        np.testing.assert_allclose(
+            steps[t], np.asarray(fresh)[:, -1], atol=2e-4, rtol=1e-3,
+            err_msg=f"t={t}",
+        )
+
+
 def test_sinks_require_window_at_model_level(rng):
     model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
                         num_kv_heads=2, impl="flash", dtype=jnp.float32,
@@ -156,6 +211,75 @@ def test_sinks_rolling_non_aligned_window(rng):
         lr, roll = model.apply({"params": params}, step, roll)
         np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
                                    atol=8e-3, rtol=3e-2, err_msg=f"t={t}")
+
+
+@pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+@pytest.mark.parametrize("softcap", [None, 12.0])
+def test_sinks_grads_match_dense_autodiff(rng, bwd_impl, softcap):
+    """window+sinks gradients (dQ, dK, dV) vs jax.grad through the dense
+    mask — the banded backward kernels cover the window pairs and the
+    XLA sink patch the out-of-window sink sliver."""
+    from attention_tpu.ops.flash_vjp import flash_attention_diff
+
+    h, hkv, m, d, w, sinks = 4, 2, 320, 32, 48, 5
+    q = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, m, d)), jnp.float32)
+    wt = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+
+    def flash_loss(q, k, v):
+        out = flash_attention_diff(q, k, v, causal=True, window=w,
+                                   sinks=sinks, softcap=softcap,
+                                   bwd_impl=bwd_impl)
+        return jnp.sum(out * wt)
+
+    def dense_loss(q, k, v):
+        kx = jnp.repeat(k, h // hkv, axis=0)
+        vx = jnp.repeat(v, h // hkv, axis=0)
+        s = jnp.einsum("hmd,hnd->hmn", q, kx) / d**0.5
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        row = jnp.arange(m)[:, None]
+        col = jnp.arange(m)[None, :]
+        mask = jnp.logical_and(
+            col <= row,
+            jnp.logical_or(col >= row - (w - 1), col < sinks),
+        )
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("hmn,hnd->hmd", p, vx) * wt)
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "dq dk dv".split()):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   atol=5e-4, rtol=1e-3, err_msg=name)
+
+
+def test_sinks_model_trains_with_flash_impl(rng):
+    """End to end: a windowed sink model is differentiable with
+    impl='flash' (was inference-only in round 1) and its loss gradient
+    matches the xla impl's."""
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 200)), jnp.int32)
+    fmodel = _model()
+    xmodel = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
+                         num_kv_heads=2, impl="xla", dtype=jnp.float32,
+                         window=128, attn_sinks=4)
+    params = fmodel.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss(model, params):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tgt = jax.nn.one_hot(tokens[:, 1:], 31)
+        return -jnp.mean(jnp.sum(logp * tgt, axis=-1))
+
+    gf = jax.grad(lambda p: loss(fmodel, p))(params)
+    gx = jax.grad(lambda p: loss(xmodel, p))(params)
+    flat_f = jax.tree_util.tree_leaves(gf)
+    flat_x = jax.tree_util.tree_leaves(gx)
+    for a, b in zip(flat_f, flat_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-3)
 
 
 def test_sinks_reject_segment_ids(rng):
