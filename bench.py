@@ -36,8 +36,8 @@ import sys
 import time
 
 
-def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
-                   block_k: int, *, heads: int | None = None,
+def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
+                   block_k: int | None, *, heads: int | None = None,
                    kv_heads: int | None = None, window: int | None = None,
                    n_short: int = 4, n_long: int = 20):
     """Per-call seconds of the fused flash kernel at (seq, dim), bf16.
@@ -60,7 +60,13 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int,
     q = jax.random.normal(kq, qshape, jnp.bfloat16)
     k = jax.random.normal(kk, kvshape, jnp.bfloat16)
     v = jax.random.normal(kv, kvshape, jnp.bfloat16)
-    bs = BlockSizes(block_q, block_k)
+    # None -> the library's measured per-shape default (BlockSizes.for_shape);
+    # a partial override fills the other field from the general default.
+    if block_q is None and block_k is None:
+        bs = None
+    else:
+        bs = BlockSizes(block_q or BlockSizes().block_q,
+                        block_k or BlockSizes().block_k)
     return benchmark_amortized(
         lambda x, kk, vv: flash_attention(
             x, kk, vv, block_sizes=bs, causal=window is not None,
@@ -192,8 +198,9 @@ def main(argv=None) -> int:
         help="amortized-slope timing repeats; the min fights the shared "
         "chip's large run-to-run contention variance",
     )
-    p.add_argument("--block-q", type=int, default=256)
-    p.add_argument("--block-k", type=int, default=1024)
+    p.add_argument("--block-q", type=int, default=None,
+                   help="override the library's per-shape default tile")
+    p.add_argument("--block-k", type=int, default=None)
     p.add_argument(
         "--serial-seq", type=int, default=4096,
         help="m=n at which the serial C oracle is timed (then extrapolated)",
@@ -259,7 +266,7 @@ def main(argv=None) -> int:
         w_s = _bench_flash_s(32768, 128, args.repeats, args.block_q,
                              args.block_k, window=1024, n_short=4,
                              n_long=32)
-        w_fl = 2 * 32768 * (1024 + args.block_q) * (128 + 128)
+        w_fl = 2 * 32768 * (1024 + (args.block_q or 256)) * (128 + 128)
         ladder["swa_w1024_32k"] = {
             "ms": round(w_s * 1e3, 3),
             "gflops": round(w_fl / w_s / 1e9, 1),

@@ -1,0 +1,101 @@
+"""Decode latency ladder: cache length x block_k, interleaved.
+
+Round-1 verdict #9: with block_k=2048 a short prefix still pays a full
+2048-row block per KV head; measure len in {512, 2k, 8k, 32k} and pick a
+policy.  All (length, block_k) pairs are timed round-robin in ONE
+process with the scan-slope clock, medians reported.
+
+Run: python scripts/decode_ladder.py [--rounds 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=32)
+    p.add_argument("--kv-heads", type=int, default=4)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--lengths", type=str, default="512,2048,8192,32768")
+    p.add_argument("--block-ks", type=str, default="512,1024,2048")
+    p.add_argument("--n-short", type=int, default=8)
+    p.add_argument("--n-long", type=int, default=64)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from attention_tpu.ops.decode import flash_decode
+
+    b, h, hkv, d = args.batch, args.heads, args.kv_heads, args.dim
+    lengths = [int(x) for x in args.lengths.split(",")]
+    block_ks = [int(x) for x in args.block_ks.split(",")]
+    cap = max(lengths)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.bfloat16)
+    kc = jax.random.normal(kk, (b, hkv, cap, d), jnp.bfloat16)
+    vc = jax.random.normal(kv, (b, hkv, cap, d), jnp.bfloat16)
+
+    def make_chained(bk):
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def chained(x0, kc_, vc_, n, lens):
+            def body(carry, _):
+                out = flash_decode(carry, kc_, vc_, lens, block_k=bk)
+                return out.astype(x0.dtype), None
+
+            out, _ = lax.scan(body, x0, None, length=n)
+            return jnp.sum(out.astype(jnp.float32))
+
+        return chained
+
+    cases = {}
+    for bk in block_ks:
+        fn = make_chained(bk)
+        for ln in lengths:
+            lens = jnp.full((b,), ln, jnp.int32)
+            jax.device_get(fn(q, kc, vc, args.n_short, lens))
+            jax.device_get(fn(q, kc, vc, args.n_long, lens))
+            cases[(ln, bk)] = (fn, lens)
+
+    slopes = {c: [] for c in cases}
+    for _ in range(args.rounds):
+        for c, (fn, lens) in cases.items():
+            t0 = time.perf_counter()
+            jax.device_get(fn(q, kc, vc, args.n_short, lens))
+            t_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            jax.device_get(fn(q, kc, vc, args.n_long, lens))
+            t_l = time.perf_counter() - t0
+            slopes[c].append((t_l - t_s) / (args.n_long - args.n_short))
+
+    table = {}
+    for (ln, bk), ss in sorted(slopes.items()):
+        per = statistics.median(ss)
+        gb = 2 * b * hkv * ln * d * 2 / per / 1e9  # bf16 K+V read
+        table[f"len{ln}_bk{bk}"] = {
+            "us": round(per * 1e6, 1),
+            "kv_read_gb_s": round(gb, 0),
+        }
+        print(json.dumps({f"len{ln}_bk{bk}": table[f"len{ln}_bk{bk}"]}),
+              flush=True)
+    for ln in lengths:
+        best = min(block_ks, key=lambda bk: table[f"len{ln}_bk{bk}"]["us"])
+        print(json.dumps({"best_for_len": ln, "block_k": best}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Interleaved BlockSizes sweep for the GQA ladder config (32q/4kv).
+
+Round-1 verdict: gqa_32q4kv_16k was the slowest ladder entry (0.73 util)
+and the only config never block-size-tuned.  The shared chip's
+contention swings run-to-run results 0.4-2x, so configs are compared the
+only honest way (see utils/timing.py): ONE process, round-robin slope
+pairs over all configs, median per config.
+
+Run: python scripts/gqa_sweep.py [--seq 16384] [--rounds 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=16384)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--heads", type=int, default=32)
+    p.add_argument("--kv-heads", type=int, default=4)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--n-short", type=int, default=2)
+    p.add_argument("--n-long", type=int, default=8)
+    p.add_argument("--causal", action="store_true")
+    p.add_argument(
+        "--configs", type=str,
+        default="256x1024,512x1024,1024x1024,256x2048,512x2048,512x512",
+    )
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from attention_tpu.ops.flash import BlockSizes, flash_attention
+    from attention_tpu.utils.flops import attention_flops, peak_flops
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (args.heads, args.seq, args.dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (args.kv_heads, args.seq, args.dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (args.kv_heads, args.seq, args.dim), jnp.bfloat16)
+
+    def make_chained(bq, bk):
+        bs = BlockSizes(bq, bk)
+
+        @functools.partial(jax.jit, static_argnums=3)
+        def chained(x0, kk_, vv_, n):
+            def body(carry, _):
+                out = flash_attention(carry, kk_, vv_, block_sizes=bs,
+                                      causal=args.causal)
+                return out.astype(x0.dtype), None
+
+            out, _ = lax.scan(body, x0, None, length=n)
+            return jnp.sum(out.astype(jnp.float32))
+
+        return chained
+
+    chains = {}
+    for c in args.configs.split(","):
+        bq, bk = (int(x) for x in c.split("x"))
+        fn = make_chained(bq, bk)
+        try:  # compile + warm both lengths up front
+            jax.device_get(fn(q, k, v, args.n_short))
+            jax.device_get(fn(q, k, v, args.n_long))
+            chains[c] = fn
+        except Exception as e:  # noqa: BLE001 - sweep survives bad configs
+            print(json.dumps({c: {"error": str(e)[:120]}}), flush=True)
+
+    slopes = {c: [] for c in chains}
+    for _ in range(args.rounds):
+        for c, fn in chains.items():
+            t0 = time.perf_counter()
+            jax.device_get(fn(q, k, v, args.n_short))
+            t_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            jax.device_get(fn(q, k, v, args.n_long))
+            t_l = time.perf_counter() - t0
+            slopes[c].append((t_l - t_s) / (args.n_long - args.n_short))
+
+    flops = attention_flops(args.seq, args.seq, args.dim, args.dim,
+                            causal=args.causal) * args.heads
+    peak = peak_flops()
+    out = {}
+    for c, ss in slopes.items():
+        per = statistics.median(ss)
+        out[c] = {
+            "ms": round(per * 1e3, 3),
+            "util": round(flops / per / peak, 4),
+            "spread": f"{min(ss)*1e3:.2f}-{max(ss)*1e3:.2f}ms",
+        }
+        print(json.dumps({c: out[c]}), flush=True)
+    best = min(out, key=lambda c: out[c]["ms"])
+    print(json.dumps({"best": best, **{"detail": out[best]}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,13 +12,14 @@ Exit status 0 iff every variant lowered and agreed with its oracle.
 
 from __future__ import annotations
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-sys.path.insert(0, ".")
 
 from attention_tpu.ops.decode import flash_decode
 from attention_tpu.ops.flash import flash_attention, flash_attention_partials
