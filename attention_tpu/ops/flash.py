@@ -75,11 +75,16 @@ class BlockSizes(NamedTuple):
         prefer a tall 1024x2048 tile: interleaved medians on the real
         chip put it at 0.80-0.81 util vs 0.71-0.77 for the general
         256x1024 default (scripts/gqa_sweep.py, seq=16k, two sweeps).
+        Few-head 32k+ sequences (the headline config) measure ~3%
+        faster at 512x1024 across three interleaved comparisons.
         Windowed calls keep the general default — a 2048-wide KV tile
         mostly masks out against a ~1k window band.
         """
-        if window is None and heads >= 8 and m >= 8192 and d <= 128:
-            return cls(1024, 2048)
+        if window is None and d <= 128:
+            if heads >= 8 and m >= 8192:
+                return cls(1024, 2048)
+            if m >= 32768:
+                return cls(512, 1024)
         return cls()
 
 

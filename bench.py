@@ -148,6 +148,31 @@ def _bench_paged_decode_s(batch: int, heads: int, kv_heads: int,
     )
 
 
+
+# A slope implying more than this fraction of peak matmul FLOPs is
+# treated as the chip's known absurd-fast outlier and re-measured.
+PLAUSIBLE_UTIL = 0.98
+
+
+def _measure_plausible(measure, flops, attempts=4):
+    """(seconds, plausible): re-run ``measure()`` until the timing is
+    physically possible (util <= PLAUSIBLE_UTIL of peak matmul FLOPs).
+
+    The shared chip occasionally returns an absurd-fast outlier (a slope
+    as low as 0.3x the real time — one observed run implied 2.6x peak).
+    Reporting one would be dishonest; up to ``attempts`` total tries,
+    first plausible attempt wins, else the last attempt ships flagged.
+    """
+    from attention_tpu.utils.flops import peak_flops
+
+    t = None
+    for _ in range(attempts):
+        t = measure()
+        if flops / t / peak_flops() <= PLAUSIBLE_UTIL:
+            return t, True
+    return t, False
+
+
 def _time_serial_once(seq: int, dim: int) -> float:
     import numpy as np
 
@@ -210,13 +235,15 @@ def main(argv=None) -> int:
 
     from attention_tpu.utils.flops import attention_flops, peak_flops
 
-    tpu_s = _bench_flash_s(args.seq, args.dim, args.repeats, args.block_q,
-                           args.block_k)
+    flops = attention_flops(args.seq, args.seq, args.dim, args.dim)
+
+    tpu_s, plausible = _measure_plausible(
+        lambda: _bench_flash_s(args.seq, args.dim, args.repeats,
+                               args.block_q, args.block_k), flops)
     serial_s = _bench_serial_s(min(args.serial_seq, args.seq), args.dim,
                                args.seq)
     speedup = serial_s / tpu_s
 
-    flops = attention_flops(args.seq, args.seq, args.dim, args.dim)
     util = flops / tpu_s / peak_flops()
     result = {
         "metric": f"attention speedup vs serial attention.c baseline "
@@ -233,6 +260,10 @@ def main(argv=None) -> int:
             "reference_best_speedup": 7.49,
         },
     }
+    if not plausible:
+        result["detail"]["implausible_timing"] = (
+            "slope exceeds peak FLOPs after 4 attempts; chip outlier"
+        )
 
     if args.all:
         # The BASELINE.md config ladder (serial config 1 is the
@@ -252,10 +283,12 @@ def main(argv=None) -> int:
                 # jitter; big configs keep chains short so compile+upload
                 # don't dominate wall time.
                 n_long = max(8, min(64, (32768 // seq) * 16))
-                s = _bench_flash_s(seq, dim, args.repeats, args.block_q,
-                                   args.block_k, heads=h, kv_heads=hkv,
-                                   n_short=max(2, n_long // 8),
-                                   n_long=n_long)
+                s, _ok = _measure_plausible(
+                    lambda: _bench_flash_s(
+                        seq, dim, args.repeats, args.block_q,
+                        args.block_k, heads=h, kv_heads=hkv,
+                        n_short=max(2, n_long // 8), n_long=n_long),
+                    attention_flops(seq, seq, dim, dim) * (h or 1))
             fl = attention_flops(seq, seq, dim, dim) * (h or 1)
             ladder[name] = {
                 "ms": round(s * 1e3, 3),
@@ -263,10 +296,11 @@ def main(argv=None) -> int:
                 "util": round(fl / s / peak_flops(), 4),
             }
         # sliding-window config: banded grid, cost ~ window not sequence
-        w_s = _bench_flash_s(32768, 128, args.repeats, args.block_q,
-                             args.block_k, window=1024, n_short=4,
-                             n_long=32)
         w_fl = 2 * 32768 * (1024 + (args.block_q or 256)) * (128 + 128)
+        w_s, _ok = _measure_plausible(
+            lambda: _bench_flash_s(32768, 128, args.repeats, args.block_q,
+                                   args.block_k, window=1024, n_short=4,
+                                   n_long=32), w_fl)
         ladder["swa_w1024_32k"] = {
             "ms": round(w_s * 1e3, 3),
             "gflops": round(w_fl / w_s / 1e9, 1),
