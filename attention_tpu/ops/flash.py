@@ -75,8 +75,9 @@ class BlockSizes(NamedTuple):
         prefer a tall 1024x2048 tile: interleaved medians on the real
         chip put it at 0.80-0.81 util vs 0.71-0.77 for the general
         256x1024 default (scripts/gqa_sweep.py, seq=16k, two sweeps).
-        Few-head 32k+ sequences (the headline config) measure ~3%
-        faster at 512x1024 across three interleaved comparisons.
+        Few-head 32k+ sequences measure faster at 512x1024: ~3% at
+        the 32k headline shape (three interleaved comparisons) and ~2%
+        at 131k (55.3 vs 56.5 ms interleaved); both non-causal.
         Windowed calls keep the general default — a 2048-wide KV tile
         mostly masks out against a ~1k window band.
         """

@@ -275,29 +275,30 @@ def main(argv=None) -> int:
             "long_131k": (131072, 128, None, None),
             "gqa_32q4kv_16k": (16384, 128, 32, 4),
         }.items():
+            fl = attention_flops(seq, seq, dim, dim) * (h or 1)
             if (seq, dim, h) == (args.seq, args.dim, None):
-                s = tpu_s  # headline already measured this config
+                s, ok = tpu_s, plausible  # headline already measured
             else:
                 # Scan-chain lengths scale inversely with per-call cost:
                 # small configs need long chains to rise above dispatch
                 # jitter; big configs keep chains short so compile+upload
                 # don't dominate wall time.
                 n_long = max(8, min(64, (32768 // seq) * 16))
-                s, _ok = _measure_plausible(
+                s, ok = _measure_plausible(
                     lambda: _bench_flash_s(
                         seq, dim, args.repeats, args.block_q,
                         args.block_k, heads=h, kv_heads=hkv,
-                        n_short=max(2, n_long // 8), n_long=n_long),
-                    attention_flops(seq, seq, dim, dim) * (h or 1))
-            fl = attention_flops(seq, seq, dim, dim) * (h or 1)
+                        n_short=max(2, n_long // 8), n_long=n_long), fl)
             ladder[name] = {
                 "ms": round(s * 1e3, 3),
                 "gflops": round(fl / s / 1e9, 1),
                 "util": round(fl / s / peak_flops(), 4),
             }
+            if not ok:
+                ladder[name]["implausible_timing"] = True
         # sliding-window config: banded grid, cost ~ window not sequence
         w_fl = 2 * 32768 * (1024 + (args.block_q or 256)) * (128 + 128)
-        w_s, _ok = _measure_plausible(
+        w_s, w_ok = _measure_plausible(
             lambda: _bench_flash_s(32768, 128, args.repeats, args.block_q,
                                    args.block_k, window=1024, n_short=4,
                                    n_long=32), w_fl)
@@ -305,6 +306,8 @@ def main(argv=None) -> int:
             "ms": round(w_s * 1e3, 3),
             "gflops": round(w_fl / w_s / 1e9, 1),
         }
+        if not w_ok:
+            ladder["swa_w1024_32k"]["implausible_timing"] = True
         # fixed config (name encodes it) — independent of --dim/--seq
         dec_b, dec_h, dec_hkv, dec_len, dec_d = 8, 32, 4, 32768, 128
         dec_s = _bench_decode_s(dec_b, dec_h, dec_hkv, dec_len, dec_d,
