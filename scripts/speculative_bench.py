@@ -1,0 +1,152 @@
+"""Honest speculative-decoding benchmark with a TRAINED draft.
+
+Round 1 could only report a negative result: with a random-weight draft
+(acceptance ~1/vocab) or the target drafting for itself (cost ratio 1),
+speculative decoding cannot win, and large pre-trained pairs exceed the
+axon tunnel's compile-size limit.  The missing ingredient is a draft
+that is both CHEAP and USUALLY RIGHT — so this benchmark manufactures
+one: target (dim 512, depth 2) and draft (dim 128, depth 1) are both
+trained to near-zero loss on a deterministic arithmetic-sequence
+language (next = 3*prev + 7 mod V), giving ~100% draft acceptance with
+a ~8x cheaper draft — the regime distillation aims for.
+
+Timing is device_get-of-scalar (the tunnel ignores block_until_ready),
+with ALL configs interleaved round-robin in one process (medians) per
+the repo's contention-honesty rule; the speculative output is asserted
+exactly equal to target greedy.
+
+Result on record (2026-07-30, v5 lite chip, 4k-token prompt, 128
+steps, interleaved 5-round medians — the authoritative run; see
+RESULTS.md): plain 1.014 ms/tok; gamma=12 -> 1.45x, gamma=8 -> 1.09x,
+gamma=4 -> 1.07x.  Earlier same-day windows measured up to 1.58x.
+
+Run: python scripts/speculative_bench.py [--gammas 4,8,12] [--sanity]
+(--sanity also times two reference configs: a random-weight draft,
+acceptance ~1/V, and the target drafting for itself, cost ratio 1.
+Interpret those with care: at batch 1 the per-token cost of ALL these
+loops is dominated by per-iteration loop overhead, not attention — the
+decode kernel itself measures ~4 us inside a ~1 ms/tok loop — so the
+reference configs mostly compare loop structures, not acceptance.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gammas", type=str, default="4,8,12")
+    ap.add_argument("--sanity", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from attention_tpu.models import TinyDecoder, generate
+    from attention_tpu.models.speculative import generate_speculative
+
+    V = 251
+    rng = np.random.default_rng(0)
+
+    def make_batch(b, s):
+        start = rng.integers(1, V, (b, 1))
+        seq = [start]
+        for _ in range(s - 1):
+            seq.append((seq[-1] * 3 + 7) % V)
+        return jnp.asarray(np.concatenate(seq, 1), jnp.int32)
+
+    target = TinyDecoder(vocab=V, dim=512, depth=2, num_q_heads=8,
+                         num_kv_heads=2, impl="flash")
+    draft = TinyDecoder(vocab=V, dim=128, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash")
+
+    def train(model, key, steps=250):
+        toks = make_batch(16, 64)
+        params = model.init(jax.random.PRNGKey(key), toks[:, :-1])["params"]
+        opt = optax.adam(3e-3)
+        st = opt.init(params)
+
+        @jax.jit
+        def step(p, st, toks):
+            def loss(p):
+                lg = model.apply({"params": p}, toks[:, :-1])
+                lp = jax.nn.log_softmax(lg)
+                return -jnp.mean(
+                    jnp.take_along_axis(lp, toks[:, 1:, None], -1)
+                )
+
+            l, g = jax.value_and_grad(loss)(p)
+            up, st2 = opt.update(g, st)
+            return optax.apply_updates(p, up), st2, l
+
+        loss = None
+        for _ in range(steps):
+            params, st, loss = step(params, st, make_batch(16, 64))
+        return params, float(loss)
+
+    tp, tl = train(target, 0)
+    dp, dl = train(draft, 1)
+    print(json.dumps({"target_loss": round(tl, 5),
+                      "draft_loss": round(dl, 5)}))
+
+    prompt = make_batch(1, 4096)
+    steps = 128
+
+    configs = {"plain": lambda: generate(target, tp, prompt, steps=steps)}
+    for gamma in (int(g) for g in args.gammas.split(",")):
+        configs[f"gamma={gamma}"] = (
+            lambda gamma=gamma: generate_speculative(
+                target, tp, draft, dp, prompt, steps=steps, gamma=gamma))
+    if args.sanity:
+        # configs that must NOT win: random-weight draft (acceptance
+        # ~1/V) and the target drafting for itself (cost ratio 1)
+        rp = draft.init(jax.random.PRNGKey(99), prompt[:, :8])["params"]
+        configs["sanity:random-draft"] = lambda: generate_speculative(
+            target, tp, draft, rp, prompt, steps=steps, gamma=4)
+        configs["sanity:self-draft"] = lambda: generate_speculative(
+            target, tp, target, tp, prompt, steps=steps, gamma=4)
+
+    # exactness first (and compile+warm every config): EVERY
+    # speculative config must equal target greedy exactly — including
+    # the sanity ones, whose ~0-acceptance regime exercises the cache
+    # rollback path hardest
+    plain = np.asarray(configs["plain"]())
+    for name, fn in configs.items():
+        if name == "plain":
+            jax.device_get(jnp.sum(fn()))
+        elif not (np.asarray(fn()) == plain).all():
+            print(json.dumps({name: "OUTPUT MISMATCH"}))
+            return 1
+
+    # interleaved rounds: every config timed once per round, medians
+    import statistics
+
+    rounds = 5
+    times = {name: [] for name in configs}
+    for _ in range(rounds):
+        for name, fn in configs.items():
+            t0 = time.perf_counter()
+            jax.device_get(jnp.sum(fn()))
+            times[name].append(time.perf_counter() - t0)
+    t_plain = statistics.median(times["plain"])
+    for name, ts in times.items():
+        t = statistics.median(ts)
+        print(json.dumps({
+            "config": name,
+            "ms_per_tok": round(t / steps * 1e3, 3),
+            "speedup_vs_plain": round(t_plain / t, 2),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
