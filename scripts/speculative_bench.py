@@ -10,23 +10,28 @@ trained to near-zero loss on a deterministic arithmetic-sequence
 language (next = 3*prev + 7 mod V), giving ~100% draft acceptance with
 a ~8x cheaper draft — the regime distillation aims for.
 
-Timing is device_get-of-scalar (the tunnel ignores block_until_ready),
-with ALL configs interleaved round-robin in one process (medians) per
-the repo's contention-honesty rule; the speculative output is asserted
-exactly equal to target greedy.
+Timing: the PRIMARY metric is device-side module time from a
+jax.profiler trace (sum of the "XLA Modules" lane), because wall-clock
+through the axon tunnel carries +-tens-of-ms of per-invocation latency
+variance — enough to manufacture fake 1.5x "wins" on a ~5 ms device
+workload (this script's first draft did exactly that; the trace
+exposed it).  Wall-clock interleaved medians are reported as a
+secondary column.  The speculative output is asserted exactly equal to
+target greedy for every config.
 
-Result on record (2026-07-30, v5 lite chip, 4k-token prompt, 128
-steps, interleaved 5-round medians — the authoritative run; see
-RESULTS.md): plain 1.014 ms/tok; gamma=12 -> 1.45x, gamma=8 -> 1.09x,
-gamma=4 -> 1.07x.  Earlier same-day windows measured up to 1.58x.
+Result on record (2026-07-30, v5 lite chip, 4k prompt, 128 steps,
+DEVICE time): plain 34.9 us/tok; gamma=12 -> 1.12x, gamma=8 -> ~1.0x,
+gamma=4 -> 0.88x.  The honest conclusion: at tunnel-compilable scale
+the machinery is exact and roughly break-even, winning slightly at
+high gamma; the real win regime (target step >> draft step + loop
+overhead) needs a larger target than the tunnel will compile, as
+round 1 found.
 
 Run: python scripts/speculative_bench.py [--gammas 4,8,12] [--sanity]
-(--sanity also times two reference configs: a random-weight draft,
-acceptance ~1/V, and the target drafting for itself, cost ratio 1.
-Interpret those with care: at batch 1 the per-token cost of ALL these
-loops is dominated by per-iteration loop overhead, not attention — the
-decode kernel itself measures ~4 us inside a ~1 ms/tok loop — so the
-reference configs mostly compare loop structures, not acceptance.)
+(--sanity adds two reference configs: a random-weight draft,
+acceptance ~1/V — expected to LOSE on device time since every
+iteration pays gamma drafts + a verify for ~1 token — and the target
+drafting for itself, cost ratio 1, expected ~1x or below.)
 """
 
 from __future__ import annotations
@@ -127,9 +132,56 @@ def main() -> int:
             print(json.dumps({name: "OUTPUT MISMATCH"}))
             return 1
 
-    # interleaved rounds: every config timed once per round, medians
+    # PRIMARY metric: device-side module time from a profiler trace
+    # (wall-clock through the tunnel varies by tens of ms per call).
+    import glob
+    import gzip
+    import shutil
     import statistics
 
+    from attention_tpu.utils.profiling import trace  # noqa: E402
+
+    def device_ms(fn, tag):
+        log = f"/tmp/specbench_{tag}"
+        shutil.rmtree(log, ignore_errors=True)
+        with trace(log):
+            jax.device_get(jnp.sum(fn()))
+        paths = sorted(
+            glob.glob(f"{log}/plugins/profile/*/*.trace.json.gz"))
+        if not paths:
+            raise SystemExit(
+                f"no profiler trace captured under {log} — this metric "
+                "needs a device platform whose profiler exports a trace"
+            )
+        d = json.load(gzip.open(paths[-1]))
+        lanes = {}
+        for e in d["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+        ms = sum(
+            e["dur"] for e in d["traceEvents"]
+            if e.get("ph") == "X"
+            and lanes.get((e.get("pid"), e.get("tid"))) == "XLA Modules"
+        ) / 1e3
+        if ms <= 0:
+            raise SystemExit(
+                "trace has no 'XLA Modules' device lane (CPU platform or "
+                "incompatible profiler export) — device metric unavailable"
+            )
+        return ms
+
+    # 3 interleaved trace rounds per config, medians — device module
+    # time is far less contention-sensitive than wall-clock, but the
+    # repo's measurement discipline (interleave + median) applies to
+    # every comparative claim.
+    dev_samples = {name: [] for name in configs}
+    for r in range(3):
+        for name, fn in configs.items():
+            dev_samples[name].append(
+                device_ms(fn, f"{name.replace(':', '_')}_{r}"))
+    dev = {name: statistics.median(ss) for name, ss in dev_samples.items()}
+
+    # secondary: wall-clock interleaved medians
     rounds = 5
     times = {name: [] for name in configs}
     for _ in range(rounds):
@@ -137,13 +189,15 @@ def main() -> int:
             t0 = time.perf_counter()
             jax.device_get(jnp.sum(fn()))
             times[name].append(time.perf_counter() - t0)
-    t_plain = statistics.median(times["plain"])
-    for name, ts in times.items():
-        t = statistics.median(ts)
+    d_plain = dev["plain"]
+    w_plain = statistics.median(times["plain"])
+    for name in configs:
+        w = statistics.median(times[name])
         print(json.dumps({
             "config": name,
-            "ms_per_tok": round(t / steps * 1e3, 3),
-            "speedup_vs_plain": round(t_plain / t, 2),
+            "device_us_per_tok": round(dev[name] / steps * 1e3, 1),
+            "device_speedup_vs_plain": round(d_plain / dev[name], 2),
+            "wallclock_speedup_vs_plain_secondary": round(w_plain / w, 2),
         }))
     return 0
 
