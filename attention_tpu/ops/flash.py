@@ -71,21 +71,24 @@ class BlockSizes(NamedTuple):
                   window: int | None = None) -> "BlockSizes":
         """Measured per-shape defaults (callers may always override).
 
-        Many-head long-sequence shapes (the 32q/4kv GQA ladder config)
-        prefer a tall 1024x2048 tile: interleaved medians on the real
-        chip put it at 0.80-0.81 util vs 0.71-0.77 for the general
-        256x1024 default (scripts/gqa_sweep.py, seq=16k, two sweeps).
-        Few-head 32k+ sequences measure faster at 512x1024: ~3% at
-        the 32k headline shape (three interleaved comparisons) and ~2%
-        at 131k (55.3 vs 56.5 ms interleaved); both non-causal.
-        Windowed calls keep the general default — a 2048-wide KV tile
-        mostly masks out against a ~1k window band.
+        With the deterministic device-time clock
+        (`utils.timing.benchmark_traced` — reproduces to the decimal,
+        unlike the contention-swung wall clock), one tile wins every
+        unwindowed d<=128 shape with m >= 8192: a tall **2048x1024**.
+        Device-lane utilization vs the 256x1024 general default:
+        single-head 8k 0.785 vs 0.745, 16k 0.801 vs 0.763, 32k 0.809
+        vs 0.773, 131k 0.816 vs 0.774, GQA 32q/4kv@16k 0.787 vs 0.721.
+        Windowed long sequences prefer a compact **512x512** tile — the
+        band covers ceil((window-1+block_q)/block_k)+1 KV blocks, so
+        smaller square tiles waste less of the band on masked columns:
+        at seq=32k (device clock) w=1024 runs 227 us vs 329 for the
+        general default, w=4096 575 vs 718, w=256 166 vs 153 for
+        256x512 (within a whisker of the best).
         """
-        if window is None and d <= 128:
-            if heads >= 8 and m >= 8192:
-                return cls(1024, 2048)
-            if m >= 32768:
-                return cls(512, 1024)
+        if d <= 128 and m >= 8192:
+            if window is None:
+                return cls(2048, 1024)
+            return cls(512, 512)
         return cls()
 
 

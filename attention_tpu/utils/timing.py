@@ -109,6 +109,32 @@ def benchmark_attention(fn, q, k, v, *, repeats: int = 5, warmup: int = 2,
     return Timing(times_s=[per])
 
 
+def _chained_scan(fn):
+    """Jitted n-fold application of ``fn`` with a data dependency.
+
+    Shared builder for the two chained clocks (:func:`benchmark_amortized`,
+    :func:`benchmark_traced`): each iteration consumes the previous
+    output (cast back to the input dtype), and the return value is one
+    scalar so fetching it cannot be transfer-dominated.  Big side inputs
+    must come through ``ops`` — closure-captured arrays become jaxpr
+    constants and make lowering take minutes at hundreds of MB.
+    """
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def chained(x0, ops, n):
+        def body(carry, _):
+            return fn(carry, *ops).astype(x0.dtype), None
+
+        out, _ = lax.scan(body, x0, None, length=n)
+        return jnp.sum(out.astype(jnp.float32))
+
+    return chained
+
+
 def benchmark_amortized(
     fn: Callable,
     x,
@@ -134,19 +160,7 @@ def benchmark_amortized(
     arrays are flattened into the jaxpr as constants, and at
     hundreds-of-MB that makes lowering/compilation take minutes.
     """
-    import functools
-
-    import jax.numpy as jnp
-    from jax import lax
-
-    @functools.partial(jax.jit, static_argnums=2)
-    def chained(x0, ops, n):
-        def body(carry, _):
-            return fn(carry, *ops).astype(x0.dtype), None
-
-        out, _ = lax.scan(body, x0, None, length=n)
-        return jnp.sum(out.astype(jnp.float32))
-
+    chained = _chained_scan(fn)
     jax.device_get(chained(x, operands, n_short))  # compile both lengths
     jax.device_get(chained(x, operands, n_long))
     slopes, longs = [], []
@@ -173,3 +187,69 @@ def benchmark_amortized(
         # just conservative: fixed overhead is charged to the iterations.
         slope = statistics.median(longs) / n_long
     return slope
+
+
+def benchmark_traced(
+    fn: Callable,
+    x,
+    *,
+    n: int = 20,
+    operands: tuple = (),
+    repeats: int = 3,
+) -> float | None:
+    """Per-iteration seconds from DEVICE-side profiler time, or None.
+
+    Chains ``n`` applications of ``fn`` (same contract as
+    :func:`benchmark_amortized`), captures a ``jax.profiler`` trace, and
+    sums the trace's "XLA Modules" device lane.  Device module time is
+    deterministic on the shared chip (measured identical to the decimal
+    across repeats) where wall-clock sways with tunnel latency and
+    contention — so this is the preferred clock when a device trace is
+    available.  Returns the median over ``repeats`` captures, or None
+    when the platform's profiler exports no device lane (e.g. CPU);
+    callers fall back to :func:`benchmark_amortized`.
+    """
+    import glob
+    import gzip
+    import json
+    import shutil
+    import statistics
+    import tempfile
+
+    chained = _chained_scan(fn)
+    jax.device_get(chained(x, operands, n))  # compile + warm
+
+    def one_capture(log_dir) -> float | None:
+        shutil.rmtree(log_dir, ignore_errors=True)
+        with jax.profiler.trace(log_dir):
+            jax.device_get(chained(x, operands, n))
+        paths = sorted(
+            glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz"))
+        if not paths:
+            return None
+        d = json.load(gzip.open(paths[-1]))
+        lanes = {}
+        for e in d["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+        per_module: dict = {}
+        for e in d["traceEvents"]:
+            if (e.get("ph") == "X"
+                    and lanes.get((e.get("pid"), e.get("tid")))
+                    == "XLA Modules"):
+                key = e["name"].split("(")[0]
+                per_module[key] = per_module.get(key, 0.0) + e["dur"]
+        if not per_module:
+            return None
+        # the chained scan dominates; stray scalar modules (the sum
+        # fetch) are orders of magnitude smaller
+        return max(per_module.values()) / 1e6 / n
+
+    with tempfile.TemporaryDirectory(prefix="bench_trace_") as td:
+        samples = []
+        for i in range(repeats):
+            s = one_capture(f"{td}/{i}")
+            if s is None:
+                return None
+            samples.append(s)
+    return statistics.median(samples)
