@@ -66,3 +66,33 @@ def append_jsonl(path: str, record: RunRecord) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:
         f.write(record.to_json() + "\n")
+
+
+def device_module_seconds(log_dir: str) -> dict[str, float] | None:
+    """Per-module device seconds from a ``trace(log_dir)`` capture.
+
+    Parses the newest Chrome-trace export under ``log_dir`` and sums the
+    duration of each module on the device "XLA Modules" lane.  Returns
+    ``{module_name: seconds}``, or None when no trace/device lane exists
+    (e.g. CPU platforms) — the shared parser for every device-time clock
+    (`utils.timing.benchmark_traced`, `scripts/speculative_bench.py`).
+    """
+    import glob
+    import gzip
+    import json as _json
+
+    paths = sorted(glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        return None
+    data = _json.load(gzip.open(paths[-1]))
+    lanes = {}
+    for e in data["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+    per_module: dict[str, float] = {}
+    for e in data["traceEvents"]:
+        if (e.get("ph") == "X"
+                and lanes.get((e.get("pid"), e.get("tid"))) == "XLA Modules"):
+            key = e["name"].split("(")[0]
+            per_module[key] = per_module.get(key, 0.0) + e["dur"] / 1e6
+    return per_module or None

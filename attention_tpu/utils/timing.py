@@ -143,6 +143,7 @@ def benchmark_amortized(
     n_short: int = 4,
     n_long: int = 20,
     operands: tuple = (),
+    _chained=None,
 ) -> float:
     """Per-iteration seconds of ``fn`` via scan-chained slope timing.
 
@@ -160,7 +161,7 @@ def benchmark_amortized(
     arrays are flattened into the jaxpr as constants, and at
     hundreds-of-MB that makes lowering/compilation take minutes.
     """
-    chained = _chained_scan(fn)
+    chained = _chained if _chained is not None else _chained_scan(fn)
     jax.device_get(chained(x, operands, n_short))  # compile both lengths
     jax.device_get(chained(x, operands, n_long))
     slopes, longs = [], []
@@ -196,12 +197,14 @@ def benchmark_traced(
     n: int = 20,
     operands: tuple = (),
     repeats: int = 3,
+    _chained=None,
 ) -> float | None:
     """Per-iteration seconds from DEVICE-side profiler time, or None.
 
     Chains ``n`` applications of ``fn`` (same contract as
     :func:`benchmark_amortized`), captures a ``jax.profiler`` trace, and
-    sums the trace's "XLA Modules" device lane.  Device module time is
+    sums the trace's "XLA Modules" device lane (shared parser:
+    `utils.profiling.device_module_seconds`).  Device module time is
     deterministic on the shared chip (measured identical to the decimal
     across repeats) where wall-clock sways with tunnel latency and
     contention — so this is the preferred clock when a device trace is
@@ -209,47 +212,57 @@ def benchmark_traced(
     when the platform's profiler exports no device lane (e.g. CPU);
     callers fall back to :func:`benchmark_amortized`.
     """
-    import glob
-    import gzip
-    import json
     import shutil
     import statistics
     import tempfile
 
-    chained = _chained_scan(fn)
+    from attention_tpu.utils.profiling import device_module_seconds
+
+    chained = _chained if _chained is not None else _chained_scan(fn)
     jax.device_get(chained(x, operands, n))  # compile + warm
 
     def one_capture(log_dir) -> float | None:
         shutil.rmtree(log_dir, ignore_errors=True)
         with jax.profiler.trace(log_dir):
             jax.device_get(chained(x, operands, n))
-        paths = sorted(
-            glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz"))
-        if not paths:
-            return None
-        d = json.load(gzip.open(paths[-1]))
-        lanes = {}
-        for e in d["traceEvents"]:
-            if e.get("ph") == "M" and e.get("name") == "thread_name":
-                lanes[(e["pid"], e["tid"])] = e["args"]["name"]
-        per_module: dict = {}
-        for e in d["traceEvents"]:
-            if (e.get("ph") == "X"
-                    and lanes.get((e.get("pid"), e.get("tid")))
-                    == "XLA Modules"):
-                key = e["name"].split("(")[0]
-                per_module[key] = per_module.get(key, 0.0) + e["dur"]
-        if not per_module:
+        mods = device_module_seconds(log_dir)
+        if not mods:
             return None
         # the chained scan dominates; stray scalar modules (the sum
         # fetch) are orders of magnitude smaller
-        return max(per_module.values()) / 1e6 / n
+        return max(mods.values()) / n
 
     with tempfile.TemporaryDirectory(prefix="bench_trace_") as td:
         samples = []
         for i in range(repeats):
-            s = one_capture(f"{td}/{i}")
-            if s is None:
+            sec = one_capture(f"{td}/{i}")
+            if sec is None:
                 return None
-            samples.append(s)
+            samples.append(sec)
     return statistics.median(samples)
+
+
+def benchmark_auto(
+    fn: Callable,
+    x,
+    *,
+    operands: tuple = (),
+    repeats: int = 3,
+    n_short: int = 4,
+    n_long: int = 20,
+) -> float:
+    """Per-iteration seconds via the best available clock.
+
+    Builds the chained-scan program ONCE, tries the deterministic
+    device-trace clock, and falls back to the wall-clock slope on the
+    same compiled function when no device lane exists — so fallback
+    platforms pay a single compile, not two.
+    """
+    chained = _chained_scan(fn)
+    traced = benchmark_traced(fn, x, n=n_long, operands=operands,
+                              repeats=max(1, repeats), _chained=chained)
+    if traced is not None:
+        return traced
+    return benchmark_amortized(fn, x, repeats=repeats, n_short=n_short,
+                               n_long=n_long, operands=operands,
+                               _chained=chained)

@@ -54,7 +54,7 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
     import jax.numpy as jnp
 
     from attention_tpu.ops.flash import BlockSizes, flash_attention
-    from attention_tpu.utils.timing import benchmark_amortized, benchmark_traced
+    from attention_tpu.utils.timing import benchmark_auto
 
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     qshape = (seq, dim) if heads is None else (heads, seq, dim)
@@ -63,26 +63,20 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
     k = jax.random.normal(kk, kvshape, jnp.bfloat16)
     v = jax.random.normal(kv, kvshape, jnp.bfloat16)
     # None -> the library's measured per-shape default (BlockSizes.for_shape);
-    # a partial override fills the other field from the general default.
+    # a partial override fills the other field from that EFFECTIVE tile,
+    # so the run and any FLOPs estimate derived from effective_block_sizes
+    # agree in every flag combination.
+    eff = BlockSizes.for_shape(heads or 1, seq, dim, window)
     if block_q is None and block_k is None:
-        bs = None
+        bs = None  # let the library resolve (same as eff)
     else:
-        bs = BlockSizes(block_q or BlockSizes().block_q,
-                        block_k or BlockSizes().block_k)
+        bs = BlockSizes(block_q or eff.block_q, block_k or eff.block_k)
     step = lambda x, kk, vv: flash_attention(  # noqa: E731
         x, kk, vv, block_sizes=bs, causal=window is not None, window=window,
     )
-    # Preferred clock: device-side profiler time (deterministic on the
-    # shared chip); falls back to the scan-slope wall clock when the
-    # platform exports no device trace lane.
-    traced = benchmark_traced(step, q, n=n_long, operands=(k, v),
-                              repeats=max(1, repeats))
-    if traced is not None:
-        return traced
-    return benchmark_amortized(
-        step, q, repeats=repeats, n_short=n_short, n_long=n_long,
-        operands=(k, v),
-    )
+    # benchmark_auto: deterministic device-trace clock, slope fallback.
+    return benchmark_auto(step, q, repeats=repeats, n_short=n_short,
+                          n_long=n_long, operands=(k, v))
 
 
 def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
@@ -92,7 +86,7 @@ def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
     import jax.numpy as jnp
 
     from attention_tpu.ops.decode import flash_decode
-    from attention_tpu.utils.timing import benchmark_amortized, benchmark_traced
+    from attention_tpu.utils.timing import benchmark_auto
 
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(kq, (batch, heads, dim), jnp.bfloat16)
@@ -108,19 +102,11 @@ def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
         qkv = quantize_kv(kc, vc)
         stepq = lambda x, c, ll: (  # noqa: E731
             flash_decode_quantized(x, c, ll).astype(x.dtype))
-        tq = benchmark_traced(stepq, q, operands=(qkv, lens),
-                              repeats=max(1, repeats))
-        if tq is not None:
-            return tq
-        return benchmark_amortized(stepq, q, repeats=repeats,
-                                   operands=(qkv, lens))
+        return benchmark_auto(stepq, q, repeats=repeats,
+                              operands=(qkv, lens))
     stepd = lambda x, kcc, vcc, ll: flash_decode(x, kcc, vcc, ll)  # noqa: E731
-    td = benchmark_traced(stepd, q, operands=(kc, vc, lens),
-                          repeats=max(1, repeats))
-    if td is not None:
-        return td
-    return benchmark_amortized(stepd, q, repeats=repeats,
-                               operands=(kc, vc, lens))
+    return benchmark_auto(stepd, q, repeats=repeats,
+                          operands=(kc, vc, lens))
 
 
 def _bench_paged_decode_s(batch: int, heads: int, kv_heads: int,
@@ -133,7 +119,7 @@ def _bench_paged_decode_s(batch: int, heads: int, kv_heads: int,
 
     from attention_tpu.ops.paged import PagePool, paged_from_dense, \
         paged_flash_decode
-    from attention_tpu.utils.timing import benchmark_amortized, benchmark_traced
+    from attention_tpu.utils.timing import benchmark_auto
 
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(kq, (batch, heads, dim), jnp.bfloat16)
@@ -155,12 +141,7 @@ def _bench_paged_decode_s(batch: int, heads: int, kv_heads: int,
         num_pages=num_pages, page_size=page_size,
     )
     stepp = lambda x, c: paged_flash_decode(x, c).astype(x.dtype)  # noqa: E731
-    tp = benchmark_traced(stepp, q, operands=(cache,),
-                          repeats=max(1, repeats))
-    if tp is not None:
-        return tp
-    return benchmark_amortized(stepp, q, repeats=repeats,
-                               operands=(cache,))
+    return benchmark_auto(stepp, q, repeats=repeats, operands=(cache,))
 
 
 
@@ -312,7 +293,13 @@ def main(argv=None) -> int:
             if not ok:
                 ladder[name]["implausible_timing"] = True
         # sliding-window config: banded grid, cost ~ window not sequence
-        w_fl = 2 * 32768 * (1024 + (args.block_q or 256)) * (128 + 128)
+        # band FLOPs estimate uses the same effective tile the run uses
+        # (explicit flag wins; else for_shape's windowed default)
+        from attention_tpu.ops.flash import BlockSizes
+
+        w_bq = args.block_q or BlockSizes.for_shape(1, 32768, 128,
+                                                    window=1024).block_q
+        w_fl = 2 * 32768 * (1024 + w_bq) * (128 + 128)
         w_s, w_ok = _measure_plausible(
             lambda: _bench_flash_s(32768, 128, args.repeats, args.block_q,
                                    args.block_k, window=1024, n_short=4,
