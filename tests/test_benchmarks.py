@@ -107,3 +107,36 @@ def test_bench_cli_smoke():
     rc = mod.main(["--seq", "256", "--dim", "64", "--repeats", "1",
                    "--serial-seq", "256"])
     assert rc == 0
+
+
+def test_blocksizes_for_shape_rules():
+    """The measured tile lookup: 2048x1024 for unwindowed long d<=128
+    shapes, 512x512 for windowed ones, general default elsewhere;
+    explicit block_sizes= always wins (callers pass it through)."""
+    from attention_tpu.ops.flash import BlockSizes
+
+    assert BlockSizes.for_shape(1, 8192, 128) == BlockSizes(2048, 1024)
+    assert BlockSizes.for_shape(32, 16384, 128) == BlockSizes(2048, 1024)
+    assert BlockSizes.for_shape(1, 32768, 128, window=1024) == \
+        BlockSizes(512, 512)
+    assert BlockSizes.for_shape(1, 4096, 128) == BlockSizes()
+    assert BlockSizes.for_shape(1, 8192, 256) == BlockSizes()
+    assert BlockSizes.for_shape(4, 4096, 128, window=64) == BlockSizes()
+
+
+def test_benchmark_auto_cpu_fallback():
+    """On CPU (no device trace lane) benchmark_auto must fall back to
+    the slope clock and return a positive per-iteration time."""
+    import jax.numpy as jnp
+
+    from attention_tpu.utils.timing import benchmark_auto
+
+    t = benchmark_auto(lambda x: x * 2.0, jnp.ones((64, 64)),
+                       n_short=2, n_long=6, repeats=2)
+    assert t > 0
+
+
+def test_device_module_seconds_missing_dir(tmp_path):
+    from attention_tpu.utils.profiling import device_module_seconds
+
+    assert device_module_seconds(str(tmp_path / "nope")) is None
