@@ -68,7 +68,8 @@ class BlockSizes(NamedTuple):
 
     @classmethod
     def for_shape(cls, heads: int, m: int, d: int,
-                  window: int | None = None) -> "BlockSizes":
+                  window: int | None = None,
+                  returns_stats: bool = False) -> "BlockSizes":
         """Measured per-shape defaults (callers may always override).
 
         With the deterministic device-time clock
@@ -84,11 +85,20 @@ class BlockSizes(NamedTuple):
         at seq=32k (device clock) w=1024 runs 227 us vs 329 for the
         general default, w=4096 575 vs 718, w=256 166 vs 153 for
         256x512 (within a whisker of the best).
+
+        ``returns_stats`` (the `flash_attention_partials` path) caps the
+        Q tile at 1024: the extra lane-replicated (block_q, 128) fp32
+        stat outputs push a 2048-row tile ~0.5 MB past the 16 MB scoped
+        VMEM limit (compile-time OOM, found at 16q/4kv seq=8k), and
+        1024x1024 is also the measured fastest stats tile (2.42 ms vs
+        2.73 for the general default at that shape).
         """
         if d <= 128 and m >= 8192:
-            if window is None:
-                return cls(2048, 1024)
-            return cls(512, 512)
+            if window is not None:
+                return cls(512, 512)
+            if returns_stats:
+                return cls(1024, 1024)
+            return cls(2048, 1024)
         return cls()
 
 
@@ -745,7 +755,8 @@ def flash_attention_partials(
         causal=causal,
         normalize=False,
         block_sizes=block_sizes or BlockSizes.for_shape(
-            qh.shape[0], qh.shape[1], qh.shape[2], window),
+            qh.shape[0], qh.shape[1], qh.shape[2], window,
+            returns_stats=True),
         return_stats=True,
         interpret=interpret,
         out_dtype=jnp.float32,

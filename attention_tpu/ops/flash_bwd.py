@@ -293,6 +293,22 @@ def _gqa_repeat(x, group):
     return jnp.repeat(x, group, axis=0) if group > 1 else x
 
 
+def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
+    """Measured backward tile defaults (see the rationale comment at the
+    use site in :func:`flash_backward`).  Windowed shapes keep the
+    round-1 512x512 — the banded grid covers
+    ceil((window-1+block_q)/block_k)+1 KV blocks, so a taller tile
+    computes ~50% more masked band columns, and the round-2 sweep only
+    measured unwindowed shapes."""
+    import jax.numpy as _jnp
+
+    if window is not None or d > 128:
+        return BlockSizes(512, 512)
+    if _jnp.dtype(dtype).itemsize <= 2:
+        return BlockSizes(1024, 1024)
+    return BlockSizes(512, 1024)
+
+
 def flash_backward(
     q: jax.Array,  # (h, m, d)
     k: jax.Array,  # (hkv, n, d)
@@ -328,12 +344,19 @@ def flash_backward(
             raise ValueError("sinks require window= (see flash_attention)")
         if segmented:
             raise ValueError("sinks do not compose with segment_ids")
-    # Backward default pinned independently of the forward's (256, 1024):
-    # scripts/bwd_sweep.py on the real chip put block_q=512 clearly ahead
-    # of 256 for the combined dQ+dKdV pass (~2.2 ms vs ~4 ms at seq=8k,
-    # h=4, bf16), with 512x512 and 512x1024 within contention noise of
-    # each other; 512x512 keeps the smaller VMEM footprint.
-    bs = block_sizes or BlockSizes(512, 512)
+    # Backward default pinned independently of the forward's: with the
+    # deterministic device clock (scripts/bwd_sweep.py + the shape grid
+    # in RESULTS.md round 2), 1024x1024 beats the round-1 512x512 by
+    # 22-28% on bf16 at every shape that compiles (9.43->7.39 ms at
+    # 16q/4kv 8k causal; 8.24->6.41 at 16k; 6.50->5.40 non-causal 8k),
+    # where 2048x1024 / 1024x2048 VMEM-OOM on some shapes.  fp32 inputs
+    # double the q/k/v/dO tile bytes and 1024x1024 OOMs inside the full
+    # VJP module (16.79M vs the 16M scoped limit at 16q/4kv 8k, even
+    # though it compiles standalone), so fp32 takes 512x1024 (still 15%
+    # over the old default: 8.98 vs 10.60 ms).  Larger head dims keep
+    # the smallest footprint.
+    bs = block_sizes or default_bwd_block_sizes(
+        q.shape[-1], q.dtype, window)
     h, m, d = q.shape
     hkv, n, dv = v.shape
     group = h // hkv

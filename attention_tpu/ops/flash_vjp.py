@@ -231,9 +231,10 @@ def flash_attention_diff(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
-    # None flows through: the forward resolves it to BlockSizes()'s
-    # (256, 1024) and flash_backward to its own (512, 512) default — the
-    # two kernels are tuned independently (see flash_bwd.py).
+    # None flows through: the forward resolves it via
+    # BlockSizes.for_shape(returns_stats=True) and flash_backward via
+    # default_bwd_block_sizes (dtype- and window-aware) — the two
+    # kernels are tuned independently (see flash_bwd.py).
     bs = block_sizes
     qseg, kvseg = q_segment_ids, kv_segment_ids
     if qseg is not None and q.ndim == 4:

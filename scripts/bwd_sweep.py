@@ -3,8 +3,8 @@
 The forward sweep (kernel_sweep.py) picked (256, 1024); the backward
 kernels (flash_bwd.py) have a different VMEM footprint (fp32 P/dS tiles
 plus dK/dV accumulators), so they are tuned separately.  Chains dO -> dQ
-through the amortized scan clock (the only honest timing under the axon
-tunnel — see utils/timing.py).
+through the chained-scan clock (device-trace time preferred,
+wall-clock slope fallback — see utils/timing.py::benchmark_auto).
 """
 
 from __future__ import annotations
@@ -24,7 +24,7 @@ def _bench_bwd_s(seq, dim, heads, bq, bk, repeats):
     from attention_tpu.ops.flash import BlockSizes
     from attention_tpu.ops.flash_bwd import flash_backward
     from attention_tpu.ops.flash_vjp import _flash_fwd_impl
-    from attention_tpu.utils.timing import benchmark_amortized
+    from attention_tpu.utils.timing import benchmark_auto
 
     ks = jax.random.split(jax.random.PRNGKey(0), 4)
     scale = 1.0 / dim**0.5
@@ -43,7 +43,7 @@ def _bench_bwd_s(seq, dim, heads, bq, bk, repeats):
         # XLA dead-code-eliminates it and the sweep times only dQ.
         return dq + (jnp.sum(dk) + jnp.sum(dv)).astype(dq.dtype)
 
-    return benchmark_amortized(
+    return benchmark_auto(
         step, jax.random.normal(ks[3], out.shape, jnp.bfloat16),
         repeats=repeats, n_short=2, n_long=8,
         operands=(q, k, v, out, lse),

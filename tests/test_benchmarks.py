@@ -140,3 +140,25 @@ def test_device_module_seconds_missing_dir(tmp_path):
     from attention_tpu.utils.profiling import device_module_seconds
 
     assert device_module_seconds(str(tmp_path / "nope")) is None
+
+
+def test_blocksizes_stats_and_backward_defaults():
+    """Pin the VMEM-safety rules: the stats-returning forward caps its
+    tile at 1024 (2048 OOMs scoped VMEM), and the backward default is
+    dtype- and window-aware."""
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.flash import BlockSizes
+    from attention_tpu.ops.flash_bwd import default_bwd_block_sizes
+
+    assert BlockSizes.for_shape(16, 8192, 128, returns_stats=True) == \
+        BlockSizes(1024, 1024)
+    assert BlockSizes.for_shape(16, 8192, 128) == BlockSizes(2048, 1024)
+    assert default_bwd_block_sizes(128, jnp.bfloat16, None) == \
+        BlockSizes(1024, 1024)
+    assert default_bwd_block_sizes(128, jnp.float32, None) == \
+        BlockSizes(512, 1024)
+    assert default_bwd_block_sizes(128, jnp.bfloat16, 1024) == \
+        BlockSizes(512, 512)
+    assert default_bwd_block_sizes(256, jnp.bfloat16, None) == \
+        BlockSizes(512, 512)
