@@ -339,6 +339,41 @@ def _():
     return got, want
 
 
+# ------------------- large-shape compile checks -------------------
+# Tiny-shape numerics above can't catch scoped-VMEM overflows: the tile
+# defaults only reach full size at real shapes (two compile-time OOMs
+# were found this way in round 2 — partials with stats outputs, and the
+# fp32 VJP).  These cases compile + run ONE call at the worst-case
+# shapes for each default; correctness is covered by the tiny cases.
+
+@case("compile/partials stats tile @16q4kv 8k")
+def _():
+    q, k, v = _arr(16, 8192, 128), _arr(4, 8192, 128), _arr(4, 8192, 128)
+    o, m, l = flash_attention_partials(q, k, v, causal=True)
+    return jnp.zeros(()), jnp.zeros(()), 1.0  # compiled + ran = pass
+
+
+@case("compile/fp32 full vjp @16q4kv 8k")
+def _():
+    q, k, v = _arr(16, 8192, 128), _arr(4, 8192, 128), _arr(4, 8192, 128)
+    g = jax.grad(lambda q: jnp.sum(flash_attention_diff(q, k, v,
+                                                        causal=True)))(q)
+    jax.block_until_ready(g)
+    return jnp.zeros(()), jnp.zeros(()), 1.0
+
+
+@case("compile/bf16 vjp + big fwd tile @32q4kv 16k")
+def _():
+    q = _arr(32, 16384, 128).astype(jnp.bfloat16)
+    k = _arr(4, 16384, 128).astype(jnp.bfloat16)
+    v = _arr(4, 16384, 128).astype(jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)  # 2048x1024 tile
+    g = jax.grad(lambda q: jnp.sum(flash_attention_diff(
+        q, k, v, causal=True).astype(jnp.float32)))(q)
+    jax.block_until_ready((out, g))
+    return jnp.zeros(()), jnp.zeros(()), 1.0
+
+
 def main() -> int:
     platform = jax.devices()[0].platform
     print(f"platform: {platform} ({jax.devices()[0]})")
