@@ -298,8 +298,9 @@ def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
     use site in :func:`flash_backward`).  Windowed shapes keep the
     round-1 512x512 — the banded grid covers
     ceil((window-1+block_q)/block_k)+1 KV blocks, so a taller tile
-    computes ~50% more masked band columns, and the round-2 sweep only
-    measured unwindowed shapes."""
+    computes more masked band columns; confirmed by a device-clock
+    sweep at w=1024 seq=32k: 512x512 = 3.96 ms vs 4.10-6.23 for every
+    other tile tried."""
     import jax.numpy as _jnp
 
     if window is not None or d > 128:
