@@ -177,7 +177,10 @@ def _sink_read_keys(kc, new_total, window, sinks, theta):
     query and window keys keep their absolute rotations.  Cost per step:
     a rope over ``sinks`` rows; the stored cache stays absolute.
     """
-    delta = jnp.maximum(new_total - (window + sinks), 0)
+    delta = jnp.maximum(jnp.asarray(new_total, jnp.int32) - (window + sinks),
+                        0)
+    if delta.ndim:  # ragged: per-sequence (B,) totals -> (B, 1, 1) pos
+        delta = delta[:, None, None]
     rot = apply_rope(kc[:, :, :sinks], delta, theta).astype(kc.dtype)
     # in-place-aliasable write of just the sink rows (a concatenate
     # would copy the whole capacity-sized cache every decode step)
@@ -341,13 +344,16 @@ class GQASelfAttention(nn.Module):
                 causal=self.causal, window=self.window,
                 softcap=self.softcap, sinks=self.attn_sinks,
             )
-        elif s_new == 1 and self.window is None:
-            out = flash_decode(q[:, :, 0, :], kc, vc, new_len,
-                               softcap=self.softcap)[:, :, None, :]
+        elif s_new == 1:
+            # windowed decode included: the decode kernel's per-sequence
+            # [len-w, len) band + pinned sinks clamps out-of-window block
+            # DMAs, so bandwidth scales with the window, not the prefix
+            out = flash_decode(q[:, :, 0, :], kr, vc, new_len,
+                               softcap=self.softcap, window=self.window,
+                               sinks=self.attn_sinks or None)[:, :, None, :]
         else:
-            # windowed decode steps also take this path: the banded flash
-            # kernel applies the window over the cache (a rolling-buffer
-            # cache that frees out-of-window rows is future work)
+            # chunked prefill / multi-token append: the banded flash
+            # kernel applies the window over the cache
             out = flash_attention(
                 q, kr, vc, causal=self.causal,
                 q_offset=cache.length, kv_valid=new_len, window=self.window,
@@ -468,11 +474,6 @@ class GQASelfAttention(nn.Module):
                 "prefill padded prompts on a KVCache, then "
                 "RaggedKVCache.from_prefill"
             )
-        if self.window is not None:
-            raise ValueError(
-                "sliding-window decode is not supported on the ragged "
-                "cache"
-            )
         write = jax.vmap(
             lambda buf, row, i: jax.lax.dynamic_update_slice(
                 buf, row, (jnp.int32(0), i, jnp.int32(0))
@@ -481,8 +482,17 @@ class GQASelfAttention(nn.Module):
         kc = write(cache.k, k.astype(cache.k.dtype), cache.lengths)
         vc = write(cache.v, v.astype(cache.v.dtype), cache.lengths)
         new_lengths = cache.lengths + 1
+        # Sliding-window serving on the ragged cache: each query sits at
+        # its own len-1, so the decode kernel's per-sequence [len-w, len)
+        # band (+ pinned sinks) applies directly; with RoPE the sink
+        # re-rotation delta is per-sequence.
+        kr = kc
+        if self.rope and self.attn_sinks and self.window is not None:
+            kr = _sink_read_keys(kc, new_lengths, self.window,
+                                 self.attn_sinks, self.rope_theta)
         out = flash_decode(
-            q[:, :, 0, :], kc, vc, new_lengths, softcap=self.softcap
+            q[:, :, 0, :], kr, vc, new_lengths, softcap=self.softcap,
+            window=self.window, sinks=self.attn_sinks or None,
         )[:, :, None, :]
         # per-sequence overflow poison (same loud-overflow contract)
         over = new_lengths > cache.k.shape[2]

@@ -248,8 +248,6 @@ def generate_ragged(
         raise ValueError(
             f"generate_ragged requires impl='flash' (got {model.impl!r})"
         )
-    if model.window is not None:
-        raise ValueError("generate_ragged does not support windowed models")
     b, s_max = prompt.shape
     lengths = _validate_lengths(prompt_lengths, s_max)
     if capacity is None:

@@ -56,13 +56,23 @@ from attention_tpu.ops.flash import (
 def _decode_kernel(
     lens_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
     *, hkv: int, block_k: int, block_q: int, n: int,
-    softcap2: float | None = None,
+    softcap2: float | None = None, window: int | None = None,
+    sinks: int | None = None,
 ):
-    """One (batch*kv-head, kv-block) grid step of cached decode."""
+    """One (batch*kv-head, kv-block) grid step of cached decode.
+
+    ``window`` restricts attention to the last ``window`` cached rows of
+    each sequence (the query sits at position valid-1), with the first
+    ``sinks`` rows pinned (StreamingLLM) — the decode-side counterpart
+    of the forward kernel's banded mask.
+    """
     bh = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
     valid = lens_ref[bh // hkv]
+    kv_min = None
+    if window is not None:
+        kv_min = jnp.maximum(valid - window, 0)
 
     @pl.when(j == 0)
     def _init():
@@ -70,14 +80,23 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(j * block_k < valid)
+    live = j * block_k < valid
+    if window is not None:
+        # skip blocks wholly below the window start, unless they hold
+        # pinned sink rows
+        above_min = (j + 1) * block_k > kv_min
+        if sinks:
+            above_min = jnp.logical_or(above_min, j * block_k < sinks)
+        live = jnp.logical_and(live, above_min)
+
+    @pl.when(live)
     def _tile():
         _flash_tile(
             q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
             valid=valid, q_offset=0, kv_offset=0,
             kv_idx=j, q_idx=0,
             n_true=n, block_k=block_k, causal=False, block_q=block_q,
-            softcap2=softcap2,
+            softcap2=softcap2, kv_min=kv_min, sinks=sinks,
         )
 
     @pl.when(j == num_j - 1)
@@ -100,7 +119,9 @@ def _pick_block_k(n: int, want: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_k", "interpret", "softcap")
+    jax.jit,
+    static_argnames=("scale", "block_k", "interpret", "softcap", "window",
+                     "sinks"),
 )
 def flash_decode(
     q: jax.Array,        # (B, H, d)
@@ -112,11 +133,24 @@ def flash_decode(
     block_k: int = 2048,
     interpret: bool | None = None,
     softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
 ) -> jax.Array:
     """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv).
 
-    ``softcap`` applies Gemma-2-style logit capping before softmax."""
+    ``softcap`` applies Gemma-2-style logit capping before softmax.
+    ``window`` attends only the last ``window`` valid rows per sequence
+    (sliding-window serving on a dense/ragged cache — each query sits at
+    its sequence's position ``len-1``); ``sinks`` additionally pins the
+    first ``sinks`` rows (StreamingLLM), requires ``window``."""
     check_softcap(softcap)
+    if sinks is not None:
+        if window is None:
+            raise ValueError("sinks require window= (see flash_attention)")
+        if sinks < 1:
+            raise ValueError(f"sinks must be >= 1, got {sinks}")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if q.ndim != 3 or k_cache.ndim != 4 or v_cache.ndim != 4:
         raise ValueError(
             f"expected q (B,H,d), caches (B,Hkv,N,d): got "
@@ -156,9 +190,22 @@ def flash_decode(
         # Clamp past-the-prefix block indices to the last valid block:
         # the repeated index makes Pallas skip the HBM->VMEM DMA, so
         # bandwidth scales with the used prefix (see module docstring).
+        # With a window, also clamp leading blocks below the window
+        # start (keeping sink blocks resident when sinks are on), so
+        # bandwidth scales with the WINDOW, not the prefix.
         valid = lens_ref[bh // hkv]
         last = jnp.maximum((valid + block_k - 1) // block_k - 1, 0)
-        return (bh, jnp.minimum(j, last), 0)
+        jj = jnp.minimum(j, last)
+        if window is not None:
+            first = jnp.maximum(valid - window, 0) // block_k
+            floor = jnp.minimum(first, last)
+            if sinks:
+                sink_last = (sinks - 1) // block_k
+                jj = jnp.where(jj <= sink_last, jj,
+                               jnp.maximum(jj, floor))
+            else:
+                jj = jnp.maximum(jj, floor)
+        return (bh, jj, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -183,6 +230,7 @@ def flash_decode(
             _decode_kernel, hkv=hkv, block_k=block_k, block_q=group_pad,
             n=n,
             softcap2=None if softcap is None else softcap * _LOG2E,
+            window=window, sinks=sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, dv), v_cache.dtype),

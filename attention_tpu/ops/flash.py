@@ -237,7 +237,7 @@ def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
     block_q, q_seg_ref=None, kv_seg_ref=None, window=None, softcap2=None,
-    sinks=None,
+    sinks=None, kv_min=None,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
@@ -248,6 +248,8 @@ def _flash_tile(
     boundaries are masked."""
     dynamic_valid = valid is not None
     segmented = q_seg_ref is not None
+    banded = kv_min is not None  # decode-side window: cols in
+    # [kv_min, valid) plus the pinned first `sinks` positions
 
     # Q arrives pre-scaled by scale*log2(e) (`_flash_call`), so `s` is the
     # scores in the log2 domain: exp(s_nat - m_nat) == exp2(s - m).  This
@@ -267,7 +269,7 @@ def _flash_tile(
         s = softcap2 * jnp.tanh(s / softcap2)
 
     needs_tail_mask = n_true % block_k != 0
-    masked = needs_tail_mask or causal or dynamic_valid or segmented
+    masked = needs_tail_mask or causal or dynamic_valid or segmented or banded
     if masked:
         col = kv_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
@@ -287,6 +289,11 @@ def _flash_tile(
                 if sinks is not None:
                     win = jnp.logical_or(win, col + kv_offset < sinks)
                 mask = jnp.logical_and(mask, win)
+        if banded:
+            keep = col >= kv_min
+            if sinks is not None:
+                keep = jnp.logical_or(keep, col < sinks)
+            mask = jnp.logical_and(mask, keep)
         if segmented:
             # (block_q, 1) vs (1, block_k): all lanes/sublanes of the
             # replicated id blocks are equal, so max() is just a reshape.

@@ -324,6 +324,23 @@ def _():
     return got, want
 
 
+@case("decode/window+sinks ragged lens")
+def _():
+    q, kc, vc, lens, _ = _decode_setup()
+    w, sk = 160, 4
+    got = flash_decode(q, kc, vc, lens, block_k=256, window=w, sinks=sk)
+    with jax.default_matmul_precision("highest"):
+        kx = jnp.repeat(kc, 2, axis=1)
+        vx = jnp.repeat(vc, 2, axis=1)
+        s = jnp.einsum("bhd,bhnd->bhn", q, kx) / 8.0
+        col = jnp.arange(kc.shape[2])[None, None, :]
+        ln = lens[:, None, None]
+        mask = (col < ln) & ((col >= jnp.maximum(ln - w, 0)) | (col < sk))
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), axis=-1)
+        want = jnp.einsum("bhn,bhnd->bhd", p, vx)
+    return got, want
+
+
 @case("decode/softcap")
 def _():
     q, kc, vc, lens, _ = _decode_setup()

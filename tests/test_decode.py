@@ -176,3 +176,65 @@ def test_kvcache_create_shapes():
     c = KVCache.create(batch=2, num_kv_heads=3, capacity=64, head_dim=16)
     assert c.k.shape == c.v.shape == (2, 3, 64, 16)
     assert int(c.length) == 0
+
+
+def _windowed_decode_oracle(q, kc, vc, lens, window, sinks=None,
+                            softcap=None):
+    """Dense fp64 oracle: each query (at position len-1) attends the last
+    `window` valid rows plus the first `sinks` pinned rows."""
+    b, h, d = q.shape
+    hkv, n = kc.shape[1], kc.shape[2]
+    group = h // hkv
+    kx = np.repeat(np.asarray(kc, np.float64), group, axis=1)
+    vx = np.repeat(np.asarray(vc, np.float64), group, axis=1)
+    s = np.einsum("bhd,bhnd->bhn", np.asarray(q, np.float64), kx) / d**0.5
+    if softcap is not None:
+        s = softcap * np.tanh(s / softcap)
+    col = np.arange(n)[None, None, :]
+    lens = np.asarray(lens)[:, None, None]
+    mask = col < lens
+    keep = col >= np.maximum(lens - window, 0)
+    if sinks:
+        keep |= col < sinks
+    mask &= keep
+    s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = np.where(np.isnan(p), 0.0, p)
+    p /= np.maximum(p.sum(-1, keepdims=True), 1e-300)
+    return np.einsum("bhn,bhnd->bhd", p, vx)
+
+
+@pytest.mark.parametrize("sinks", [None, 4])
+def test_flash_decode_window_matches_oracle(rng, sinks):
+    """Windowed (+sinks) ragged decode: per-sequence window over the
+    valid prefix, pinned sink rows, mixed lengths in one batch."""
+    b, h, hkv, n, d, w = 4, 4, 2, 1024, 64, 200
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    # lengths straddle block boundaries, below and above the window
+    lens = jnp.asarray([1024, 150, 513, 700], jnp.int32)
+    got = np.asarray(flash_decode(q, kc, vc, lens, block_k=256,
+                                  window=w, sinks=sinks))
+    want = _windowed_decode_oracle(q, kc, vc, lens, w, sinks)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_flash_decode_window_equals_full_when_len_fits(rng):
+    b, h, hkv, n, d = 2, 4, 2, 512, 64
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    lens = jnp.asarray([100, 256], jnp.int32)
+    a = np.asarray(flash_decode(q, kc, vc, lens, block_k=256))
+    bb = np.asarray(flash_decode(q, kc, vc, lens, block_k=256, window=256))
+    np.testing.assert_allclose(a, bb, atol=1e-6)
+
+
+def test_flash_decode_window_validation(rng):
+    q = jnp.zeros((1, 2, 64), jnp.float32)
+    kc = jnp.zeros((1, 2, 256, 64), jnp.float32)
+    with pytest.raises(ValueError, match="sinks"):
+        flash_decode(q, kc, kc, jnp.int32(10), sinks=2)  # no window
+    with pytest.raises(ValueError, match="window"):
+        flash_decode(q, kc, kc, jnp.int32(10), window=0)
