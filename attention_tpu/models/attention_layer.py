@@ -535,13 +535,17 @@ class GQASelfAttention(nn.Module):
                 f"impl {self.impl!r} has no quantized-cache path "
                 "(supported: ['flash'])"
             )
-        if self.window is not None:
+        if self.rope and self.attn_sinks and self.window is not None:
             raise ValueError(
-                "sliding-window decode is not supported on the int8 cache"
+                "rope + attn_sinks decode needs the in-cache sink "
+                "re-rotation, which cannot be applied to quantized "
+                "keys — use the bf16 KVCache or the rolling cache"
             )
         kv = update_quantized_kv(cache.kv, k, v, cache.length)
         new_len = cache.length + 1
         out = flash_decode_quantized(q[:, :, 0, :], kv, new_len,
-                                     softcap=self.softcap)
+                                     softcap=self.softcap,
+                                     window=self.window,
+                                     sinks=self.attn_sinks or None)
         # overflow already NaN-poisons via update_quantized_kv's scales
         return out[:, :, None, :].astype(q.dtype), QuantKVCache(kv, new_len)

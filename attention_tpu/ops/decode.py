@@ -108,6 +108,31 @@ def _decode_kernel(
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
+def banded_block_clamp(j, valid, block_k: int, window, sinks):
+    """DMA-eliding clamp for a decode kernel's KV block index.
+
+    Past-the-prefix blocks clamp to the last valid block (Pallas elides
+    the HBM->VMEM DMA when consecutive grid steps map to the same
+    block, so bandwidth scales with the used prefix).  With a window,
+    leading blocks below the window start clamp UP to the window's
+    first block — keeping sink blocks at their identity indices when
+    sinks are on — so bandwidth scales with the WINDOW, not the prefix.
+    Shared by the bf16 (`flash_decode`) and int8
+    (`flash_decode_quantized`) kernels; the clamp must mirror their
+    `live` compute guards.
+    """
+    last = jnp.maximum((valid + block_k - 1) // block_k - 1, 0)
+    jj = jnp.minimum(j, last)
+    if window is not None:
+        floor = jnp.minimum(jnp.maximum(valid - window, 0) // block_k, last)
+        if sinks:
+            sink_last = (sinks - 1) // block_k
+            jj = jnp.where(jj <= sink_last, jj, jnp.maximum(jj, floor))
+        else:
+            jj = jnp.maximum(jj, floor)
+    return jj
+
+
 def _pick_block_k(n: int, want: int) -> int:
     """Largest multiple of 128 that divides n and is <= want."""
     if n % 128:
@@ -187,25 +212,8 @@ def flash_decode(
     vc = v_cache.reshape(b * hkv, n, dv)
 
     def kv_index(bh, j, lens_ref):
-        # Clamp past-the-prefix block indices to the last valid block:
-        # the repeated index makes Pallas skip the HBM->VMEM DMA, so
-        # bandwidth scales with the used prefix (see module docstring).
-        # With a window, also clamp leading blocks below the window
-        # start (keeping sink blocks resident when sinks are on), so
-        # bandwidth scales with the WINDOW, not the prefix.
         valid = lens_ref[bh // hkv]
-        last = jnp.maximum((valid + block_k - 1) // block_k - 1, 0)
-        jj = jnp.minimum(j, last)
-        if window is not None:
-            first = jnp.maximum(valid - window, 0) // block_k
-            floor = jnp.minimum(first, last)
-            if sinks:
-                sink_last = (sinks - 1) // block_k
-                jj = jnp.where(jj <= sink_last, jj,
-                               jnp.maximum(jj, floor))
-            else:
-                jj = jnp.maximum(jj, floor)
-        return (bh, jj, 0)
+        return (bh, banded_block_clamp(j, valid, block_k, window, sinks), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

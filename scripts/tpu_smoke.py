@@ -341,6 +341,16 @@ def _():
     return got, want
 
 
+@case("decode/int8 window+sinks")
+def _():
+    q, kc, vc, lens, _ = _decode_setup()
+    w, sk = 160, 4
+    got = flash_decode_quantized(q, quantize_kv(kc, vc), lens,
+                                 block_k=256, window=w, sinks=sk)
+    want = flash_decode(q, kc, vc, lens, block_k=256, window=w, sinks=sk)
+    return got, want, 3e-2  # int8 quantization error
+
+
 @case("decode/softcap")
 def _():
     q, kc, vc, lens, _ = _decode_setup()

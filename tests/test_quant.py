@@ -148,3 +148,58 @@ def test_generate_int8_rejects_xla_impl_up_front(rng):
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
     with pytest.raises(ValueError, match="int8_cache requires"):
         generate(model, params, prompt, steps=2, int8_cache=True)
+
+
+@pytest.mark.parametrize("sinks", [None, 4])
+def test_quantized_decode_window_matches_bf16(rng, sinks):
+    """int8 windowed (+sinks) decode == bf16 windowed decode within
+    quantization error, ragged lengths."""
+    b, h, hkv, n, d, w = 3, 4, 2, 512, 64, 150
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.bfloat16)
+    lens = jnp.asarray([512, 100, 300], jnp.int32)
+    want = np.asarray(flash_decode(q.astype(jnp.bfloat16), kc, vc, lens,
+                                   block_k=128, window=w, sinks=sinks),
+                      np.float32)
+    got = np.asarray(flash_decode_quantized(
+        q.astype(jnp.bfloat16), quantize_kv(kc, vc), lens, block_k=128,
+        window=w, sinks=sinks), np.float32)
+    np.testing.assert_allclose(got, want, atol=3e-2)
+
+
+def test_int8_windowed_model_matches_bf16_logits(rng):
+    """Windowed (+sinks) decode on the int8 cache: teacher-forced
+    per-step logits match the bf16 cache within quantization error.
+    (Token-exact generation comparison is flaky: untrained weights
+    produce near-tie logits that int8 noise flips.)"""
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=61, dim=64, depth=2, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=32, attn_sinks=4)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    full = model.init_caches(batch=2, capacity=128)
+    _, full = model.apply({"params": params}, prompt, full)
+    quant = tuple(c.quantize() for c in full)
+    toks = jnp.asarray(rng.integers(0, 61, (2, 48)), jnp.int32)
+    for t in range(toks.shape[1]):
+        step = toks[:, t : t + 1]
+        lf, full = model.apply({"params": params}, step, full)
+        lq, quant = model.apply({"params": params}, step, quant)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                                   atol=8e-2, rtol=5e-2,
+                                   err_msg=f"step {t}")
+
+
+def test_int8_rope_sinks_window_rejected(rng):
+    from attention_tpu.models import TinyDecoder, generate
+
+    model = TinyDecoder(vocab=61, dim=64, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.bfloat16,
+                        window=32, attn_sinks=4, rope=True)
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="re-rotation"):
+        generate(model, params, prompt, steps=2, int8_cache=True)
