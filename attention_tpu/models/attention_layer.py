@@ -511,13 +511,17 @@ class GQASelfAttention(nn.Module):
                 "PagedKV supports single-token decode steps; prefill on "
                 "a dense KVCache, then ops.paged.paged_from_dense"
             )
-        if self.window is not None:
+        if self.rope and self.attn_sinks and self.window is not None:
             raise ValueError(
-                "sliding-window decode is not supported on the paged cache"
+                "rope + attn_sinks decode needs the in-cache sink "
+                "re-rotation, which cannot be applied to pool pages "
+                "(they may be prefix-shared across sequences) — use the "
+                "bf16 KVCache or the rolling cache"
             )
         cache = paged_append(cache, k, v)
         out = paged_flash_decode(
-            q[:, :, 0, :], cache, softcap=self.softcap
+            q[:, :, 0, :], cache, softcap=self.softcap,
+            window=self.window, sinks=self.attn_sinks or None,
         )[:, :, None, :]
         return out.astype(q.dtype), cache
 

@@ -342,8 +342,6 @@ def generate_paged(
         raise ValueError(
             f"generate_paged requires impl='flash' (got {model.impl!r})"
         )
-    if model.window is not None:
-        raise ValueError("generate_paged does not support windowed models")
     b, s_max = prompt.shape
     lengths = _validate_lengths(prompt_lengths, s_max)
     capacity = -(-(s_max + steps) // page_size) * page_size

@@ -80,14 +80,7 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = j * block_k < valid
-    if window is not None:
-        # skip blocks wholly below the window start, unless they hold
-        # pinned sink rows
-        above_min = (j + 1) * block_k > kv_min
-        if sinks:
-            above_min = jnp.logical_or(above_min, j * block_k < sinks)
-        live = jnp.logical_and(live, above_min)
+    live = banded_live(j, valid, block_k, window, sinks)
 
     @pl.when(live)
     def _tile():
@@ -106,6 +99,32 @@ def _decode_kernel(
         # (attention-mpi.c:358-362)
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def check_band(window, sinks) -> None:
+    """Shared validation for the decode-side window/sinks contract
+    (mirrors flash_attention's): sinks require a window, both >= 1."""
+    if sinks is not None:
+        if window is None:
+            raise ValueError("sinks require window= (see flash_attention)")
+        if sinks < 1:
+            raise ValueError(f"sinks must be >= 1, got {sinks}")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
+def banded_live(j, valid, block_k: int, window, sinks):
+    """Compute-guard predicate paired with :func:`banded_block_clamp`:
+    True for blocks holding valid rows inside the window band or pinned
+    sink rows.  The two MUST stay mirrored — a block the clamp remaps
+    must never compute, and a live block must keep its identity index."""
+    live = j * block_k < valid
+    if window is not None:
+        above_min = (j + 1) * block_k > jnp.maximum(valid - window, 0)
+        if sinks:
+            above_min = jnp.logical_or(above_min, j * block_k < sinks)
+        live = jnp.logical_and(live, above_min)
+    return live
 
 
 def banded_block_clamp(j, valid, block_k: int, window, sinks):
@@ -169,13 +188,7 @@ def flash_decode(
     its sequence's position ``len-1``); ``sinks`` additionally pins the
     first ``sinks`` rows (StreamingLLM), requires ``window``."""
     check_softcap(softcap)
-    if sinks is not None:
-        if window is None:
-            raise ValueError("sinks require window= (see flash_attention)")
-        if sinks < 1:
-            raise ValueError(f"sinks must be >= 1, got {sinks}")
-    if window is not None and window < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    check_band(window, sinks)
     if q.ndim != 3 or k_cache.ndim != 4 or v_cache.ndim != 4:
         raise ValueError(
             f"expected q (B,H,d), caches (B,Hkv,N,d): got "

@@ -31,6 +31,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from attention_tpu.ops.decode import (
+    banded_block_clamp,
+    banded_live,
+    check_band,
+)
 from attention_tpu.ops.flash import (
     _LOG2E,
     _STAT_LANES,
@@ -132,12 +137,20 @@ class PagePool:
 def _paged_kernel(
     lens_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
     *, hkv: int, page: int, softcap2,
+    window: int | None = None, sinks: int | None = None,
 ):
-    """One (batch*kv-head, logical-page) grid step."""
+    """One (batch*kv-head, logical-page) grid step.
+
+    ``window``/``sinks``: the same per-sequence [len-w, len) band +
+    pinned sink rows as the dense decode kernels — logical positions,
+    applied before page translation."""
     bh = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
     valid = lens_ref[bh // hkv]
+    kv_min = None
+    if window is not None:
+        kv_min = jnp.maximum(valid - window, 0)
 
     @pl.when(j == 0)
     def _init():
@@ -145,7 +158,9 @@ def _paged_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(j * page < valid)
+    live = banded_live(j, valid, page, window, sinks)
+
+    @pl.when(live)
     def _tile():
         _flash_tile(
             q_ref, k_ref[0], v_ref[0], acc_scr, m_scr, l_scr,
@@ -153,6 +168,7 @@ def _paged_kernel(
             kv_idx=j, q_idx=0,
             n_true=num_j * page, block_k=page, causal=False,
             block_q=q_ref.shape[1], softcap2=softcap2,
+            kv_min=kv_min, sinks=sinks,
         )
 
     @pl.when(j == num_j - 1)
@@ -163,7 +179,8 @@ def _paged_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret", "softcap")
+    jax.jit,
+    static_argnames=("scale", "interpret", "softcap", "window", "sinks"),
 )
 def paged_flash_decode(
     q: jax.Array,       # (B, H, d)
@@ -172,9 +189,17 @@ def paged_flash_decode(
     scale: float | None = None,
     interpret: bool | None = None,
     softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
 ) -> jax.Array:
-    """softmax(q K[:len]^T * scale) V[:len] through the page table."""
+    """softmax(q K[:len]^T * scale) V[:len] through the page table.
+
+    ``window``/``sinks``: sliding-window serving with pinned sink rows
+    (same per-sequence logical band as :func:`ops.decode.flash_decode`),
+    applied before page translation — out-of-window pages are never
+    DMA'd, so a windowed server could even free them."""
     check_softcap(softcap)
+    check_band(window, sinks)
     b, h, d = q.shape
     p_, hkv, page, dk = cache.k_pool.shape
     dv = cache.v_pool.shape[-1]
@@ -204,17 +229,18 @@ def paged_flash_decode(
         qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
 
     def kv_index(bh, j, lens_ref, tbl_ref):
-        # page translation AND past-the-prefix clamp, both on prefetched
-        # scalars: repeated physical indices make Pallas elide the DMA
+        # LOGICAL-page clamp (past-the-prefix and, with a window,
+        # below-the-band — see decode.banded_block_clamp), THEN page
+        # translation, all on prefetched scalars: repeated physical
+        # indices make Pallas elide the DMA.
         bi = bh // hkv
         valid = lens_ref[bi]
-        last = jnp.maximum((valid + page - 1) // page - 1, 0)
+        jj = banded_block_clamp(j, valid, page, window, sinks)
         # max(..., 0): a length-0 row lands on page_table[bi, 0], which a
         # hand-built PagedKV may legitimately leave as the -1 free-slot
         # sentinel; the output is masked anyway, but the DMA index must
         # stay in bounds.
-        return (jnp.maximum(tbl_ref[bi, jnp.minimum(j, last)], 0),
-                bh % hkv, 0, 0)
+        return (jnp.maximum(tbl_ref[bi, jj], 0), bh % hkv, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -239,6 +265,7 @@ def paged_flash_decode(
         functools.partial(
             _paged_kernel, hkv=hkv, page=page,
             softcap2=None if softcap is None else softcap * _LOG2E,
+            window=window, sinks=sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(

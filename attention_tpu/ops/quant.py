@@ -42,7 +42,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from attention_tpu.ops.decode import _pick_block_k, banded_block_clamp
+from attention_tpu.ops.decode import (
+    _pick_block_k,
+    banded_block_clamp,
+    banded_live,
+    check_band,
+)
 from attention_tpu.ops.flash import (
     _LOG2E,
     _STAT_LANES,
@@ -139,12 +144,7 @@ def _decode_q_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = j * block_k < valid
-    if window is not None:
-        above_min = (j + 1) * block_k > kv_min
-        if sinks:
-            above_min = jnp.logical_or(above_min, j * block_k < sinks)
-        live = jnp.logical_and(live, above_min)
+    live = banded_live(j, valid, block_k, window, sinks)
 
     @pl.when(live)
     def _tile():
@@ -214,13 +214,7 @@ def flash_decode_quantized(
     2048).
     """
     check_softcap(softcap)
-    if sinks is not None:
-        if window is None:
-            raise ValueError("sinks require window= (see flash_attention)")
-        if sinks < 1:
-            raise ValueError(f"sinks must be >= 1, got {sinks}")
-    if window is not None and window < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    check_band(window, sinks)
     b, h, d = q.shape
     bk_, hkv, n, dk_ = cache.k_q.shape
     if bk_ != b or dk_ != d or cache.v_q.shape != (b, hkv, n, d):

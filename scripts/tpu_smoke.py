@@ -351,6 +351,17 @@ def _():
     return got, want, 3e-2  # int8 quantization error
 
 
+@case("decode/paged window+sinks")
+def _():
+    q, kc, vc, lens, _ = _decode_setup()
+    w, sk = 160, 4
+    want = flash_decode(q, kc, vc, lens, block_k=256, window=w, sinks=sk)
+    pool = PagePool(num_pages=16)
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=16)
+    got = paged_flash_decode(q, cache, window=w, sinks=sk)
+    return got, want
+
+
 @case("decode/softcap")
 def _():
     q, kc, vc, lens, _ = _decode_setup()
