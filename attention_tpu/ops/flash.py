@@ -233,6 +233,16 @@ def _flash_kernel(
             l_out_ref[0] = l_scr[...]
 
 
+def banded_keep(col, kv_min, sinks):
+    """Decode-side band keep-mask: columns inside [kv_min, ...) or in the
+    pinned first ``sinks`` rows.  One definition shared by `_flash_tile`
+    and the int8 decode kernel so the band semantics cannot diverge."""
+    keep = col >= kv_min
+    if sinks is not None:
+        keep = jnp.logical_or(keep, col < sinks)
+    return keep
+
+
 def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
@@ -290,10 +300,7 @@ def _flash_tile(
                     win = jnp.logical_or(win, col + kv_offset < sinks)
                 mask = jnp.logical_and(mask, win)
         if banded:
-            keep = col >= kv_min
-            if sinks is not None:
-                keep = jnp.logical_or(keep, col < sinks)
-            mask = jnp.logical_and(mask, keep)
+            mask = jnp.logical_and(mask, banded_keep(col, kv_min, sinks))
         if segmented:
             # (block_q, 1) vs (1, block_k): all lanes/sublanes of the
             # replicated id blocks are equal, so max() is just a reshape.

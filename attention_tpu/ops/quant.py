@@ -49,6 +49,7 @@ from attention_tpu.ops.decode import (
     check_band,
 )
 from attention_tpu.ops.flash import (
+    banded_keep,
     _LOG2E,
     _STAT_LANES,
     NEG_INF,
@@ -162,10 +163,7 @@ def _decode_q_kernel(
         col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < valid
         if kv_min is not None:
-            keep = col >= kv_min
-            if sinks is not None:
-                keep = jnp.logical_or(keep, col < sinks)
-            mask = jnp.logical_and(mask, keep)
+            mask = jnp.logical_and(mask, banded_keep(col, kv_min, sinks))
         s = jnp.where(mask, s, NEG_INF)
 
         p, corr = _online_softmax_update(s, m_scr, l_scr, masked=True)

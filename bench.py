@@ -187,6 +187,16 @@ def _time_serial_once(seq: int, dim: int) -> float:
     return best
 
 
+# Direct measurement of the serial C oracle at the headline shape
+# (m=n=32768, d=128) on an idle CPU, 2026-07-30 (`--serial-seq 32768`;
+# RESULTS.md).  The default extrapolation from 4096 predicts within 1%
+# of this on an idle machine, but concurrent CPU load inflates the BASE
+# timing linearly and would overstate the headline speedup — cap the
+# extrapolated denominator at the real measurement (idle-machine
+# estimates usually land BELOW it, keeping the speedup a lower bound).
+SERIAL_32K_128_MEASURED_S = 190.0
+
+
 def _bench_serial_s(seq: int, dim: int, target_seq: int):
     """Seconds for the serial fp64 C oracle at target_seq.
 
@@ -194,10 +204,17 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
     and seq, and extrapolated geometrically with min(measured
     per-doubling ratio, the ideal 4x) — the min keeps a noisy-high
     measured ratio from exponentiating into an inflated headline
-    speedup; see the module docstring.
+    speedup, and the headline shape is additionally capped at its
+    direct idle-CPU measurement so background load cannot inflate the
+    denominator; see the module docstring.
     """
     if seq >= target_seq:
-        return _time_serial_once(target_seq, dim)
+        t = _time_serial_once(target_seq, dim)
+        if (target_seq, dim) == (32768, 128):
+            # direct measurement under CPU load inflates too; the
+            # recorded idle-CPU figure is the upper bound either way
+            t = min(t, SERIAL_32K_128_MEASURED_S)
+        return t
     t_half = _time_serial_once(seq // 2, dim)
     t_full = _time_serial_once(seq, dim)
     # Work is Θ(seq²): the true per-doubling time ratio is ≥4 (above 4
@@ -207,7 +224,10 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
     # understates the serial side (memory-bound serial is slower than
     # quadratic), i.e. the reported speedup is a lower bound.
     ratio = min(t_full / t_half, 4.0)
-    return t_full * ratio ** math.log2(target_seq / seq)
+    est = t_full * ratio ** math.log2(target_seq / seq)
+    if (target_seq, dim) == (32768, 128):
+        est = min(est, SERIAL_32K_128_MEASURED_S)
+    return est
 
 
 def main(argv=None) -> int:
