@@ -23,6 +23,7 @@ from attention_tpu.ops.quant import (
     QuantizedKV,
     flash_decode_quantized,
     quantize_kv,
+    sink_read_rotation,
     update_quantized_kv,
 )
 from attention_tpu.ops.reference import attention_xla
@@ -539,15 +540,15 @@ class GQASelfAttention(nn.Module):
                 f"impl {self.impl!r} has no quantized-cache path "
                 "(supported: ['flash'])"
             )
-        if self.rope and self.attn_sinks and self.window is not None:
-            raise ValueError(
-                "rope + attn_sinks decode needs the in-cache sink "
-                "re-rotation, which cannot be applied to quantized "
-                "keys — use the bf16 KVCache or the rolling cache"
-            )
         kv = update_quantized_kv(cache.kv, k, v, cache.length)
         new_len = cache.length + 1
-        out = flash_decode_quantized(q[:, :, 0, :], kv, new_len,
+        kr = kv
+        if self.rope and self.attn_sinks and self.window is not None:
+            # int8 counterpart of _sink_read_keys (per-sequence storage,
+            # so — unlike paged pool pages — re-rotation is legal)
+            kr = sink_read_rotation(kv, new_len, self.window,
+                                    self.attn_sinks, self.rope_theta)
+        out = flash_decode_quantized(q[:, :, 0, :], kr, new_len,
                                      softcap=self.softcap,
                                      window=self.window,
                                      sinks=self.attn_sinks or None)

@@ -91,6 +91,38 @@ def _quant_rows(x):
     return q, scale_rep
 
 
+def sink_read_rotation(kv: "QuantizedKV", new_total, window: int,
+                       sinks: int, theta: float) -> "QuantizedKV":
+    """StreamingLLM in-cache sink positions for an int8 cache, at read
+    time: dequantize the ``sinks`` pinned key rows, rotate them forward
+    by ``delta = max(new_total - (window + sinks), 0)`` (the same
+    convention as the bf16 `_sink_read_keys` — RoPE rotations compose
+    additively), requantize, and return a READ copy of the cache; the
+    stored cache keeps absolute rotations, so there is no compounding
+    drift.  Double quantization of the sink rows adds int8-grade noise,
+    inside the cache's existing error contract.
+    """
+    from attention_tpu.ops.rope import apply_rope
+
+    k_sink = (kv.k_q[:, :, :sinks].astype(jnp.float32)
+              * kv.k_scale[:, :, 0, :sinks][..., None])
+    delta = jnp.maximum(
+        jnp.asarray(new_total, jnp.int32) - (window + sinks), 0
+    )
+    if delta.ndim:  # ragged per-sequence totals -> (B, 1, 1) positions
+        delta = delta[:, None, None]
+    q_rot, s_rot = _quant_rows(apply_rope(k_sink, delta, theta))
+    zero = jnp.zeros((), jnp.int32)
+    return kv._replace(
+        k_q=jax.lax.dynamic_update_slice(
+            kv.k_q, q_rot, (zero, zero, zero, zero)
+        ),
+        k_scale=jax.lax.dynamic_update_slice(
+            kv.k_scale, s_rot, (zero, zero, zero, zero)
+        ),
+    )
+
+
 def quantize_kv(k: jax.Array, v: jax.Array) -> QuantizedKV:
     """Quantize full (B, Hkv, N, d) K/V caches to the int8 cache format."""
     k_q, k_s = _quant_rows(k)

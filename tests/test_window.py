@@ -149,8 +149,9 @@ def test_windowed_cached_decode_matches_forward(rng, impl):
 
 def test_windowed_model_runs_on_int8_cache(rng):
     """Round 2: windowed decode is SUPPORTED on the int8 cache (it was
-    rejected in round 1); only rope+sinks stays excluded there (covered
-    by test_quant.py::test_int8_rope_sinks_window_rejected)."""
+    rejected in round 1); rope+sinks works there too (covered by
+    test_quant.py::test_int8_rope_sinks_window_matches_bf16_logits) —
+    only the PAGED cache excludes rope+sinks."""
     from attention_tpu.models import TinyDecoder
 
     model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
