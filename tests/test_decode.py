@@ -238,3 +238,51 @@ def test_flash_decode_window_validation(rng):
         flash_decode(q, kc, kc, jnp.int32(10), sinks=2)  # no window
     with pytest.raises(ValueError, match="window"):
         flash_decode(q, kc, kc, jnp.int32(10), window=0)
+
+
+def test_banded_clamp_live_mirror_property():
+    """Exhaustive property check of the clamp/guard pair shared by the
+    bf16, int8, and paged decode kernels: (1) a live block always keeps
+    its identity index (the DMA it computes on is its own); (2) a
+    clamped (non-identity) block is never live; (3) the clamped index
+    is always within [0, ceil(valid/bk)-1] (in bounds / allocated);
+    (4) the union of live blocks covers exactly the visible columns."""
+    from attention_tpu.ops.decode import banded_block_clamp, banded_live
+
+    def check(valid, block_k, window, sinks, num_blocks):
+        v = jnp.int32(valid)
+        for j in range(num_blocks):
+            live = bool(banded_live(j, v, block_k, window, sinks))
+            jj = int(banded_block_clamp(j, v, block_k, window, sinks))
+            last = max((valid + block_k - 1) // block_k - 1, 0)
+            assert 0 <= jj <= last, (valid, block_k, window, sinks, j, jj)
+            if live:
+                assert jj == j, ("live block remapped",
+                                 valid, block_k, window, sinks, j, jj)
+        # visible-column coverage at the piecewise-constant boundaries
+        # (the predicates only change at block edges, kv_min, sinks, and
+        # valid — checking those covers every column)
+        kv_min = max(valid - window, 0) if window is not None else 0
+        cand = {0, kv_min - 1, kv_min, valid - 1}
+        if sinks is not None:
+            cand |= {sinks - 1, sinks}
+        for j in range(num_blocks):
+            cand |= {j * block_k, j * block_k + block_k - 1}
+        for col in cand:
+            if not 0 <= col < valid:
+                continue
+            vis = col >= kv_min or (sinks is not None and col < sinks)
+            j = col // block_k
+            live = bool(banded_live(j, v, block_k, window, sinks))
+            if vis:
+                assert live, ("visible col in dead block",
+                              valid, block_k, window, sinks, col)
+
+    for block_k in (128, 256):
+        num_blocks = 1024 // block_k
+        for window in (None, 1, 100, 128, 250, 1000, 2000):
+            sinks_opts = (None,) if window is None else (None, 1, 4, 130,
+                                                         300)
+            for sinks in sinks_opts:
+                for valid in (0, 1, 127, 128, 129, 255, 500, 1000, 1024):
+                    check(valid, block_k, window, sinks, num_blocks)
