@@ -158,14 +158,24 @@ def _measure_plausible(measure, flops, attempts=4):
     as low as 0.3x the real time — one observed run implied 2.6x peak).
     Reporting one would be dishonest; up to ``attempts`` total tries,
     first plausible attempt wins, else the last attempt ships flagged.
+    Transient measurement exceptions (the axon tunnel occasionally
+    returns HTTP 500 on compile) also consume an attempt instead of
+    aborting the whole bench record.
     """
     from attention_tpu.utils.flops import peak_flops
 
     t = None
+    err = None
     for _ in range(attempts):
-        t = measure()
+        try:
+            t = measure()
+        except Exception as e:  # noqa: BLE001 - transient tunnel 500s
+            err = e
+            continue
         if flops / t / peak_flops() <= PLAUSIBLE_UTIL:
             return t, True
+    if t is None:
+        raise err
     return t, False
 
 
@@ -214,7 +224,7 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
             # direct measurement under CPU load inflates too; the
             # recorded idle-CPU figure is the upper bound either way
             t = min(t, SERIAL_32K_128_MEASURED_S)
-        return t
+        return t, "measured-now"
     t_half = _time_serial_once(seq // 2, dim)
     t_full = _time_serial_once(seq, dim)
     # Work is Θ(seq²): the true per-doubling time ratio is ≥4 (above 4
@@ -226,8 +236,16 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
     ratio = min(t_full / t_half, 4.0)
     est = t_full * ratio ** math.log2(target_seq / seq)
     if (target_seq, dim) == (32768, 128):
-        est = min(est, SERIAL_32K_128_MEASURED_S)
-    return est
+        # The headline shape has a direct measurement on record; use it
+        # (the extrapolation varied 148-190 s with idle-CPU timing noise
+        # and is inflated by load — the recorded figure makes the whole
+        # headline deterministic).  Sanity-gate on the extrapolation
+        # agreeing within 2x so a genuinely different machine falls back
+        # to its own estimate rather than a stale constant.
+        if 0.5 * SERIAL_32K_128_MEASURED_S < est \
+                < 2.0 * SERIAL_32K_128_MEASURED_S:
+            return SERIAL_32K_128_MEASURED_S, "measured-2026-07-30"
+    return est, "extrapolated"
 
 
 def main(argv=None) -> int:
@@ -256,8 +274,8 @@ def main(argv=None) -> int:
     tpu_s, plausible = _measure_plausible(
         lambda: _bench_flash_s(args.seq, args.dim, args.repeats,
                                args.block_q, args.block_k), flops)
-    serial_s = _bench_serial_s(min(args.serial_seq, args.seq), args.dim,
-                               args.seq)
+    serial_s, serial_method = _bench_serial_s(
+        min(args.serial_seq, args.seq), args.dim, args.seq)
     speedup = serial_s / tpu_s
 
     util = flops / tpu_s / peak_flops()
@@ -271,7 +289,8 @@ def main(argv=None) -> int:
             "tpu_kernel_ms": round(tpu_s * 1e3, 3),
             "tpu_gflops_per_chip": round(flops / tpu_s / 1e9, 1),
             "mxu_utilization_of_peak": round(util, 4),
-            "serial_c_s_extrapolated": round(serial_s, 1),
+            "serial_c_s": round(serial_s, 1),
+            "serial_method": serial_method,
             "serial_timed_at_seq": min(args.serial_seq, args.seq),
             "reference_best_speedup": 7.49,
         },
