@@ -164,12 +164,18 @@ def _measure_plausible(measure, flops, attempts=4):
     """
     from attention_tpu.utils.flops import peak_flops
 
+    import jax
+
     t = None
     err = None
     for _ in range(attempts):
         try:
             t = measure()
-        except Exception as e:  # noqa: BLE001 - transient tunnel 500s
+        except jax.errors.JaxRuntimeError as e:
+            # the tunnel occasionally 500s on compile; retry those, but
+            # surface each so deterministic failures aren't silent
+            print(f"measurement attempt failed (retrying): "
+                  f"{str(e)[:200]}", file=sys.stderr)
             err = e
             continue
         if flops / t / peak_flops() <= PLAUSIBLE_UTIL:
@@ -220,10 +226,11 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
     """
     if seq >= target_seq:
         t = _time_serial_once(target_seq, dim)
-        if (target_seq, dim) == (32768, 128):
+        if (target_seq, dim) == (32768, 128) \
+                and t > SERIAL_32K_128_MEASURED_S:
             # direct measurement under CPU load inflates too; the
             # recorded idle-CPU figure is the upper bound either way
-            t = min(t, SERIAL_32K_128_MEASURED_S)
+            return SERIAL_32K_128_MEASURED_S, "measured-2026-07-30-cap"
         return t, "measured-now"
     t_half = _time_serial_once(seq // 2, dim)
     t_full = _time_serial_once(seq, dim)
@@ -245,6 +252,10 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
         if 0.5 * SERIAL_32K_128_MEASURED_S < est \
                 < 2.0 * SERIAL_32K_128_MEASURED_S:
             return SERIAL_32K_128_MEASURED_S, "measured-2026-07-30"
+        if est >= 2.0 * SERIAL_32K_128_MEASURED_S:
+            # indistinguishable from heavy load on this machine; keep
+            # the speedup a lower bound by capping at the measurement
+            return SERIAL_32K_128_MEASURED_S, "measured-2026-07-30-cap"
     return est, "extrapolated"
 
 
