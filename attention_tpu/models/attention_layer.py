@@ -241,6 +241,14 @@ class GQASelfAttention(nn.Module):
     rope: bool = False  # rotary position embeddings on Q/K
     rope_theta: float = 10000.0
     softcap: float | None = None  # logit soft-capping (Gemma-2 style)
+    # Context parallelism: when set (training under a mesh whose
+    # ``cp_axis`` shards the sequence), batch attention runs the
+    # differentiable CP composition `parallel.cp.cp_flash_attention` —
+    # the Pallas flash custom VJP under shard_map — instead of a
+    # single-device kernel call.  Requires ``impl='flash'``; ``mesh``
+    # must be the training mesh.  Decode/cached paths are unaffected.
+    cp_axis: str | None = None
+    mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -250,6 +258,19 @@ class GQASelfAttention(nn.Module):
                 f"q heads {self.num_q_heads} not a multiple of kv heads "
                 f"{self.num_kv_heads}"
             )
+        if self.cp_axis is not None:
+            if self.impl != "flash":
+                raise ValueError(
+                    "cp_axis (context-parallel attention) runs the fused "
+                    f"flash path; impl {self.impl!r} is not supported"
+                )
+            if self.mesh is None:
+                raise ValueError("cp_axis requires mesh=")
+            if self.attn_sinks:
+                raise ValueError(
+                    "attention sinks are not yet plumbed through the "
+                    "context-parallel path"
+                )
         dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
             features=(heads, self.head_dim),
             use_bias=False,
@@ -286,10 +307,19 @@ class GQASelfAttention(nn.Module):
                 f"attn_sinks must be >= 0, got {self.attn_sinks}"
             )
         if cache is None:
-            out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,
-                                        window=self.window,
-                                        softcap=self.softcap,
-                                        sinks=self.attn_sinks)
+            if self.cp_axis is not None:
+                from attention_tpu.parallel.cp import cp_flash_attention
+
+                out = cp_flash_attention(
+                    q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
+                    causal=self.causal, window=self.window,
+                    softcap=self.softcap,
+                )
+            else:
+                out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,
+                                            window=self.window,
+                                            softcap=self.softcap,
+                                            sinks=self.attn_sinks)
         elif isinstance(cache, QuantKVCache):
             out, cache = self._quantized_decode(q, k, v, cache)
         elif isinstance(cache, RaggedKVCache):

@@ -48,6 +48,8 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     ep_axis: str | None = None
+    cp_axis: str | None = None  # context-parallel attention (needs mesh)
+    mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
     def __call__(self, x, cache=None):
@@ -64,6 +66,8 @@ class TransformerBlock(nn.Module):
             rope=self.rope,
             rope_theta=self.rope_theta,
             softcap=self.softcap,
+            cp_axis=self.cp_axis,
+            mesh=self.mesh,
         )(y, cache)
         if cache is not None:
             attn_out, cache = attn_out
@@ -109,6 +113,12 @@ class TinyDecoder(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     ep_axis: str | None = None  # mesh axis experts shard over
+    # Context-parallel training: run batch attention as the flash custom
+    # VJP composed under shard_map over ``cp_axis`` of ``mesh`` (see
+    # `parallel.cp`).  This is what makes the SHARDED train step execute
+    # the framework's own kernels rather than XLA's auto-SPMD einsums.
+    cp_axis: str | None = None
+    mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
@@ -138,6 +148,8 @@ class TinyDecoder(nn.Module):
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 ep_axis=self.ep_axis,
+                cp_axis=self.cp_axis,
+                mesh=self.mesh,
                 name=f"TransformerBlock_{i}",
             )
             if caches is None:

@@ -68,6 +68,7 @@ def _stat_col(ref):
 
 
 def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
+                 q_off=0, kv_off=0, valid=None,
                  q_seg_ref=None, kv_seg_ref=None, window=None,
                  softcap2=None):
     """(block_q, block_k) probability tile, Q-major; returns (p, dcap)
@@ -76,6 +77,11 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
 
     ``qs`` is the forward's pre-scaled Q (scores come out log2-domain),
     ``lse_col`` a (block_q, 1) log2-domain log-sum-exp column.
+    ``q_off``/``kv_off`` are the global positions of this call's local
+    Q/KV row 0 (dynamic scalars — causal masking stays correct when the
+    caller holds only a shard, the forward kernel's offsets contract);
+    ``valid`` is a traced count of valid LOCAL KV rows, or None when
+    every row is real.
     """
     s2 = jax.lax.dot_general(
         qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -87,13 +93,21 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
         dcap = 1.0 - t * t
     p = jnp.exp2(s2 - lse_col)
     mask = None
-    if causal:
+    if causal or valid is not None:
         row = q_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
         col = k_base + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    if valid is not None:
+        # rows the forward fully masked have lse == -inf (guard them too:
+        # exp2(s - -inf) would be +inf, not 0)
+        mask = jnp.logical_and(col < valid, lse_col != NEG_INF)
+    if causal:
         # also guards rows the forward fully masked (lse == -inf)
-        mask = jnp.logical_and(col <= row, lse_col != NEG_INF)
+        cm = jnp.logical_and(col + kv_off <= row + q_off,
+                             lse_col != NEG_INF)
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
         if window is not None:
-            mask = jnp.logical_and(mask, col >= row - (window - 1))
+            mask = jnp.logical_and(
+                mask, col + kv_off >= row + q_off - (window - 1))
     if q_seg_ref is not None:
         q_ids = jnp.max(q_seg_ref[...], axis=-1, keepdims=True)
         kv_ids = jnp.max(kv_seg_ref[...], axis=0, keepdims=True)
@@ -105,15 +119,17 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
 
 
 def _dq_kernel(
-    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
+    offsets_ref, lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, scale, out_dtype, compute_dtype, segmented,
-    window, n_j_total, softcap2,
+    window, n_j_total, softcap2, dynamic_valid,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
     else:
         q_seg_ref = kv_seg_ref = None
     dq_ref, acc_scr = rest
+    q_off = offsets_ref[0]
+    kv_off = offsets_ref[1]
     jb = pl.program_id(2)
     q_base = pl.program_id(1) * block_q
     if window is None:
@@ -121,7 +137,9 @@ def _dq_kernel(
     else:
         # banded grid (mirrors the forward kernel): skipped grid steps
         # are not free, so the j dimension covers only the window band
-        j = jnp.maximum((q_base - (window - 1)) // block_k, 0) + jb
+        j = jnp.maximum(
+            (q_base + q_off - kv_off - (window - 1)) // block_k, 0
+        ) + jb
     k_base = j * block_k
 
     @pl.when(jb == 0)
@@ -133,6 +151,8 @@ def _dq_kernel(
         p, dcap = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
+            q_off=q_off, kv_off=kv_off,
+            valid=offsets_ref[2] if dynamic_valid else None,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
             softcap2=softcap2,
         )
@@ -148,13 +168,23 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )  # (block_q, d) = dS K
 
+    keep = True
+    guarded = False
     if causal:
         # KV tiles strictly above the diagonal are all zeros under the
         # causal mask — skip them (halves causal backward FLOPs); the
         # banded window grid can also run past the last real KV block.
-        keep = k_base <= q_base + block_q - 1
+        keep = jnp.logical_and(
+            keep, k_base + kv_off <= q_base + block_q - 1 + q_off
+        )
+        guarded = True
         if window is not None:
             keep = jnp.logical_and(keep, j < n_j_total)
+    if dynamic_valid:
+        # blocks wholly past the valid KV prefix contribute nothing
+        keep = jnp.logical_and(keep, k_base < offsets_ref[2])
+        guarded = True
+    if guarded:
         pl.when(keep)(_compute)
     else:
         _compute()
@@ -165,15 +195,17 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
+    offsets_ref, lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, group, compute_dtype, segmented, window,
-    n_i_total, softcap2,
+    n_i_total, softcap2, dynamic_valid,
 ):
     if segmented:
         q_seg_ref, kv_seg_ref, *rest = rest
     else:
         q_seg_ref = kv_seg_ref = None
     dk_ref, dv_ref, dk_scr, dv_scr = rest
+    q_off = offsets_ref[0]
+    kv_off = offsets_ref[1]
     h = pl.program_id(1)
     ib = pl.program_id(2)
     h_in_group = jax.lax.rem(h, group)
@@ -182,8 +214,11 @@ def _dkv_kernel(
         i = ib
     else:
         # banded: only q blocks within [diagonal, diagonal + window)
-        # contribute to this kv block
-        i = k_base // block_q + ib
+        # contribute to this kv block (diagonal in LOCAL q coordinates:
+        # the first local q row that can see local kv row k_base)
+        i = jnp.maximum(
+            (k_base + kv_off - q_off) // block_q, 0
+        ) + ib
     q_base = i * block_q
 
     @pl.when(jnp.logical_and(h_in_group == 0, ib == 0))
@@ -196,6 +231,8 @@ def _dkv_kernel(
         p, dcap = _recompute_p(
             qs, k, _stat_col(lse_ref), causal=causal,
             q_base=q_base, k_base=k_base,
+            q_off=q_off, kv_off=kv_off,
+            valid=offsets_ref[2] if dynamic_valid else None,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
             softcap2=softcap2,
         )
@@ -214,18 +251,30 @@ def _dkv_kernel(
             ds.astype(compute_dtype), qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_k, d) = dSᵀ Q_scaled
+
+    keep = True
+    guarded = False
     if causal:
         # Q tiles wholly above the diagonal contribute nothing to this
         # KV block — skip them (halves causal backward FLOPs); the
         # banded window grid can also run past the last real Q block.
-        keep = k_base <= q_base + block_q - 1
+        keep = jnp.logical_and(
+            keep, k_base + kv_off <= q_base + block_q - 1 + q_off
+        )
+        guarded = True
         if window is not None:
             # band_i overestimates by one tile when block_k % block_q
             # == 0: also skip q tiles wholly past the window end
             keep = jnp.logical_and(keep, i < n_i_total)
             keep = jnp.logical_and(
-                keep, q_base - (window - 1) <= k_base + block_k - 1
+                keep,
+                q_base + q_off - (window - 1)
+                <= k_base + block_k - 1 + kv_off,
             )
+    if dynamic_valid:
+        keep = jnp.logical_and(keep, k_base < offsets_ref[2])
+        guarded = True
+    if guarded:
         pl.when(keep)(_compute)
     else:
         _compute()
@@ -327,6 +376,9 @@ def flash_backward(
     window: int | None = None,
     softcap: float | None = None,
     sinks: int | None = None,
+    q_offset=None,
+    kv_offset=None,
+    kv_valid=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """dQ, dK, dV via the two Pallas backward kernels.
 
@@ -334,7 +386,22 @@ def flash_backward(
     scores and dS picks up the 1 - tanh^2 chain factor.  ``sinks``
     (StreamingLLM, requires ``window``) adds the out-of-window sink
     pairs via the XLA sliver `_sink_patch` on top of the banded
-    window-masked kernels."""
+    window-masked kernels.
+
+    ``q_offset``/``kv_offset``/``kv_valid`` are dynamic scalars with the
+    same contract as the forward kernel's (`flash.py::_flash_call`): the
+    global sequence positions of local row 0 and the count of valid
+    local KV rows — what makes the backward composable under context
+    parallelism (each device differentiates its shard of the reference's
+    orchestrated distribution, `attention-mpi.c:191-407`).  ``sinks``
+    pins ABSOLUTE positions and is not supported together with offsets.
+    """
+    if sinks is not None and (q_offset is not None or kv_offset is not None
+                              or kv_valid is not None):
+        raise ValueError(
+            "sinks do not compose with q_offset/kv_offset/kv_valid "
+            "(sink positions are absolute)"
+        )
     segmented = q_segment_ids is not None
     if segmented != (kv_segment_ids is not None):
         raise ValueError("q_segment_ids and kv_segment_ids go together")
@@ -405,23 +472,51 @@ def flash_backward(
         band_j = min(num_j, -(-(window - 1 + block_q) // block_k) + 1)
         band_i = min(num_i, (block_k - 1 + window - 1) // block_q + 2)
 
-    def j_abs(ii, jj):
+    dynamic_valid = kv_valid is not None
+    offsets = jnp.stack(
+        [
+            jnp.asarray(0 if q_offset is None else q_offset, jnp.int32),
+            jnp.asarray(0 if kv_offset is None else kv_offset, jnp.int32),
+            jnp.asarray(n if kv_valid is None else kv_valid, jnp.int32),
+        ]
+    )
+
+    def j_abs(ii, jj, off):
         # clamp band-tail steps to the last block the row actually
         # computes (its causal diagonal), so their DMAs elide instead of
         # fetching a never-used block
         if window is None:
-            return jj
-        base = jnp.maximum((ii * block_q - (window - 1)) // block_k, 0)
-        causal_last = (ii * block_q + block_q - 1) // block_k
-        return jnp.minimum(base + jj,
-                           jnp.minimum(causal_last, num_j - 1))
+            jj_c = jj
+        else:
+            base = jnp.maximum(
+                (ii * block_q + off[0] - off[1] - (window - 1)) // block_k,
+                0,
+            )
+            causal_last = jnp.maximum(
+                (ii * block_q + block_q - 1 + off[0] - off[1]) // block_k, 0
+            )
+            jj_c = jnp.minimum(base + jj,
+                               jnp.minimum(causal_last, num_j - 1))
+        if dynamic_valid:
+            valid_last = jnp.maximum(
+                (off[2] + block_k - 1) // block_k - 1, 0
+            )
+            jj_c = jnp.minimum(jj_c, valid_last)
+        return jj_c
 
-    def i_abs(jj, ii):
+    def i_abs(jj, ii, off):
         # clamp to the last q block inside this kv block's window span
         if window is None:
             return ii
-        win_last = (jj * block_k + block_k - 1 + window - 1) // block_q
-        return jnp.minimum(jj * block_k // block_q + ii,
+        first = jnp.maximum(
+            (jj * block_k + off[1] - off[0]) // block_q, 0
+        )
+        win_last = jnp.maximum(
+            (jj * block_k + block_k - 1 + window - 1 + off[1] - off[0])
+            // block_q,
+            0,
+        )
+        return jnp.minimum(first + ii,
                            jnp.minimum(win_last, num_i - 1))
 
     seg_inputs = ()
@@ -434,18 +529,43 @@ def flash_backward(
                                       m, n, m_pad, n_pad)
         seg_inputs = (q_rep, kv_rep)
         seg_specs_q = [
-            pl.BlockSpec((block_q, _STAT_LANES), lambda hh, ii, jj: (ii, 0)),
+            pl.BlockSpec((block_q, _STAT_LANES),
+                         lambda hh, ii, jj, off: (ii, 0)),
             pl.BlockSpec((8, block_k),
-                         lambda hh, ii, jj: (0, j_abs(ii, jj))),
+                         lambda hh, ii, jj, off: (0, j_abs(ii, jj, off))),
         ]
         seg_specs_kv = [
             pl.BlockSpec((block_q, _STAT_LANES),
-                         lambda jj, hh, ii: (i_abs(jj, ii), 0)),
-            pl.BlockSpec((8, block_k), lambda jj, hh, ii: (0, jj)),
+                         lambda jj, hh, ii, off: (i_abs(jj, ii, off), 0)),
+            pl.BlockSpec((8, block_k), lambda jj, hh, ii, off: (0, jj)),
         ]
 
     stat_spec_q = pl.BlockSpec(
-        (1, block_q, _STAT_LANES), lambda hh, ii, jj: (hh, ii, 0)
+        (1, block_q, _STAT_LANES), lambda hh, ii, jj, off: (hh, ii, 0)
+    )
+    dq_grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, num_i, band_j),
+        in_specs=[
+            stat_spec_q,
+            stat_spec_q,
+            pl.BlockSpec((1, block_q, d),
+                         lambda hh, ii, jj, off: (hh, ii, 0)),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda hh, ii, jj, off: (hh // group, j_abs(ii, jj, off), 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, dv),
+                lambda hh, ii, jj, off: (hh // group, j_abs(ii, jj, off), 0),
+            ),
+            pl.BlockSpec((1, block_q, dv),
+                         lambda hh, ii, jj, off: (hh, ii, 0)),
+            *seg_specs_q,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda hh, ii, jj, off: (hh, ii, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )
     dq = pl.pallas_call(
         functools.partial(
@@ -460,22 +580,10 @@ def flash_backward(
             window=window,
             n_j_total=num_j,
             softcap2=None if softcap is None else softcap * _LOG2E,
+            dynamic_valid=dynamic_valid,
         ),
-        grid=(h, num_i, band_j),
-        in_specs=[
-            stat_spec_q,
-            stat_spec_q,
-            pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda hh, ii, jj: (hh // group, j_abs(ii, jj), 0)),
-            pl.BlockSpec((1, block_k, dv),
-                         lambda hh, ii, jj: (hh // group, j_abs(ii, jj), 0)),
-            pl.BlockSpec((1, block_q, dv), lambda hh, ii, jj: (hh, ii, 0)),
-            *seg_specs_q,
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda hh, ii, jj: (hh, ii, 0)),
+        grid_spec=dq_grid_spec,
         out_shape=jax.ShapeDtypeStruct((h, m_pad, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=6 * h * m_pad * (band_j * block_k) * d,
@@ -485,10 +593,38 @@ def flash_backward(
             transcendentals=h * m_pad * (band_j * block_k),
         ),
         interpret=interpret,
-    )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)[:, :m]
+    )(offsets, lse_rep, delta_rep, qs, k, v, do, *seg_inputs)[:, :m]
 
     stat_spec_kv = pl.BlockSpec(
-        (1, block_q, _STAT_LANES), lambda jj, hh, ii: (hh, i_abs(jj, ii), 0)
+        (1, block_q, _STAT_LANES),
+        lambda jj, hh, ii, off: (hh, i_abs(jj, ii, off), 0),
+    )
+    dkv_grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_j, h, band_i),
+        in_specs=[
+            stat_spec_kv,
+            stat_spec_kv,
+            pl.BlockSpec((1, block_q, d),
+                         lambda jj, hh, ii, off: (hh, i_abs(jj, ii, off), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda jj, hh, ii, off: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, dv),
+                         lambda jj, hh, ii, off: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_q, dv),
+                         lambda jj, hh, ii, off: (hh, i_abs(jj, ii, off), 0)),
+            *seg_specs_kv,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d),
+                         lambda jj, hh, ii, off: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, dv),
+                         lambda jj, hh, ii, off: (hh // group, jj, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, dv), jnp.float32),
+        ],
     )
     dk, dvg = pl.pallas_call(
         functools.partial(
@@ -502,30 +638,12 @@ def flash_backward(
             window=window,
             n_i_total=num_i,
             softcap2=None if softcap is None else softcap * _LOG2E,
+            dynamic_valid=dynamic_valid,
         ),
-        grid=(num_j, h, band_i),
-        in_specs=[
-            stat_spec_kv,
-            stat_spec_kv,
-            pl.BlockSpec((1, block_q, d),
-                         lambda jj, hh, ii: (hh, i_abs(jj, ii), 0)),
-            pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
-            pl.BlockSpec((1, block_q, dv),
-                         lambda jj, hh, ii: (hh, i_abs(jj, ii), 0)),
-            *seg_specs_kv,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda jj, hh, ii: (hh // group, jj, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda jj, hh, ii: (hh // group, jj, 0)),
-        ],
+        grid_spec=dkv_grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((hkv, n_pad, d), jnp.float32),
             jax.ShapeDtypeStruct((hkv, n_pad, dv), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, dv), jnp.float32),
         ],
         compiler_params=_compiler_params(("parallel", "arbitrary", "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -536,7 +654,7 @@ def flash_backward(
             transcendentals=h * (band_i * block_q) * n_pad,
         ),
         interpret=interpret,
-    )(lse_rep, delta_rep, qs, k, v, do, *seg_inputs)
+    )(offsets, lse_rep, delta_rep, qs, k, v, do, *seg_inputs)
     dk, dvg = dk[:, :n], dvg[:, :n]
     if sinks is not None:
         dq_s, dk_s, dv_s, se = _sink_patch(

@@ -47,11 +47,13 @@ def _gqa_expand(k, group):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
-def _flash_diff(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                bwd_chunk, bwd_impl, window, softcap, sinks):
+                   nondiff_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
+def _flash_diff(q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, scale,
+                causal, block_sizes, bwd_chunk, bwd_impl, window, softcap,
+                sinks):
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                             q_seg, kv_seg, window, softcap, sinks)
+                             q_seg, kv_seg, window, softcap, sinks,
+                             q_off, kv_off, kv_val)
     return out
 
 
@@ -62,15 +64,17 @@ def _seg_zeros(seg):
 
     if seg is None:
         return None
-    return np.zeros(seg.shape, jax.dtypes.float0)
+    return np.zeros(jnp.shape(seg), jax.dtypes.float0)
 
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
-                    kv_seg=None, window=None, softcap=None, sinks=None):
+                    kv_seg=None, window=None, softcap=None, sinks=None,
+                    q_off=None, kv_off=None, kv_val=None):
     out_un, row_max, row_sum = flash_attention_partials(
         q, k, v, scale=scale, causal=causal, block_sizes=block_sizes,
         q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
         softcap=softcap, sinks=sinks,
+        q_offset=q_off, kv_offset=kv_off, kv_valid=kv_val,
     )
     l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = (out_un / l_safe[..., None]).astype(q.dtype)
@@ -80,17 +84,20 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
     return out, lse
 
 
-def _flash_diff_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_sizes,
-                    bwd_chunk, bwd_impl, window, softcap, sinks):
+def _flash_diff_fwd(q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, scale,
+                    causal, block_sizes, bwd_chunk, bwd_impl, window,
+                    softcap, sinks):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
-                               q_seg, kv_seg, window, softcap, sinks)
-    return out, (q, k, v, q_seg, kv_seg, out, lse)
+                               q_seg, kv_seg, window, softcap, sinks,
+                               q_off, kv_off, kv_val)
+    return out, (q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, out, lse)
 
 
 def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
                     window, softcap, sinks, res, dout):
-    q, k, v, q_seg, kv_seg, out, lse = res
-    seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg))
+    q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, out, lse = res
+    seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg),
+                _seg_zeros(q_off), _seg_zeros(kv_off), _seg_zeros(kv_val))
     if bwd_impl == "pallas":
         from attention_tpu.ops.flash import _should_interpret
         from attention_tpu.ops.flash_bwd import flash_backward
@@ -101,12 +108,15 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
             interpret=_should_interpret(),
             q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
             softcap=softcap, sinks=sinks,
+            q_offset=q_off, kv_offset=kv_off, kv_valid=kv_val,
         ) + seg_cots
     h, m, dk = q.shape
     hkv, n, dv = v.shape
     group = h // hkv
     kx = _gqa_expand(k, group)  # (h, n, dk)
     vx = _gqa_expand(v, group)
+    qo = 0 if q_off is None else q_off
+    ko = 0 if kv_off is None else kv_off
 
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, kx, vx))
     dout32 = dout.astype(jnp.float32)
@@ -158,17 +168,21 @@ def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
             t = jnp.tanh(s / softcap)
             s = softcap * t
             dcap = 1.0 - t * t
+        mask = None
         if causal:
-            rows = base + jnp.arange(chunk)
-            mask = jnp.arange(n)[None, :] <= rows[:, None]
+            rows = base + jnp.arange(chunk) + qo
+            cols = jnp.arange(n) + ko
+            mask = cols[None, :] <= rows[:, None]
             if window is not None:
-                win = jnp.arange(n)[None, :] >= rows[:, None] - (window - 1)
+                win = cols[None, :] >= rows[:, None] - (window - 1)
                 if sinks is not None:
                     # pinned StreamingLLM sink positions stay visible
-                    win = jnp.logical_or(
-                        win, jnp.arange(n)[None, :] < sinks
-                    )
+                    win = jnp.logical_or(win, cols[None, :] < sinks)
                 mask = jnp.logical_and(mask, win)
+        if kv_val is not None:
+            vm = (jnp.arange(n) < kv_val)[None, :]
+            mask = vm if mask is None else jnp.logical_and(mask, vm)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         if segmented:
             s = jnp.where(qsegi[:, None] == kvseg_arr[None, :], s, NEG_INF)
@@ -213,6 +227,9 @@ def flash_attention_diff(
     window: int | None = None,
     softcap: float | None = None,
     sinks: int | None = None,
+    q_offset=None,
+    kv_offset=None,
+    kv_valid=None,
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
@@ -225,37 +242,51 @@ def flash_attention_diff(
     the VJP.  ``sinks`` (StreamingLLM pinned positions; requires
     ``window``) is differentiable too: the banded backward kernels
     handle the window pairs and `flash_bwd._sink_patch` the sink
-    sliver.
+    sliver.  ``q_offset``/``kv_offset``/``kv_valid`` (dynamic int32
+    scalars, same contract as :func:`flash_attention`) keep causal
+    masking and valid-prefix masking correct when the caller holds only
+    a sequence shard — the differentiable leg of context parallelism;
+    they flow through both the forward and backward kernels.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
+    if sinks is not None and (q_offset is not None or kv_offset is not None
+                              or kv_valid is not None):
+        raise ValueError(
+            "sinks do not compose with q_offset/kv_offset/kv_valid "
+            "(sink positions are absolute)"
+        )
     # None flows through: the forward resolves it via
     # BlockSizes.for_shape(returns_stats=True) and flash_backward via
     # default_bwd_block_sizes (dtype- and window-aware) — the two
     # kernels are tuned independently (see flash_bwd.py).
     bs = block_sizes
     qseg, kvseg = q_segment_ids, kv_segment_ids
+    offs = tuple(
+        None if o is None else jnp.asarray(o, jnp.int32)
+        for o in (q_offset, kv_offset, kv_valid)
+    )
     if qseg is not None and q.ndim == 4:
         raise ValueError(
             "segment ids support 2D/3D inputs (ids shared across heads)"
         )
     if q.ndim == 2:
         return _flash_diff(
-            q[None], k[None], v[None], qseg, kvseg, scale, causal, bs,
-            bwd_chunk, bwd_impl, window, softcap, sinks,
+            q[None], k[None], v[None], qseg, kvseg, *offs, scale, causal,
+            bs, bwd_chunk, bwd_impl, window, softcap, sinks,
         )[0]
     if q.ndim == 3:
-        return _flash_diff(q, k, v, qseg, kvseg, scale, causal, bs,
+        return _flash_diff(q, k, v, qseg, kvseg, *offs, scale, causal, bs,
                            bwd_chunk, bwd_impl, window, softcap, sinks)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
         vf = v.reshape(b * v.shape[1], *v.shape[2:])
         out = _flash_diff(
-            q.reshape(b * hq, m, d), kf, vf, None, None, scale, causal, bs,
-            bwd_chunk, bwd_impl, window, softcap, sinks,
+            q.reshape(b * hq, m, d), kf, vf, None, None, *offs, scale,
+            causal, bs, bwd_chunk, bwd_impl, window, softcap, sinks,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")

@@ -3,6 +3,7 @@ from attention_tpu.parallel.mesh import (  # noqa: F401
     choose_kv_placement,
     default_mesh,
 )
+from attention_tpu.parallel.cp import cp_flash_attention  # noqa: F401
 from attention_tpu.parallel.kv_sharded import kv_sharded_attention  # noqa: F401
 from attention_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 from attention_tpu.parallel.ring import ring_attention  # noqa: F401

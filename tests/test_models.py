@@ -57,11 +57,13 @@ def test_mesh_factorization():
 
 def test_sharded_training_step_decreases_loss(rng):
     """Full dp/sp/tp-sharded train step on the 8-device CPU mesh: loss
-    must move and params must stay finite over a few steps."""
+    must move and params must stay finite over a few steps.  Runs the
+    framework's own fused kernel under the mesh (impl='flash' with
+    context-parallel attention), not the auto-SPMD dense fallback."""
     mesh = make_mesh_3d(8)
     model = TinyDecoder(
-        vocab=64, dim=64, depth=1, num_q_heads=4, num_kv_heads=2, impl="xla",
-        dtype=jnp.float32,
+        vocab=64, dim=64, depth=1, num_q_heads=4, num_kv_heads=2,
+        impl="flash", cp_axis="sp", mesh=mesh, dtype=jnp.float32,
     )
     params, optimizer, opt_state = init_sharded(model, mesh, batch=4, seq=32)
     step = make_train_step(model, optimizer, mesh)
