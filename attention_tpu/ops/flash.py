@@ -108,6 +108,7 @@ def _ceil_to(x: int, mult: int) -> int:
 
 def _flash_kernel(
     offsets_ref,
+    knmax_ref,
     q_ref,
     k_ref,
     v_ref,
@@ -125,6 +126,7 @@ def _flash_kernel(
     softcap2: float | None = None,
     sinks: int | None = None,
     sink_blocks: int = 0,
+    bound_mode: bool = False,
 ):
     """One (head, q-block, kv-block) grid step of online-softmax attention.
 
@@ -136,6 +138,17 @@ def _flash_kernel(
     shard includes padding from an indivisible global sequence).
     ``window`` (static) keeps only the last ``window`` positions per row
     (sliding-window attention; requires causal).
+    ``bound_mode`` (the VFA idea, PAPERS.md: global-max precompute)
+    replaces the online max recurrence with a per-row upper bound on the
+    scores, computed in-kernel at the first KV step from the resident Q
+    block and the prefetched per-KV-head max key norm (``knmax_ref``,
+    Cauchy-Schwarz: |q·k| <= ||q||·max||k||): softmax is invariant to
+    which max is subtracted, so using a bound instead of the true
+    running max gives the same normalized output and lse while deleting
+    the row-max reduce, the corr exp2, the accumulator rescale and the
+    m-scratch traffic from the serial VPU chain.  ``l`` then accumulates
+    per-lane and reduces once at finalize.  The m scratch holds the
+    bound (written once, read per tile) instead of the running max.
     ``rest`` = ([q_seg, kv_seg,] o_ref, m_out, l_out, acc, m, l).
     """
     if segmented:
@@ -146,6 +159,7 @@ def _flash_kernel(
     # program_id is read at the kernel top level: interpret mode on CPU
     # substitutes grid indices only there, and the values are
     # loop-invariant anyway.
+    h_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     jb = pl.program_id(2)
     if window is None:
@@ -172,7 +186,18 @@ def _flash_kernel(
 
     @pl.when(jb == 0)
     def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        if bound_mode:
+            # Cauchy-Schwarz bound from the resident (pre-scaled) Q
+            # block and this head's prefetched max key norm; softcap
+            # tightens it (|cap·tanh(s/cap)| <= min(|s|, cap)).
+            q0 = q_ref[0].astype(jnp.float32)
+            qn = jnp.sqrt(jnp.sum(q0 * q0, axis=-1, keepdims=True))
+            b = qn * knmax_ref[h_idx]
+            if softcap2 is not None:
+                b = jnp.minimum(b, softcap2)
+            m_scr[...] = jnp.broadcast_to(b, m_scr.shape)
+        else:
+            m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
@@ -213,12 +238,17 @@ def _flash_kernel(
             block_q=block_q,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
             window=window, softcap2=softcap2, sinks=sinks,
+            bound_mode=bound_mode,
         )
 
     @pl.when(jb == pl.num_programs(2) - 1)
     def _finalize():
         acc = acc_scr[...]
-        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        if bound_mode:
+            # l accumulated per lane: one cross-lane reduce, here only
+            l = jnp.sum(l_scr[...], axis=-1, keepdims=True)
+        else:
+            l = jnp.max(l_scr[...], axis=-1, keepdims=True)
         if normalize:
             # 1/gsum normalization with the divide-by-zero guard the
             # reference applies (attention-mpi.c:358-362).
@@ -229,8 +259,13 @@ def _flash_kernel(
         if m_out_ref is not None:
             # Stats leave the kernel in the natural-log domain (the
             # distributed pmax/psum merge computes exp(lmax - gmax)).
+            # In bound mode m_scr holds the bound — any value >= the
+            # true row max yields the same merge and lse.
             m_out_ref[0] = m_scr[...] * _LN2
-            l_out_ref[0] = l_scr[...]
+            if bound_mode:
+                l_out_ref[0] = jnp.broadcast_to(l, l_out_ref[0].shape)
+            else:
+                l_out_ref[0] = l_scr[...]
 
 
 def banded_keep(col, kv_min, sinks):
@@ -247,7 +282,7 @@ def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
     block_q, q_seg_ref=None, kv_seg_ref=None, window=None, softcap2=None,
-    sinks=None, kv_min=None,
+    sinks=None, kv_min=None, bound_mode=False,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
@@ -309,6 +344,30 @@ def _flash_tile(
             mask = jnp.logical_and(mask, q_ids == kv_ids)
         s = jnp.where(mask, s, NEG_INF)
 
+    if bound_mode:
+        # Bound mode (VFA): the per-row score max is replaced by the
+        # upper bound `_init` stored in m_scr, so there is no running
+        # max, no corr, no accumulator rescale — the whole tile update
+        # is one exp2, one per-lane partial sum and the P·V matmul.
+        # Masked entries are -inf ⇒ exp2(-inf - b) = 0 (bound finite).
+        b_col = jnp.max(m_scr[...], axis=-1, keepdims=True)
+        p = jnp.exp2(s - b_col)
+        # per-lane partial sums via lane-aligned slices (a reshape-based
+        # (bq, bk/128, 128) reduce forces a Mosaic relayout — measured
+        # 1.6x slower and +10MB scoped VMEM at 32k)
+        lane_sum = p[:, :_STAT_LANES]
+        for g in range(1, block_k // _STAT_LANES):
+            lane_sum = lane_sum + p[:, g * _STAT_LANES:(g + 1) * _STAT_LANES]
+        l_scr[...] += lane_sum
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] += pv
+        return
+
     p, corr = _online_softmax_update(s, m_scr, l_scr, masked=masked)
 
     pv = jax.lax.dot_general(
@@ -368,9 +427,12 @@ def _flash_call(
     window=None,
     softcap=None,
     sinks=None,
+    max_mode="online",
 ):
     h, m, d = q.shape
     hkv, n, dv = v.shape
+    if max_mode not in ("online", "bound"):
+        raise ValueError(f"unknown max_mode {max_mode!r}")
     if h % hkv != 0:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     group = h // hkv
@@ -436,6 +498,7 @@ def _flash_call(
         )
     grid = (h, m_pad // block_q, sink_blocks + band_blocks)
 
+    bound_mode = max_mode == "bound"
     kernel = functools.partial(
         _flash_kernel,
         n_true=n,
@@ -451,6 +514,7 @@ def _flash_call(
         softcap2=None if softcap is None else softcap * _LOG2E,
         sinks=sinks,
         sink_blocks=sink_blocks,
+        bound_mode=bound_mode,
     )
 
     offsets = jnp.stack(
@@ -462,7 +526,7 @@ def _flash_call(
     )
     dynamic_valid = kv_valid is not None
 
-    def kv_map(hh, i, j, off):
+    def kv_map(hh, i, j, off, knm):
         # Clamp block indices for tiles the kernel's @pl.when guard will
         # skip (above the causal diagonal / past the dynamic valid
         # prefix) to the last block it will compute: Pallas elides the
@@ -498,10 +562,27 @@ def _flash_call(
         return (hh // group, jj, 0)
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda hh, i, j, off: (hh, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda hh, i, j, off, knm: (hh, i, 0)),
         pl.BlockSpec((1, block_k, d), kv_map),
         pl.BlockSpec((1, block_k, dv), kv_map),
     ]
+    if bound_mode:
+        # Per-KV-head max key norm for the in-kernel Cauchy-Schwarz
+        # bound on the log2-domain scores: |q·k| <= ||q||·max_j ||k_j||
+        # (exact kernel operands: the pre-scaled, re-rounded Q — its
+        # norm is computed in-kernel from the resident block — and the
+        # padded K).  Softmax output and lse are invariant to the
+        # choice of max as long as it is >= the true row max, so any
+        # overshoot costs only fp32 headroom (contract: overshoot must
+        # stay < ~120 log2 units; Cauchy-Schwarz on attention shapes is
+        # orders of magnitude inside that).
+        k32 = k.astype(jnp.float32)
+        knmax = jnp.repeat(
+            jnp.max(jnp.sqrt(jnp.sum(k32 * k32, axis=-1)), axis=-1),
+            group,
+        )  # (h,) f32, indexed by the head grid dim in `_init`
+    else:
+        knmax = jnp.zeros((1,), jnp.float32)  # unused placeholder
     seg_inputs = ()
     if segmented:
         q_rep, kv_rep = segment_masks(q_segment_ids, kv_segment_ids,
@@ -509,18 +590,20 @@ def _flash_call(
         seg_inputs = (q_rep, kv_rep)
         in_specs += [
             pl.BlockSpec((block_q, _STAT_LANES),
-                         lambda hh, i, j, off: (i, 0)),
-            pl.BlockSpec((8, block_k),
-                         lambda hh, i, j, off: (0, kv_map(hh, i, j, off)[1])),
+                         lambda hh, i, j, off, knm: (i, 0)),
+            pl.BlockSpec(
+                (8, block_k),
+                lambda hh, i, j, off, knm: (0, kv_map(hh, i, j, off, knm)[1]),
+            ),
         ]
     out_shapes = [jax.ShapeDtypeStruct((h, m_pad, dv), out_dtype)]
     out_specs = [
-        pl.BlockSpec((1, block_q, dv), lambda hh, i, j, off: (hh, i, 0))
+        pl.BlockSpec((1, block_q, dv), lambda hh, i, j, off, knm: (hh, i, 0))
     ]
     if return_stats:
         stat_shape = jax.ShapeDtypeStruct((h, m_pad, _STAT_LANES), jnp.float32)
         stat_spec = pl.BlockSpec(
-            (1, block_q, _STAT_LANES), lambda hh, i, j, off: (hh, i, 0)
+            (1, block_q, _STAT_LANES), lambda hh, i, j, off, knm: (hh, i, 0)
         )
         out_shapes += [stat_shape, stat_shape]
         out_specs += [stat_spec, stat_spec]
@@ -528,7 +611,7 @@ def _flash_call(
         kernel = functools.partial(_no_stat_kernel, kernel)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -559,7 +642,7 @@ def _flash_call(
             transcendentals=h * m_pad * n_eff,
         ),
         interpret=interpret,
-    )(offsets, q, k, v, *seg_inputs)
+    )(offsets, knmax, q, k, v, *seg_inputs)
 
     out = outs[0][:, :m]
     if return_stats:
@@ -570,8 +653,8 @@ def _flash_call(
 
 
 def _no_stat_kernel(kernel, *args):
-    # args = (off, q, k, v, [q_seg, kv_seg], o, acc, m, l): splice None
-    # stat-output refs in front of the scratch refs.
+    # args = (off, knm, q, k, v, [q_seg, kv_seg], o, acc, m, l): splice
+    # None stat-output refs in front of the scratch refs.
     *pre, o_ref, acc, m_scr, l_scr = args
     kernel(*pre, o_ref, None, None, acc, m_scr, l_scr)
 
@@ -652,6 +735,7 @@ def _canon(q, k, v):
         "window",
         "softcap",
         "sinks",
+        "max_mode",
     ),
 )
 def flash_attention(
@@ -671,6 +755,7 @@ def flash_attention(
     window: int | None = None,
     softcap: float | None = None,
     sinks: int | None = None,
+    max_mode: str = "online",
 ) -> jax.Array:
     """Fused single-device attention: softmax(q k^T * scale) v.
 
@@ -687,6 +772,9 @@ def flash_attention(
     ``cap * tanh(scores / cap)`` before masking and softmax.  ``sinks``
     (static int, requires window) keeps the first ``sinks`` positions
     attendable alongside the window (StreamingLLM attention sinks).
+    ``max_mode="bound"`` (VFA, PAPERS.md) replaces the in-kernel online
+    max with a precomputed Cauchy-Schwarz row bound — same output and
+    stats (softmax is max-choice invariant), shorter per-tile VPU chain.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -718,6 +806,7 @@ def flash_attention(
         window=window,
         softcap=softcap,
         sinks=sinks,
+        max_mode=max_mode,
     )
     return unbatch(out)
 
@@ -725,7 +814,7 @@ def flash_attention(
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "causal", "block_sizes", "interpret",
-                     "window", "softcap", "sinks"),
+                     "window", "softcap", "sinks", "max_mode"),
 )
 def flash_attention_partials(
     q: jax.Array,
@@ -744,6 +833,7 @@ def flash_attention_partials(
     window: int | None = None,
     softcap: float | None = None,
     sinks: int | None = None,
+    max_mode: str = "online",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Unnormalized attention over a local KV shard.
 
@@ -782,6 +872,7 @@ def flash_attention_partials(
         window=window,
         softcap=softcap,
         sinks=sinks,
+        max_mode=max_mode,
     )
     if q.ndim == 2:
         return out[0], row_max[0], row_sum[0]

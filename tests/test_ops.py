@@ -205,3 +205,52 @@ def test_api_dispatch(rng):
         np.testing.assert_allclose(out, exp, atol=1e-3)
     with pytest.raises(ValueError):
         attention(q, k, v, backend="nope")
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(causal=True),
+        dict(causal=False),
+        dict(causal=True, softcap=20.0),
+        dict(causal=True, window=64),
+        dict(causal=True, q_offset=16, kv_valid=200),
+    ],
+    ids=["causal", "full", "softcap", "window", "offsets"],
+)
+def test_bound_mode_matches_online(rng, kwargs):
+    """max_mode='bound' (VFA Cauchy-Schwarz bound instead of the online
+    max) must reproduce the online kernel's output bitwise-near (softmax
+    is invariant to the max choice) and the SAME lse from its partials
+    (so the merge and the backward are mode-agnostic)."""
+    q = jnp.asarray(rng.standard_normal((2, 250, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 250, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 250, 64)), jnp.float32)
+    o1 = flash_attention(q, k, v, **kwargs)
+    o2 = flash_attention(q, k, v, max_mode="bound", **kwargs)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=2e-5)
+    u1, m1, l1 = flash_attention_partials(q, k, v, **kwargs)
+    u2, m2, l2 = flash_attention_partials(q, k, v, max_mode="bound",
+                                          **kwargs)
+    l1n, l2n = np.asarray(l1), np.asarray(l2)
+    lse1 = np.asarray(m1) + np.log(np.where(l1n == 0, 1, l1n))
+    lse2 = np.asarray(m2) + np.log(np.where(l2n == 0, 1, l2n))
+    ok = l1n > 0
+    np.testing.assert_allclose(lse1[ok], lse2[ok], atol=1e-4)
+    # normalized outputs agree even where the raw partials differ
+    n1 = np.asarray(u1) / np.where(l1n[..., None] == 0, 1, l1n[..., None])
+    n2 = np.asarray(u2) / np.where(l2n[..., None] == 0, 1, l2n[..., None])
+    np.testing.assert_allclose(n1, n2, atol=2e-5)
+
+
+def test_bound_mode_gqa_matches_oracle(rng):
+    """Bound mode against the fp64 oracle on a GQA shape (the bound is
+    per-KV-head: the knmax indexing by q-head must group correctly)."""
+    q = jnp.asarray(rng.standard_normal((4, 128, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 160, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 160, 32)), jnp.float32)
+    got = np.asarray(flash_attention(q, k, v, max_mode="bound"))
+    kx = np.repeat(np.asarray(k, np.float64), 2, axis=0)
+    vx = np.repeat(np.asarray(v, np.float64), 2, axis=0)
+    want = attention_oracle_mha(np.asarray(q, np.float64), kx, vx)
+    np.testing.assert_allclose(got, want, atol=1e-4)
