@@ -60,13 +60,21 @@ def merge_partials(out_un, lmax, lsum, axis_name: str):
 
 def _local_partials(
     q, k, v, *, impl, scale, block_sizes, kv_valid, causal=False, q_offset=0,
-    kv_offset=0, softcap=None,
+    kv_offset=0, softcap=None, window=None, sinks=None, q_segment_ids=None,
+    kv_segment_ids=None,
 ):
     if impl == "flash":
         return flash_attention_partials(
             q, k, v, scale=scale, block_sizes=block_sizes, kv_valid=kv_valid,
             causal=causal, q_offset=q_offset, kv_offset=kv_offset,
-            softcap=softcap,
+            softcap=softcap, window=window, sinks=sinks,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+        )
+    if window is not None or sinks is not None or q_segment_ids is not None:
+        raise ValueError(
+            "window/sinks/segment ids on the sharded paths run the fused "
+            "kernel (impl='flash'); the xla partials oracle does not carry "
+            "them"
         )
     return attention_xla_partials(
         q, k, v, scale=scale, kv_valid=kv_valid, causal=causal,
@@ -84,6 +92,8 @@ def _local_partials(
         "impl",
         "causal",
         "softcap",
+        "window",
+        "sinks",
     ),
 )
 def kv_sharded_attention(
@@ -98,6 +108,10 @@ def kv_sharded_attention(
     impl: str = "flash",
     causal: bool = False,
     softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> jax.Array:
     """Distributed attention with K/V rows sharded over a 1D mesh.
 
@@ -107,6 +121,15 @@ def kv_sharded_attention(
 
     Accepts the same 2D/3D/4D shapes as :func:`flash_attention`; the
     sequence axis (second-to-last) of K/V is the sharded one.
+
+    The kernel's full masking surface flows through (the reference's
+    orchestrator carries its kernel's entire surface,
+    `attention-mpi.c:191-407`): ``window``/``sinks`` masks are expressed
+    in GLOBAL positions via each shard's dynamic ``kv_offset``, so a
+    band crossing shard boundaries and the absolute sink prefix both
+    resolve correctly per shard; packed-sequence segment ids ship with
+    their data — Q ids replicated, KV ids sharded alongside K/V rows
+    (ids must be 1D, 2D/3D inputs — the kernel's segment limit).
     """
     if mesh is None:
         mesh = default_mesh(axis_name)
@@ -114,6 +137,9 @@ def kv_sharded_attention(
     n = k.shape[-2]
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    segmented = q_segment_ids is not None
+    if segmented != (kv_segment_ids is not None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
 
     # Pad n up to a multiple of the mesh size; each shard masks its own
     # padded tail via the dynamic kv_valid scalar.
@@ -126,15 +152,24 @@ def kv_sharded_attention(
 
     seq_axis = k.ndim - 2
     kv_spec = P(*([None] * seq_axis), axis_name, None)
+    in_specs = [P(), kv_spec, kv_spec]
+    extra = []
+    if segmented:
+        kv_seg = jnp.asarray(kv_segment_ids, jnp.int32)
+        if n_pad != n:
+            # padded rows get id -1: matches no real (non-negative) id
+            kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
+        extra = [jnp.asarray(q_segment_ids, jnp.int32), kv_seg]
+        in_specs += [P(), P(axis_name)]
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         check_vma=False,
-        in_specs=(P(), kv_spec, kv_spec),
+        in_specs=tuple(in_specs),
         out_specs=P(),
     )
-    def run(q_full, k_local, v_local):
+    def run(q_full, k_local, v_local, *seg_local):
         idx = lax.axis_index(axis_name)
         # valid rows in this shard of the padded sequence (owner_count
         # analog: every shard owns n_local rows, the last ones partly pad)
@@ -150,10 +185,14 @@ def kv_sharded_attention(
             causal=causal,
             kv_offset=idx * n_local,
             softcap=softcap,
+            window=window,
+            sinks=sinks,
+            q_segment_ids=seg_local[0] if seg_local else None,
+            kv_segment_ids=seg_local[1] if seg_local else None,
         )
         return merge_partials(out_un, lmax, lsum, axis_name).astype(q_full.dtype)
 
-    return run(q, k, v)
+    return run(q, k, v, *extra)
 
 
 @functools.partial(

@@ -40,7 +40,7 @@ NEG_INF = float("-inf")
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
-                     "softcap"),
+                     "softcap", "schedule", "window", "sinks"),
 )
 def ring_attention(
     q: jax.Array,
@@ -53,6 +53,11 @@ def ring_attention(
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
     softcap: float | None = None,
+    schedule: str = "contiguous",
+    window: int | None = None,
+    sinks: int | None = None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> jax.Array:
     """Ring attention over a 1D mesh axis; output is Q-sharded like Q.
 
@@ -60,12 +65,47 @@ def ring_attention(
     sequence axes of Q and K/V are sharded over ``axis_name``; both are
     padded to a multiple of the ring size, with padded KV rows masked via
     the kernel's dynamic ``kv_valid`` scalar and padded Q rows sliced off.
+
+    ``schedule="zigzag"`` (causal only) interleaves sequence chunks so
+    every device carries equal unmasked work at EVERY ring step — the
+    load balance the reference had by construction (owner partitioner,
+    ±1 row, `attention-mpi.c:19-27`) and the contiguous causal ring
+    lacks (early-shard devices spend most steps on fully-masked
+    partials).  See :func:`_zigzag_ring`.
+
+    The kernel's masking surface flows through: ``window``/``sinks``
+    (expressed in GLOBAL positions via each step's rotating
+    ``kv_offset`` — sink contributions arrive when the shard holding
+    the sequence head rotates in) and, on the contiguous schedule,
+    packed-sequence segment ids (1D global ids; each device slices its
+    Q shard's ids, and each ring step slices the arriving KV shard's
+    ids from the replicated vector — cheaper than rotating them).
     """
     if mesh is None:
         mesh = default_mesh(axis_name)
     n_dev = mesh.shape[axis_name]
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if schedule not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring schedule {schedule!r}")
+    segmented = q_segment_ids is not None
+    if segmented != (kv_segment_ids is not None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
+    if schedule == "zigzag":
+        if not causal:
+            raise ValueError(
+                "zigzag schedule only helps causal attention (non-causal "
+                "ring work is already balanced); use schedule='contiguous'"
+            )
+        if segmented:
+            raise ValueError(
+                "segment ids are supported on the contiguous ring "
+                "schedule (zigzag reorders the sequence; combine packed "
+                "segments with schedule='contiguous')"
+            )
+        return _zigzag_ring(q, k, v, mesh=mesh, axis_name=axis_name,
+                            scale=scale, block_sizes=block_sizes,
+                            softcap=softcap, window=window, sinks=sinks)
 
     m = q.shape[-2]
     n = k.shape[-2]
@@ -86,14 +126,28 @@ def ring_attention(
     # so after step t device j holds shard (j - t) mod R
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
+    in_specs = [seq_spec, seq_spec, seq_spec]
+    extra = []
+    if segmented:
+        q_seg = jnp.asarray(q_segment_ids, jnp.int32)
+        kv_seg = jnp.asarray(kv_segment_ids, jnp.int32)
+        if m_pad != m:
+            q_seg = jnp.pad(q_seg, (0, m_pad - m), constant_values=-1)
+        if n_pad != n:
+            kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
+        # Q ids sharded with Q; KV ids replicated — each step slices the
+        # arriving shard's ids instead of rotating a second buffer
+        extra = [q_seg, kv_seg]
+        in_specs += [P(axis_name), P()]
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         check_vma=False,
-        in_specs=(seq_spec, seq_spec, seq_spec),
+        in_specs=tuple(in_specs),
         out_specs=seq_spec,
     )
-    def run(q_local, k_local, v_local):
+    def run(q_local, k_local, v_local, *seg_local):
         idx = lax.axis_index(axis_name)
         out_shape = q_local.shape[:-1] + (v_local.shape[-1],)
         acc = jnp.zeros(out_shape, jnp.float32)
@@ -112,6 +166,14 @@ def ring_attention(
                 v_next = lax.ppermute(v_cur, axis_name, perm)
             shard = (idx - t) % n_dev  # which global KV shard we hold now
             kv_valid = jnp.clip(n - shard * n_local, 0, n_local)
+            seg_kw = {}
+            if seg_local:
+                seg_kw = {
+                    "q_segment_ids": seg_local[0],
+                    "kv_segment_ids": lax.dynamic_slice(
+                        seg_local[1], (shard * n_local,), (n_local,)
+                    ),
+                }
             out_un, lmax, lsum = flash_attention_partials(
                 q_local,
                 k_cur,
@@ -123,21 +185,172 @@ def ring_attention(
                 kv_offset=shard * n_local,
                 kv_valid=kv_valid,
                 softcap=softcap,
+                window=window,
+                sinks=sinks,
+                **seg_kw,
             )
             # online merge across ring steps (rmax/rsum recurrence,
             # attention-mpi.c:179-181)
-            m_new = jnp.maximum(m_run, lmax)
-            c_old = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
-            c_new = jnp.where(lmax == NEG_INF, 0.0, jnp.exp(lmax - m_new))
-            acc = acc * c_old[..., None] + out_un * c_new[..., None]
-            l_run = l_run * c_old + lsum * c_new
-            m_run = m_new
+            acc, m_run, l_run = _merge_step(
+                (acc, m_run, l_run), out_un, lmax, lsum
+            )
             if t + 1 < n_dev:
                 k_cur, v_cur = k_next, v_next
         l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
         return (acc / l_safe[..., None]).astype(q_local.dtype)
 
-    out = run(q, k, v)
+    out = run(q, k, v, *extra)
     if m_pad != m:
+        out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
+    return out
+
+
+def _merge_step(state, out_un, lmax, lsum):
+    """Online merge of one partials call into a running (acc, m, l)
+    state — the rmax/rsum recurrence (`attention-mpi.c:179-181`) applied
+    across ring steps; fully-masked calls arrive as lmax=-inf no-ops."""
+    acc, m_run, l_run = state
+    m_new = jnp.maximum(m_run, lmax)
+    c_old = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_new))
+    c_new = jnp.where(lmax == NEG_INF, 0.0, jnp.exp(lmax - m_new))
+    return (
+        acc * c_old[..., None] + out_un * c_new[..., None],
+        m_new,
+        l_run * c_old + lsum * c_new,
+    )
+
+
+def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
+                 window=None, sinks=None):
+    """Causal ring attention with the llama-3-style zigzag layout.
+
+    The sequence is split into 2R chunks; device d owns chunks
+    (d, 2R-1-d) — one early, one late.  Per ring step each device then
+    carries EXACTLY 2·C² causal score work (C = chunk rows): the early
+    chunk's missing future work is exactly compensated by the late
+    chunk's surplus past work, for every (device, step) pair — the
+    per-step analog of the reference's ±1-row owner balance
+    (`attention-mpi.c:19-27`).  The contiguous schedule instead gives
+    device d at step t either a full, empty, or diagonal shard: device
+    R-1 does ~R times the per-step work of device 0, and every step's
+    merge waits on the slowest device.
+
+    Of the four (q chunk x kv chunk) pairs per step, (q_lo, kv_hi) is
+    empty BY CONSTRUCTION (kv chunk 2R-1-e is always in q chunk d's
+    future) and is skipped at trace time; the kernel's dynamic causal
+    guard skips the tiles of whichever of (q_lo, kv_lo)/(q_hi, kv_hi)
+    is empty at this step.
+    """
+    n_dev = mesh.shape[axis_name]
+    m = q.shape[-2]
+    n = k.shape[-2]
+    if m != n:
+        raise ValueError(
+            f"zigzag ring is self-attention-shaped (m == n), got {m} != {n}"
+        )
+    seq_axis = q.ndim - 2
+    n_chunks = 2 * n_dev
+    c_pad = -(-n // n_chunks) * n_chunks
+    if c_pad != n:
+        pad = [(0, 0)] * (q.ndim - 2) + [(0, c_pad - n), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    chunk = c_pad // n_chunks
+
+    # zigzag permutation: device d's contiguous 2-chunk slice holds
+    # global chunks (d, 2R-1-d); built as a static numpy gather index
+    import numpy as np
+
+    order = []
+    for d in range(n_dev):
+        order += [d, n_chunks - 1 - d]
+    idx = np.concatenate(
+        [np.arange(c * chunk, (c + 1) * chunk) for c in order]
+    )
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(idx.size)
+    idx_j = jnp.asarray(idx)
+    q_z = jnp.take(q, idx_j, axis=seq_axis)
+    k_z = jnp.take(k, idx_j, axis=seq_axis)
+    v_z = jnp.take(v, idx_j, axis=seq_axis)
+
+    seq_spec = P(*([None] * seq_axis), axis_name, None)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def chunk_valid(cid):
+        # valid rows of global chunk cid (padding lives in the tail)
+        return jnp.clip(n - cid * chunk, 0, chunk)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+    )
+    def run(q_local, k_local, v_local):
+        idx_d = lax.axis_index(axis_name)
+        a = idx_d  # early chunk id
+        b = n_chunks - 1 - idx_d  # late chunk id
+        sl_lo = [slice(None)] * (q_local.ndim - 2) + [slice(0, chunk)]
+        sl_hi = [slice(None)] * (q_local.ndim - 2) + [slice(chunk, None)]
+        q_lo, q_hi = q_local[tuple(sl_lo)], q_local[tuple(sl_hi)]
+
+        def fresh(q_c):
+            shape = q_c.shape[:-1]
+            return (
+                jnp.zeros(shape + (v_local.shape[-1],), jnp.float32),
+                jnp.full(shape, NEG_INF, jnp.float32),
+                jnp.zeros(shape, jnp.float32),
+            )
+
+        lo = fresh(q_lo)
+        hi = fresh(q_hi)
+
+        def partial_call(q_c, k_c, v_c, q_cid, kv_cid):
+            return flash_attention_partials(
+                q_c, k_c, v_c, scale=scale, block_sizes=block_sizes,
+                causal=True,
+                q_offset=q_cid * chunk,
+                kv_offset=kv_cid * chunk,
+                kv_valid=chunk_valid(kv_cid),
+                softcap=softcap,
+                window=window,
+                sinks=sinks,
+            )
+
+        k_cur, v_cur = k_local, v_local
+        for t in range(n_dev):
+            if t + 1 < n_dev:
+                k_next = lax.ppermute(k_cur, axis_name, perm)
+                v_next = lax.ppermute(v_cur, axis_name, perm)
+            e = (idx_d - t) % n_dev  # whose KV pair we hold now
+            ae = e
+            be = n_chunks - 1 - e
+            k_lo, k_hi = k_cur[tuple(sl_lo)], k_cur[tuple(sl_hi)]
+            v_lo, v_hi = v_cur[tuple(sl_lo)], v_cur[tuple(sl_hi)]
+            # (q_hi, kv_lo): always fully unmasked (b > ae)
+            hi = _merge_step(hi, *partial_call(q_hi, k_lo, v_lo, b, ae))
+            # (q_lo, kv_lo): nonempty iff ae <= a — dynamic kernel skip
+            lo = _merge_step(lo, *partial_call(q_lo, k_lo, v_lo, a, ae))
+            # (q_hi, kv_hi): nonempty iff be <= b — dynamic kernel skip
+            hi = _merge_step(hi, *partial_call(q_hi, k_hi, v_hi, b, be))
+            # (q_lo, kv_hi): empty by construction — skipped at trace time
+            if t + 1 < n_dev:
+                k_cur, v_cur = k_next, v_next
+
+        def finalize(state):
+            acc, _, l_run = state
+            l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+            return (acc / l_safe[..., None]).astype(q_local.dtype)
+
+        return jnp.concatenate(
+            [finalize(lo), finalize(hi)], axis=seq_axis
+        )
+
+    out = run(q_z, k_z, v_z)
+    out = jnp.take(out, jnp.asarray(inv), axis=seq_axis)
+    if c_pad != n:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
     return out

@@ -32,7 +32,7 @@ from attention_tpu.parallel.mesh import default_mesh
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
-                     "softcap"),
+                     "softcap", "window", "sinks"),
 )
 def ulysses_attention(
     q: jax.Array,
@@ -45,6 +45,10 @@ def ulysses_attention(
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
     softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> jax.Array:
     """All-to-all sequence-parallel attention for multi-head inputs.
 
@@ -52,6 +56,15 @@ def ulysses_attention(
     ``axis_name`` on the way in and out.  Requires the Q head count to be
     a multiple of the mesh size and sequence lengths to be multiples of
     the mesh size.
+
+    Carries the single-device kernel's full masking surface (the
+    reference's orchestrator supports its kernel's entire surface,
+    `attention-mpi.c:191-407`): ``window``/``sinks`` (sliding window +
+    StreamingLLM sinks) and packed-sequence segment ids.  After the
+    head/seq all-to-all each device holds the FULL sequence for its
+    head subset, so the absolute-position features apply unchanged;
+    segment ids ((m,)/(n,) global, 3D inputs only — the kernel's
+    limit) ride into the shard_map as replicated closures.
     """
     if mesh is None:
         mesh = default_mesh(axis_name)
@@ -105,7 +118,8 @@ def ulysses_attention(
         vh = lax.all_to_all(v_local, axis_name, head_axis, seq_axis, tiled=True)
         out = flash_attention(
             qh, kh, vh, scale=scale, block_sizes=block_sizes, causal=causal,
-            softcap=softcap,
+            softcap=softcap, window=window, sinks=sinks,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
         )
         # head-sharded -> seq-sharded
         return lax.all_to_all(out, axis_name, seq_axis, head_axis, tiled=True)

@@ -1,0 +1,127 @@
+"""Per-device compute balance of the causal ring: contiguous vs zigzag.
+
+Round-2 VERDICT missing #2: the contiguous causal ring is load-
+imbalanced (device R-1 carries ~R times device 0's per-step unmasked
+work; every step's merge waits on the slowest device).  The zigzag
+schedule (`parallel/ring.py::_zigzag_ring`) balances every (device,
+step) pair by construction.
+
+Evidence (the VERDICT's "done" bar): on the virtual 8-device CPU mesh
+at a 131k-analog causal shape, per-device busy time from the device
+trace — merged union of compute intervals per device thread — must be
+within ~10% (max/min) for zigzag, vs the large spread of contiguous.
+Also oracle-checks both schedules against the single-device kernel.
+"""
+
+from __future__ import annotations
+
+import gzip
+import glob
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _latest_trace(log_dir: str) -> str:
+    paths = glob.glob(
+        os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    return max(paths, key=os.path.getmtime)
+
+
+def _events(path: str, min_us: float = 100.0):
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    lanes = {}
+    out = []
+    for e in data.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+    for e in data.get("traceEvents", []):
+        if e.get("ph") == "X" and e.get("dur", 0) >= min_us:
+            e["lane"] = lanes.get((e.get("pid"), e.get("tid")), "")
+            out.append(e)
+    return out
+
+
+def _busy_per_tid(events) -> dict:
+    """Merged-union busy milliseconds per thread (compute events only)."""
+    spans_by_tid = {}
+    for e in events:
+        if not e["name"].startswith(("while", "wrapped_", "fusion", "jit_")):
+            continue
+        spans_by_tid.setdefault(e["tid"], []).append(
+            (e["ts"], e["ts"] + e["dur"])
+        )
+    busy = {}
+    for tid, spans in spans_by_tid.items():
+        spans.sort()
+        tot = 0.0
+        cur_lo, cur_hi = spans[0]
+        for lo, hi in spans[1:]:
+            if lo > cur_hi:
+                tot += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        tot += cur_hi - cur_lo
+        busy[tid] = tot / 1e3
+    return busy
+
+
+def main() -> int:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--dim", type=int, default=64)
+    args = p.parse_args()
+
+    from __graft_entry__ import _force_cpu_mesh
+
+    jax = _force_cpu_mesh(8)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from attention_tpu.ops.flash import flash_attention
+    from attention_tpu.parallel.mesh import default_mesh
+    from attention_tpu.parallel.ring import ring_attention
+    from attention_tpu.utils.profiling import trace
+
+    mesh = default_mesh("sp")
+    q = jax.random.normal(jax.random.PRNGKey(0), (args.seq, args.dim),
+                          jnp.float32)
+    ref = np.asarray(flash_attention(q, q, q, causal=True))
+
+    results = {}
+    for schedule in ("contiguous", "zigzag"):
+        f = jax.jit(
+            lambda x: ring_attention(
+                x, x, x, mesh=mesh, axis_name="sp", causal=True,
+                schedule=schedule,
+            )
+        )
+        out = jax.block_until_ready(f(q))
+        err = float(np.max(np.abs(np.asarray(out) - ref)))
+        log = f"/tmp/ring_balance_{schedule}"
+        shutil.rmtree(log, ignore_errors=True)
+        with trace(log):
+            jax.block_until_ready(f(q))
+        busy = _busy_per_tid(_events(_latest_trace(log)))
+        # keep the 8 busiest threads (the device workers; runtime/helper
+        # threads are far below them)
+        top = sorted(busy.values(), reverse=True)[:8]
+        results[schedule] = {
+            "oracle_max_abs_err": round(err, 6),
+            "per_device_busy_ms": [round(x, 1) for x in top],
+            "max_over_min": round(top[0] / top[-1], 3) if top else None,
+        }
+        print(json.dumps({schedule: results[schedule]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
