@@ -542,18 +542,26 @@ class GQASelfAttention(nn.Module):
                 "PagedKV supports single-token decode steps; prefill on "
                 "a dense KVCache, then ops.paged.paged_from_dense"
             )
-        if self.rope and self.attn_sinks and self.window is not None:
-            raise ValueError(
-                "rope + attn_sinks decode needs the in-cache sink "
-                "re-rotation, which cannot be applied to pool pages "
-                "(they may be prefix-shared across sequences) — use the "
-                "bf16 KVCache or the rolling cache"
-            )
         cache = paged_append(cache, k, v)
-        out = paged_flash_decode(
-            q[:, :, 0, :], cache, softcap=self.softcap,
-            window=self.window, sinks=self.attn_sinks or None,
-        )[:, :, None, :]
+        if self.rope and self.attn_sinks and self.window is not None:
+            # in-cache sink re-rotation can't touch pool pages (they may
+            # be prefix-shared across sequences with different deltas);
+            # paged_sink_decode instead rotates a per-sequence READ COPY
+            # of the sink rows and merges it with the window band — the
+            # int8 cache's sink_read_rotation pattern applied at page
+            # read
+            from attention_tpu.ops.paged import paged_sink_decode
+
+            out = paged_sink_decode(
+                q[:, :, 0, :], cache, window=self.window,
+                sinks=self.attn_sinks, theta=self.rope_theta,
+                softcap=self.softcap,
+            )[:, :, None, :]
+        else:
+            out = paged_flash_decode(
+                q[:, :, 0, :], cache, softcap=self.softcap,
+                window=self.window, sinks=self.attn_sinks or None,
+            )[:, :, None, :]
         return out.astype(q.dtype), cache
 
     def _quantized_decode(self, q, k, v, cache: QuantKVCache):

@@ -37,12 +37,14 @@ from attention_tpu.ops.decode import (
     check_band,
 )
 from attention_tpu.ops.flash import (
+    _LN2,
     _LOG2E,
     _STAT_LANES,
     NEG_INF,
     _ceil_to,
     _compiler_params,
     _flash_tile,
+    _no_stat_kernel,
     _should_interpret,
     check_softcap,
 )
@@ -135,7 +137,8 @@ class PagePool:
 
 
 def _paged_kernel(
-    lens_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
+    lens_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+    acc_scr, m_scr, l_scr,
     *, hkv: int, page: int, softcap2,
     window: int | None = None, sinks: int | None = None,
 ):
@@ -143,7 +146,11 @@ def _paged_kernel(
 
     ``window``/``sinks``: the same per-sequence [len-w, len) band +
     pinned sink rows as the dense decode kernels — logical positions,
-    applied before page translation."""
+    applied before page translation.  With stat out-refs present the
+    kernel emits the unnormalized (contrib, row_max, row_sum) partials
+    triple (natural-log domain) instead of normalizing — the merge hook
+    for composing the paged band with out-of-band contributions
+    (`paged_sink_decode`)."""
     bh = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
@@ -174,13 +181,19 @@ def _paged_kernel(
     @pl.when(j == num_j - 1)
     def _finalize():
         l = jnp.max(l_scr[...], axis=-1, keepdims=True)
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        if m_out_ref is not None:
+            o_ref[0] = acc_scr[...].astype(o_ref.dtype)
+            m_out_ref[0] = m_scr[...] * _LN2
+            l_out_ref[0] = l_scr[...]
+        else:
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "interpret", "softcap", "window", "sinks"),
+    static_argnames=("scale", "interpret", "softcap", "window", "sinks",
+                     "return_stats"),
 )
 def paged_flash_decode(
     q: jax.Array,       # (B, H, d)
@@ -191,6 +204,7 @@ def paged_flash_decode(
     softcap: float | None = None,
     window: int | None = None,
     sinks: int | None = None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """softmax(q K[:len]^T * scale) V[:len] through the page table.
 
@@ -242,6 +256,34 @@ def paged_flash_decode(
         # stay in bounds.
         return (jnp.maximum(tbl_ref[bi, jj], 0), bh % hkv, 0, 0)
 
+    out_specs = [
+        pl.BlockSpec((1, group_pad, dv), lambda bh, j, lr, tr: (bh, 0, 0))
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct(
+            (b * hkv, group_pad, dv),
+            jnp.float32 if return_stats else cache.v_pool.dtype,
+        )
+    ]
+    kernel = functools.partial(
+        _paged_kernel, hkv=hkv, page=page,
+        softcap2=None if softcap is None else softcap * _LOG2E,
+        window=window, sinks=sinks,
+    )
+    if return_stats:
+        stat_spec = pl.BlockSpec(
+            (1, group_pad, _STAT_LANES), lambda bh, j, lr, tr: (bh, 0, 0)
+        )
+        stat_shape = jax.ShapeDtypeStruct(
+            (b * hkv, group_pad, _STAT_LANES), jnp.float32
+        )
+        out_specs += [stat_spec, stat_spec]
+        out_shapes += [stat_shape, stat_shape]
+    else:
+        # flash.py's splice-None shim works verbatim here: args =
+        # (lens, tbl, q, k, v, o, acc, m, l) -> (..., o, None, None, ...)
+        kernel = functools.partial(_no_stat_kernel, kernel)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b * hkv, max_pages),
@@ -251,9 +293,7 @@ def paged_flash_decode(
             pl.BlockSpec((1, 1, page, d), kv_index),
             pl.BlockSpec((1, 1, page, dv), kv_index),
         ],
-        out_specs=pl.BlockSpec(
-            (1, group_pad, dv), lambda bh, j, lr, tr: (bh, 0, 0)
-        ),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((group_pad, dv), jnp.float32),
             pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
@@ -261,16 +301,10 @@ def paged_flash_decode(
         ],
     )
 
-    out = pl.pallas_call(
-        functools.partial(
-            _paged_kernel, hkv=hkv, page=page,
-            softcap2=None if softcap is None else softcap * _LOG2E,
-            window=window, sinks=sinks,
-        ),
+    outs = pl.pallas_call(
+        kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (b * hkv, group_pad, dv), cache.v_pool.dtype
-        ),
+        out_shape=out_shapes,
         compiler_params=_compiler_params(("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=2 * b * h * max_pages * page * (d + dv),
@@ -280,11 +314,112 @@ def paged_flash_decode(
         ),
         interpret=interpret,
     )(lens, cache.page_table, qs, cache.k_pool, cache.v_pool)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
 
-    out = out[:, :group].reshape(b, h, dv)
+    out = outs[0][:, :group].reshape(b, h, dv)
+    if return_stats:
+        row_max = outs[1][:, :group, 0].reshape(b, h)
+        row_sum = outs[2][:, :group, 0].reshape(b, h)
+        return out, row_max, row_sum
     # poisoned sequences (negative length, set by a bad append) are NaN
     return jnp.where(lens_raw[:, None, None] < 0, jnp.nan,
                      out.astype(jnp.float32)).astype(out.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "sinks", "theta", "scale", "softcap",
+                     "interpret"),
+)
+def paged_sink_decode(
+    q: jax.Array,       # (B, H, d)
+    cache: PagedKV,
+    *,
+    window: int,
+    sinks: int,
+    theta: float = 10000.0,
+    scale: float | None = None,
+    softcap: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Windowed rope+sinks decode through the page table.
+
+    The blocker this removes: StreamingLLM's in-cache sink positions
+    need the sink KEY rows re-rotated by a per-sequence delta, but pool
+    pages may be prefix-shared across sequences with different lengths —
+    rotating in place would corrupt other readers.  The int8 cache's
+    answer (`quant.sink_read_rotation`) is a per-sequence READ COPY of
+    just the sink rows; here that copy is a gather of each sequence's
+    first logical page's ``sinks`` rows into a tiny dense tensor
+    (shared pages stay read-only), rotated by that sequence's own
+    ``delta = max(len - (window + sinks), 0)``.
+
+    Composition: the paged kernel computes the window band's partials
+    (band rows [max(len-w,0), len) — out-of-band pages never DMA), the
+    rotated sink sliver's partials are a few fp32 einsums over
+    ``sinks`` rows, and the two merge with the standard online-softmax
+    rescale.  Overlap cannot double-count: sink rows inside the band
+    (only possible while delta == 0, where rotation is a no-op) are
+    masked OUT of the sliver (col < min(sinks, len - w)).
+    """
+    from attention_tpu.ops.rope import apply_rope
+
+    check_band(window, sinks)
+    if sinks is None or window is None:
+        raise ValueError("paged_sink_decode requires window and sinks")
+    page = cache.page_size
+    if sinks > page:
+        raise ValueError(
+            f"sinks {sinks} > page_size {page}: sink rows must fit the "
+            "first logical page"
+        )
+    b, h, d = q.shape
+    hkv = cache.k_pool.shape[1]
+    group = h // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    # A: window-band partials through the page table (natural-log stats)
+    out_a, m_a, l_a = paged_flash_decode(
+        q, cache, scale=scale, softcap=softcap, window=window,
+        interpret=interpret, return_stats=True,
+    )
+
+    # B: per-sequence read copy of the sink rows, rotated to in-cache
+    # positions (the quant.sink_read_rotation pattern at page read)
+    lens_raw = jnp.broadcast_to(jnp.asarray(cache.lengths, jnp.int32), (b,))
+    lens = jnp.maximum(lens_raw, 0)
+    first_phys = jnp.maximum(cache.page_table[:, 0], 0)  # (B,)
+    k_sink = cache.k_pool[first_phys, :, :sinks].astype(jnp.float32)
+    v_sink = cache.v_pool[first_phys, :, :sinks].astype(jnp.float32)
+    delta = jnp.maximum(lens - (window + sinks), 0)
+    k_rot = apply_rope(k_sink, delta[:, None, None], theta)
+    if group > 1:
+        k_rot = jnp.repeat(k_rot, group, axis=1)
+        v_sink = jnp.repeat(v_sink, group, axis=1)
+    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32), k_rot) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+    kv_min = jnp.maximum(lens - window, 0)
+    lim = jnp.minimum(jnp.minimum(sinks, kv_min), lens)  # (B,)
+    mask = jnp.arange(sinks)[None, None, :] < lim[:, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m_b = jnp.max(s, axis=-1)  # (B, H)
+    p = jnp.where(m_b[..., None] == NEG_INF, 0.0, jnp.exp(s - m_b[..., None]))
+    l_b = jnp.sum(p, axis=-1)
+    out_b = jnp.einsum("bhs,bhsd->bhd", p, v_sink)
+
+    # online merge of the two partial softmaxes
+    m = jnp.maximum(m_a, m_b)
+    c_a = jnp.where(m_a == NEG_INF, 0.0, jnp.exp(m_a - m))
+    c_b = jnp.where(m_b == NEG_INF, 0.0, jnp.exp(m_b - m))
+    l = l_a * c_a + l_b * c_b
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (out_a.astype(jnp.float32) * c_a[..., None]
+           + out_b * c_b[..., None]) / l_safe[..., None]
+    out = jnp.where(lens_raw[:, None, None] < 0, jnp.nan, out)
+    return out.astype(cache.v_pool.dtype)
 
 
 def paged_append(cache: PagedKV, k_new: jax.Array,

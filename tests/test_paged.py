@@ -306,3 +306,56 @@ def test_generate_paged_windowed_matches_ragged(rng):
     toks, _caches, _pools = generate_paged(model, params, prompt, lengths,
                                            steps=24)
     np.testing.assert_array_equal(a, np.asarray(toks))
+
+
+def test_paged_sink_decode_matches_dense_rotated(rng):
+    """rope+sinks on the paged cache (the round-2 exclusion, removed):
+    `paged_sink_decode`'s per-sequence sink read-copy + band merge must
+    equal the dense path — flash_decode over a cache whose sink keys
+    were re-rotated by `_sink_read_keys` (the bf16 convention)."""
+    from attention_tpu.models.attention_layer import _sink_read_keys
+    from attention_tpu.ops.paged import paged_sink_decode
+
+    b, hkv, h, d, cap = 3, 2, 4, 32, 512
+    w, s, theta = 16, 2, 10000.0
+    # mixed regimes: delta>0 (rotation live), delta==0 (band covers
+    # sinks), tiny prefix
+    lens = jnp.asarray([300, 17, 6], jnp.int32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, cap, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, cap, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+
+    kr = _sink_read_keys(kc, lens, w, s, theta)
+    want = np.asarray(flash_decode(q, kr, vc, lens, window=w, sinks=s,
+                                   block_k=128))
+
+    pool = PagePool(num_pages=16)
+    cache = paged_from_dense(kc, vc, lens, pool, num_pages=16)
+    got = np.asarray(paged_sink_decode(q, cache, window=w, sinks=s,
+                                       theta=theta))
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-5)
+
+
+def test_generate_paged_rope_sinks_matches_ragged(rng):
+    """End to end: the rope+window+sinks model generates identically on
+    the paged cache and the ragged dense cache — the last cell of the
+    cache x feature matrix (round-2 VERDICT #5)."""
+    from attention_tpu.models.decode import generate_paged, generate_ragged
+
+    model = TinyDecoder(vocab=43, dim=64, depth=2, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32,
+                        window=16, attn_sinks=2, rope=True)
+    lengths = np.asarray([12, 5, 9], np.int32)
+    prompt = np.random.default_rng(0).integers(1, 43, (3, 12)).astype(np.int32)
+    for i, ln in enumerate(lengths):
+        prompt[i, ln:] = 0
+    prompt = jnp.asarray(prompt)
+    lengths = jnp.asarray(lengths)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    # steps chosen so total tokens pass window+sinks (the rotation
+    # actually engages: 12 + 24 = 36 > 18)
+    a = np.asarray(generate_ragged(model, params, prompt, lengths,
+                                   steps=24))
+    toks, _caches, _pools = generate_paged(model, params, prompt, lengths,
+                                           steps=24)
+    np.testing.assert_array_equal(a, np.asarray(toks))

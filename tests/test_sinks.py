@@ -323,3 +323,29 @@ def test_sinks_partials_match_full_on_shards(rng):
             m_run = m_new
     got = acc / np.where(l_run == 0.0, 1.0, l_run)[..., None]
     np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-2)
+
+
+def test_sinks_rope_chunked_append_pins_absolute_semantics(rng):
+    """Chunked (s_new > 1) cached appends on a rope+sinks windowed model
+    INTENTIONALLY keep absolute sink rotations (the per-query in-cache
+    shift is non-uniform across a chunk, so the single-token read-time
+    re-rotation does not apply).  This pins that documented semantics:
+    flash and xla cached paths must agree with each other on a chunked
+    append that lands past sinks + window — both using absolute
+    positions — so the behavior is a contract, not an accident."""
+    kw = dict(vocab=31, dim=32, depth=1, num_q_heads=4, num_kv_heads=2,
+              dtype=jnp.float32, window=32, attn_sinks=4, rope=True)
+    model = TinyDecoder(impl="flash", **kw)
+    xmodel = TinyDecoder(impl="xla", **kw)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 48)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    # prefill 45 tokens, then a 3-token chunked append: total 48 > 36
+    full = model.init_caches(batch=2, capacity=64)
+    xfull = model.init_caches(batch=2, capacity=64)
+    _, full = model.apply({"params": params}, tokens[:, :45], full)
+    _, xfull = xmodel.apply({"params": params}, tokens[:, :45], xfull)
+    lf, _ = model.apply({"params": params}, tokens[:, 45:], full)
+    lx, _ = xmodel.apply({"params": params}, tokens[:, 45:], xfull)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lx),
+                               atol=2e-4, rtol=1e-3)
