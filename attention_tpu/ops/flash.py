@@ -98,6 +98,12 @@ class BlockSizes(NamedTuple):
                 return cls(512, 512)
             if returns_stats:
                 return cls(1024, 1024)
+            if heads >= 8:
+                # many-head interleaved sweep (scripts/gqa_sweep.py,
+                # RESULTS.md round 2): 1024x2048 measured best at
+                # 32q/4kv seq=16k (27.6-28.0 ms vs 27.9-28.0 for
+                # 2048x1024 and 29.1-31.4 for the old 256x1024)
+                return cls(1024, 2048)
             return cls(2048, 1024)
         return cls()
 

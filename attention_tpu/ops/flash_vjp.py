@@ -47,13 +47,13 @@ def _gqa_expand(k, group):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
+                   nondiff_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16))
 def _flash_diff(q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, scale,
                 causal, block_sizes, bwd_chunk, bwd_impl, window, softcap,
-                sinks):
+                sinks, max_mode):
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
                              q_seg, kv_seg, window, softcap, sinks,
-                             q_off, kv_off, kv_val)
+                             q_off, kv_off, kv_val, max_mode)
     return out
 
 
@@ -69,12 +69,14 @@ def _seg_zeros(seg):
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
                     kv_seg=None, window=None, softcap=None, sinks=None,
-                    q_off=None, kv_off=None, kv_val=None):
+                    q_off=None, kv_off=None, kv_val=None,
+                    max_mode="online"):
     out_un, row_max, row_sum = flash_attention_partials(
         q, k, v, scale=scale, causal=causal, block_sizes=block_sizes,
         q_segment_ids=q_seg, kv_segment_ids=kv_seg, window=window,
         softcap=softcap, sinks=sinks,
         q_offset=q_off, kv_offset=kv_off, kv_valid=kv_val,
+        max_mode=max_mode,
     )
     l_safe = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = (out_un / l_safe[..., None]).astype(q.dtype)
@@ -86,15 +88,15 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_sizes, q_seg=None,
 
 def _flash_diff_fwd(q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, scale,
                     causal, block_sizes, bwd_chunk, bwd_impl, window,
-                    softcap, sinks):
+                    softcap, sinks, max_mode):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_sizes,
                                q_seg, kv_seg, window, softcap, sinks,
-                               q_off, kv_off, kv_val)
+                               q_off, kv_off, kv_val, max_mode)
     return out, (q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, out, lse)
 
 
 def _flash_diff_bwd(scale, causal, block_sizes, bwd_chunk, bwd_impl,
-                    window, softcap, sinks, res, dout):
+                    window, softcap, sinks, max_mode, res, dout):
     q, k, v, q_seg, kv_seg, q_off, kv_off, kv_val, out, lse = res
     seg_cots = (_seg_zeros(q_seg), _seg_zeros(kv_seg),
                 _seg_zeros(q_off), _seg_zeros(kv_off), _seg_zeros(kv_val))
@@ -230,6 +232,7 @@ def flash_attention_diff(
     q_offset=None,
     kv_offset=None,
     kv_valid=None,
+    max_mode: str = "online",
 ) -> jax.Array:
     """Differentiable fused attention; same shape contract as
     :func:`attention_tpu.ops.flash.flash_attention` (2D/3D/4D, GQA).
@@ -275,11 +278,12 @@ def flash_attention_diff(
     if q.ndim == 2:
         return _flash_diff(
             q[None], k[None], v[None], qseg, kvseg, *offs, scale, causal,
-            bs, bwd_chunk, bwd_impl, window, softcap, sinks,
+            bs, bwd_chunk, bwd_impl, window, softcap, sinks, max_mode,
         )[0]
     if q.ndim == 3:
         return _flash_diff(q, k, v, qseg, kvseg, *offs, scale, causal, bs,
-                           bwd_chunk, bwd_impl, window, softcap, sinks)
+                           bwd_chunk, bwd_impl, window, softcap, sinks,
+                           max_mode)
     if q.ndim == 4:
         b, hq, m, d = q.shape
         kf = k.reshape(b * k.shape[1], *k.shape[2:])
@@ -287,6 +291,7 @@ def flash_attention_diff(
         out = _flash_diff(
             q.reshape(b * hq, m, d), kf, vf, None, None, *offs, scale,
             causal, bs, bwd_chunk, bwd_impl, window, softcap, sinks,
+            max_mode,
         )
         return out.reshape(b, hq, m, -1)
     raise ValueError(f"unsupported rank {q.ndim}")

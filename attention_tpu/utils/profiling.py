@@ -84,15 +84,22 @@ def device_module_seconds(log_dir: str) -> dict[str, float] | None:
     paths = sorted(glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz"))
     if not paths:
         return None
-    data = _json.load(gzip.open(paths[-1]))
-    lanes = {}
-    for e in data["traceEvents"]:
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            lanes[(e["pid"], e["tid"])] = e["args"]["name"]
-    per_module: dict[str, float] = {}
-    for e in data["traceEvents"]:
-        if (e.get("ph") == "X"
-                and lanes.get((e.get("pid"), e.get("tid"))) == "XLA Modules"):
-            key = e["name"].split("(")[0]
-            per_module[key] = per_module.get(key, 0.0) + e["dur"] / 1e6
+    try:
+        data = _json.load(gzip.open(paths[-1]))
+        lanes = {}
+        for e in data["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+        per_module: dict[str, float] = {}
+        for e in data["traceEvents"]:
+            if (e.get("ph") == "X"
+                    and lanes.get((e.get("pid"), e.get("tid")))
+                    == "XLA Modules"):
+                key = e["name"].split("(")[0]
+                per_module[key] = per_module.get(key, 0.0) + e["dur"] / 1e6
+    except (ValueError, KeyError, EOFError, OSError):
+        # a truncated/partial capture (interrupted profiler) must read
+        # as "no device lane" so benchmark_auto's slope fallback engages
+        # rather than aborting the whole benchmark
+        return None
     return per_module or None
