@@ -158,8 +158,12 @@ def _xla_mha(q, k, v, *, causal, window=None, softcap=None, sinks=0):
 
 
 def _flash_mha(q, k, v, *, causal, window=None, softcap=None, sinks=0):
+    # max_mode="bound": the library's fastest exact kernel (same output
+    # and lse as the online recurrence — tests/test_ops.py pins it;
+    # 0.92-0.97 vs 0.78-0.82 MXU util, scripts/max_mode_exp.py)
     return flash_attention_diff(q, k, v, causal=causal, window=window,
-                                softcap=softcap, sinks=sinks or None)
+                                softcap=softcap, sinks=sinks or None,
+                                max_mode="bound")
 
 
 def _sink_read_keys(kc, new_total, window, sinks, theta):
@@ -242,12 +246,16 @@ class GQASelfAttention(nn.Module):
     rope_theta: float = 10000.0
     softcap: float | None = None  # logit soft-capping (Gemma-2 style)
     # Context parallelism: when set (training under a mesh whose
-    # ``cp_axis`` shards the sequence), batch attention runs the
-    # differentiable CP composition `parallel.cp.cp_flash_attention` —
-    # the Pallas flash custom VJP under shard_map — instead of a
-    # single-device kernel call.  Requires ``impl='flash'``; ``mesh``
-    # must be the training mesh.  Decode/cached paths are unaffected.
+    # ``cp_axis`` shards the sequence), batch attention runs a
+    # differentiable CP composition — the Pallas flash custom VJP under
+    # shard_map — instead of a single-device kernel call.  Requires
+    # ``impl='flash'``; ``mesh`` must be the training mesh.
+    # ``cp_impl``: "allgather" (`parallel.cp`, KV gathered per device —
+    # the default training layout) or "ring" (`parallel.ring.
+    # ring_attention_diff`, O(n/R) KV memory in both passes — the
+    # long-context composition).  Decode/cached paths are unaffected.
     cp_axis: str | None = None
+    cp_impl: str = "allgather"
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
@@ -308,13 +316,29 @@ class GQASelfAttention(nn.Module):
             )
         if cache is None:
             if self.cp_axis is not None:
-                from attention_tpu.parallel.cp import cp_flash_attention
+                if self.cp_impl == "ring":
+                    from attention_tpu.parallel.ring import (
+                        ring_attention_diff,
+                    )
 
-                out = cp_flash_attention(
-                    q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
-                    causal=self.causal, window=self.window,
-                    softcap=self.softcap,
-                )
+                    out = ring_attention_diff(
+                        q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
+                        causal=self.causal, window=self.window,
+                        softcap=self.softcap,
+                    )
+                elif self.cp_impl == "allgather":
+                    from attention_tpu.parallel.cp import cp_flash_attention
+
+                    out = cp_flash_attention(
+                        q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
+                        causal=self.causal, window=self.window,
+                        softcap=self.softcap,
+                    )
+                else:
+                    raise ValueError(
+                        f"unknown cp_impl {self.cp_impl!r} "
+                        "(supported: ['allgather', 'ring'])"
+                    )
             else:
                 out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,
                                             window=self.window,

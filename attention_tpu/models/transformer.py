@@ -49,6 +49,7 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     ep_axis: str | None = None
     cp_axis: str | None = None  # context-parallel attention (needs mesh)
+    cp_impl: str = "allgather"  # or "ring" (O(n/R) KV memory)
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
@@ -67,6 +68,7 @@ class TransformerBlock(nn.Module):
             rope_theta=self.rope_theta,
             softcap=self.softcap,
             cp_axis=self.cp_axis,
+            cp_impl=self.cp_impl,
             mesh=self.mesh,
         )(y, cache)
         if cache is not None:
@@ -118,10 +120,12 @@ class TinyDecoder(nn.Module):
     # `parallel.cp`).  This is what makes the SHARDED train step execute
     # the framework's own kernels rather than XLA's auto-SPMD einsums.
     cp_axis: str | None = None
+    cp_impl: str = "allgather"  # or "ring"
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, caches=None):  # (B, S) int32
+    def __call__(self, tokens: jax.Array, caches=None,
+                 return_hidden: bool = False):  # (B, S) int32
         head_dim = self.dim // self.num_q_heads
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
         new_caches = []
@@ -149,6 +153,7 @@ class TinyDecoder(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 ep_axis=self.ep_axis,
                 cp_axis=self.cp_axis,
+                cp_impl=self.cp_impl,
                 mesh=self.mesh,
                 name=f"TransformerBlock_{i}",
             )
@@ -158,7 +163,15 @@ class TinyDecoder(nn.Module):
                 x, c = block(x, caches[i])
                 new_caches.append(c)
         x = nn.RMSNorm(dtype=self.dtype)(x)
+        if return_hidden:
+            # pre-head activations for memory-bounded losses (chunked
+            # cross-entropy re-projects per chunk instead of
+            # materializing the (B, S, vocab) logits); the head params
+            # still initialize below so the tree is call-invariant
+            hidden = x
         logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32)(x)
+        if return_hidden:
+            return hidden if caches is None else (hidden, tuple(new_caches))
         return logits if caches is None else (logits, tuple(new_caches))
 
     def init_caches(self, batch: int, capacity: int,

@@ -6,7 +6,10 @@ from attention_tpu.parallel.mesh import (  # noqa: F401
 from attention_tpu.parallel.cp import cp_flash_attention  # noqa: F401
 from attention_tpu.parallel.kv_sharded import kv_sharded_attention  # noqa: F401
 from attention_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
-from attention_tpu.parallel.ring import ring_attention  # noqa: F401
+from attention_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    ring_attention_diff,
+)
 from attention_tpu.parallel.serving import (  # noqa: F401
     cache_sharded_decode,
     head_sharded_decode,

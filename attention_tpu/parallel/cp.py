@@ -69,6 +69,7 @@ def cp_flash_attention(
     softcap: float | None = None,
     block_sizes: BlockSizes | None = None,
     bwd_impl: str = "pallas",
+    max_mode: str = "bound",
 ) -> jax.Array:
     """Context-parallel fused attention, differentiable end to end.
 
@@ -131,6 +132,7 @@ def cp_flash_attention(
             kv_valid=n if n_pad != n else None,
             window=window, softcap=softcap,
             block_sizes=block_sizes, bwd_impl=bwd_impl,
+            max_mode=max_mode,
         )
 
     out = run(q, k, v)

@@ -25,6 +25,7 @@ The reference's ping-pong discipline lives on in two forms:
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -140,6 +141,12 @@ def ring_attention(
         extra = [q_seg, kv_seg]
         in_specs += [P(axis_name), P()]
 
+    run_cfg = _RingCfg(
+        axis_name=axis_name, n_dev=n_dev, n=n, m_local=m_local,
+        n_local=n_local, scale=scale, block_sizes=block_sizes,
+        causal=causal, softcap=softcap, window=window, sinks=sinks,
+    )
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
@@ -148,61 +155,250 @@ def ring_attention(
         out_specs=seq_spec,
     )
     def run(q_local, k_local, v_local, *seg_local):
-        idx = lax.axis_index(axis_name)
-        out_shape = q_local.shape[:-1] + (v_local.shape[-1],)
-        acc = jnp.zeros(out_shape, jnp.float32)
-        m_run = jnp.full(q_local.shape[:-1], NEG_INF, jnp.float32)
-        l_run = jnp.zeros(q_local.shape[:-1], jnp.float32)
-
-        # Unrolled ring schedule (n_dev is static and small): step t computes
-        # on the shard currently held and — except on the last step, which
-        # needs no further rotation — first issues the ppermute for step
-        # t+1 so XLA overlaps the collective with the flash call (no data
-        # dependency between them).
-        k_cur, v_cur = k_local, v_local
-        for t in range(n_dev):
-            if t + 1 < n_dev:
-                k_next = lax.ppermute(k_cur, axis_name, perm)
-                v_next = lax.ppermute(v_cur, axis_name, perm)
-            shard = (idx - t) % n_dev  # which global KV shard we hold now
-            kv_valid = jnp.clip(n - shard * n_local, 0, n_local)
-            seg_kw = {}
-            if seg_local:
-                seg_kw = {
-                    "q_segment_ids": seg_local[0],
-                    "kv_segment_ids": lax.dynamic_slice(
-                        seg_local[1], (shard * n_local,), (n_local,)
-                    ),
-                }
-            out_un, lmax, lsum = flash_attention_partials(
-                q_local,
-                k_cur,
-                v_cur,
-                scale=scale,
-                block_sizes=block_sizes,
-                causal=causal,
-                q_offset=idx * m_local,
-                kv_offset=shard * n_local,
-                kv_valid=kv_valid,
-                softcap=softcap,
-                window=window,
-                sinks=sinks,
-                **seg_kw,
-            )
-            # online merge across ring steps (rmax/rsum recurrence,
-            # attention-mpi.c:179-181)
-            acc, m_run, l_run = _merge_step(
-                (acc, m_run, l_run), out_un, lmax, lsum
-            )
-            if t + 1 < n_dev:
-                k_cur, v_cur = k_next, v_next
-        l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
-        return (acc / l_safe[..., None]).astype(q_local.dtype)
+        # one shared copy of the rotate/merge schedule (also the
+        # custom-VJP forward): see _ring_fwd_loop
+        out, _ = _ring_fwd_loop(
+            q_local, k_local, v_local, run_cfg,
+            seg=tuple(seg_local) if seg_local else None,
+        )
+        return out
 
     out = run(q, k, v, *extra)
     if m_pad != m:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
     return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "batch_axis", "head_axis",
+                     "scale", "block_sizes", "causal", "softcap", "window"),
+)
+def ring_attention_diff(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "sp",
+    batch_axis: str | None = "dp",
+    head_axis: str | None = "tp",
+    scale: float | None = None,
+    block_sizes: BlockSizes | None = None,
+    causal: bool = False,
+    softcap: float | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """Differentiable ring attention: O(n/R) KV memory per device in
+    BOTH passes.
+
+    The all-gather CP path (`parallel/cp.py`) is the default training
+    composition but holds the full K/V per device; this is the
+    long-context alternative where even K/V exceed one device.  The
+    forward is the contiguous ring (online merge of rotating-shard
+    partials, saving the per-row lse); the custom backward runs a
+    second ring in which dK/dV accumulators TRAVEL WITH their shard —
+    each step calls the offset-aware Pallas backward kernels
+    (`flash_backward(q_offset=, kv_offset=, kv_valid=)`) on the local Q
+    block against the visiting shard, and a final rotation delivers
+    each shard's gradients home.  Ring traffic doubles in the backward
+    (k, v, dk, dv rotate together) — the standard ring-attention
+    gradient schedule.
+
+    Shapes: (h, m, d) or (b, h, m, d), GQA supported; sequence axes
+    sharded over ``axis_name``.  ``window`` requires ``causal``.
+    """
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if q.ndim not in (3, 4):
+        raise ValueError(f"ring_attention_diff takes 3D/4D, got {q.ndim}D")
+
+    m = q.shape[-2]
+    n = k.shape[-2]
+    m_pad = -(-m // n_dev) * n_dev
+    n_pad = -(-n // n_dev) * n_dev
+    if m_pad != m:
+        q = jnp.pad(q, [(0, 0)] * (q.ndim - 2) + [(0, m_pad - m), (0, 0)])
+    if n_pad != n:
+        pad = [(0, 0)] * (k.ndim - 2) + [(0, n_pad - n), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    m_local = m_pad // n_dev
+    n_local = n_pad // n_dev
+    seq_axis = q.ndim - 2
+    # batch/head axes shard over the rest of the training mesh when
+    # present and divisible (both Q and KV head counts for the head
+    # axis), mirroring parallel/cp.py — the ring itself runs over
+    # ``axis_name`` only
+    from attention_tpu.parallel.cp import _maybe_axis
+
+    h_axis = _maybe_axis(mesh, head_axis, q.shape[-3])
+    if h_axis is not None and k.shape[-3] % mesh.shape[h_axis] != 0:
+        h_axis = None
+    if q.ndim == 4:
+        b_axis = _maybe_axis(mesh, batch_axis, q.shape[0])
+        seq_spec = P(b_axis, h_axis, axis_name, None)
+    else:
+        seq_spec = P(h_axis, axis_name, None)
+
+    cfg = dict(
+        axis_name=axis_name, n_dev=n_dev, n=n, m_local=m_local,
+        n_local=n_local, scale=scale, block_sizes=block_sizes,
+        causal=causal, softcap=softcap, window=window,
+    )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+    )
+    def run(q_local, k_local, v_local):
+        if q_local.ndim == 4:
+            # fold batch into heads (grouping per batch stays aligned:
+            # hh // group lands on that batch's kv head)
+            b, h, mm, d = q_local.shape
+            bk, hkv, nn, dk_ = k_local.shape
+            out = _ring_diff(
+                q_local.reshape(b * h, mm, d),
+                k_local.reshape(bk * hkv, nn, dk_),
+                v_local.reshape(bk * hkv, nn, v_local.shape[-1]),
+                _RingCfg(**cfg),
+            )
+            return out.reshape(b, h, mm, -1)
+        return _ring_diff(q_local, k_local, v_local, _RingCfg(**cfg))
+
+    out = run(q, k, v)
+    if m_pad != m:
+        out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
+    return out
+
+
+class _RingCfg(NamedTuple):
+    axis_name: str
+    n_dev: int
+    n: int
+    m_local: int
+    n_local: int
+    scale: float
+    block_sizes: "BlockSizes | None"
+    causal: bool
+    softcap: "float | None"
+    window: "int | None"
+    sinks: "int | None" = None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ring_diff(q, k, v, cfg: _RingCfg):
+    out, _ = _ring_diff_fwd_impl(q, k, v, cfg)
+    return out
+
+
+def _ring_fwd_loop(q, k, v, cfg: _RingCfg, seg=None):
+    """Contiguous ring forward on LOCAL blocks — THE one copy of the
+    rotate/merge schedule, shared by `ring_attention` (which discards
+    the lse) and the custom-VJP path (which saves it).  ``seg`` is an
+    optional (q_ids_local, kv_ids_full) pair; each step slices the
+    arriving shard's KV ids from the replicated vector.  Returns
+    (normalized out, natural-log lse)."""
+    idx = lax.axis_index(cfg.axis_name)
+    perm = [(j, (j + 1) % cfg.n_dev) for j in range(cfg.n_dev)]
+    acc = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
+    m_run = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l_run = jnp.zeros(q.shape[:-1], jnp.float32)
+    k_cur, v_cur = k, v
+    for t in range(cfg.n_dev):
+        # prefetch-then-rotate: the next shard's ppermute is issued
+        # before this step's compute so XLA overlaps them
+        if t + 1 < cfg.n_dev:
+            k_next = lax.ppermute(k_cur, cfg.axis_name, perm)
+            v_next = lax.ppermute(v_cur, cfg.axis_name, perm)
+        shard = (idx - t) % cfg.n_dev
+        seg_kw = {}
+        if seg is not None:
+            seg_kw = {
+                "q_segment_ids": seg[0],
+                "kv_segment_ids": lax.dynamic_slice(
+                    seg[1], (shard * cfg.n_local,), (cfg.n_local,)
+                ),
+            }
+        out_un, lmax, lsum = flash_attention_partials(
+            q, k_cur, v_cur, scale=cfg.scale, block_sizes=cfg.block_sizes,
+            causal=cfg.causal, q_offset=idx * cfg.m_local,
+            kv_offset=shard * cfg.n_local,
+            kv_valid=jnp.clip(cfg.n - shard * cfg.n_local, 0, cfg.n_local),
+            softcap=cfg.softcap, window=cfg.window, sinks=cfg.sinks,
+            **seg_kw,
+        )
+        acc, m_run, l_run = _merge_step((acc, m_run, l_run),
+                                        out_un, lmax, lsum)
+        if t + 1 < cfg.n_dev:
+            k_cur, v_cur = k_next, v_next
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    lse = jnp.where(l_run == 0.0, NEG_INF, m_run + jnp.log(l_safe))
+    return out, lse
+
+
+def _ring_diff_fwd_impl(q, k, v, cfg: _RingCfg):
+    out, lse = _ring_fwd_loop(q, k, v, cfg)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_diff_fwd(q, k, v, cfg: _RingCfg):
+    out, res = _ring_diff_fwd_impl(q, k, v, cfg)
+    return out, res
+
+
+def _ring_diff_bwd(cfg: _RingCfg, res, dout):
+    from attention_tpu.ops.flash import _should_interpret
+    from attention_tpu.ops.flash_bwd import flash_backward
+
+    q, k, v, out, lse = res
+    idx = lax.axis_index(cfg.axis_name)
+    perm = [(j, (j + 1) % cfg.n_dev) for j in range(cfg.n_dev)]
+    interpret = _should_interpret()
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_cur = jnp.zeros(k.shape, jnp.float32)
+    dv_cur = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    for t in range(cfg.n_dev):
+        if t + 1 < cfg.n_dev:
+            k_next = lax.ppermute(k_cur, cfg.axis_name, perm)
+            v_next = lax.ppermute(v_cur, cfg.axis_name, perm)
+        shard = (idx - t) % cfg.n_dev
+        dq_i, dk_i, dv_i = flash_backward(
+            q, k_cur, v_cur, out, lse, dout,
+            scale=cfg.scale, causal=cfg.causal,
+            block_sizes=None,  # backward keeps its own tuned defaults
+            interpret=interpret, window=cfg.window, softcap=cfg.softcap,
+            q_offset=idx * cfg.m_local,
+            kv_offset=shard * cfg.n_local,
+            kv_valid=jnp.clip(cfg.n - shard * cfg.n_local, 0, cfg.n_local),
+        )
+        dq = dq + dq_i.astype(jnp.float32)
+        # accumulate into the buffer of the shard CURRENTLY resident,
+        # THEN rotate it together with the shard (add-before-rotate:
+        # the arriving buffer belongs to the NEXT shard)
+        dk_cur = dk_cur + dk_i.astype(jnp.float32)
+        dv_cur = dv_cur + dv_i.astype(jnp.float32)
+        if t + 1 < cfg.n_dev:
+            dk_cur = lax.ppermute(dk_cur, cfg.axis_name, perm)
+            dv_cur = lax.ppermute(dv_cur, cfg.axis_name, perm)
+            k_cur, v_cur = k_next, v_next
+    # after R-1 rotations shard s sits at device (s-1) mod R; one more
+    # rotation delivers each shard's accumulated gradients home
+    dk_home = lax.ppermute(dk_cur, cfg.axis_name, perm)
+    dv_home = lax.ppermute(dv_cur, cfg.axis_name, perm)
+    return (dq.astype(q.dtype), dk_home.astype(k.dtype),
+            dv_home.astype(v.dtype))
+
+
+_ring_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)
 
 
 def _merge_step(state, out_un, lmax, lsum):

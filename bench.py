@@ -272,21 +272,35 @@ def _calib_load() -> dict:
         return {}
 
 
+# Only shapes whose serial run is genuinely expensive get calibrated:
+# sub-second measurements are all timer noise (and a rounded-to-0.0
+# record would permanently zero the denominator, since _calib_put only
+# ever lowers values), and cheap shapes are simply re-measured live.
+CALIB_MIN_SEQ = 16384
+CALIB_MIN_SECONDS = 1.0
+
+
 def _calib_get(target_seq: int, dim: int):
     """This host's recorded idle-CPU serial seconds, or None."""
     rec = _calib_load().get(_host_key(), {}).get(f"{target_seq}x{dim}")
-    return None if rec is None else float(rec["seconds"])
+    if rec is None:
+        return None
+    seconds = float(rec["seconds"])
+    return seconds if seconds >= CALIB_MIN_SECONDS else None
 
 
 def _calib_put(target_seq: int, dim: int, seconds: float) -> None:
     """Record min(new, existing) — the calibration is the idle minimum;
-    a loaded-machine measurement must never raise it."""
+    a loaded-machine measurement must never raise it.  Cheap shapes and
+    implausibly small readings are not recorded at all."""
+    if target_seq < CALIB_MIN_SEQ or seconds < CALIB_MIN_SECONDS:
+        return
     data = _calib_load()
     host = data.setdefault(_host_key(), {})
     key = f"{target_seq}x{dim}"
     prev = host.get(key)
     if prev is None or seconds < float(prev["seconds"]):
-        host[key] = {"seconds": round(seconds, 1),
+        host[key] = {"seconds": seconds,
                      "recorded": time.strftime("%Y-%m-%d")}
         try:
             with open(CALIB_PATH, "w") as f:

@@ -49,6 +49,20 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gammas", type=str, default="4,8,12")
     ap.add_argument("--sanity", action="store_true")
+    ap.add_argument(
+        "--prompt-len", type=int, default=4096,
+        help="context length at which decoding starts; 32768 puts the "
+        "target step in the bandwidth-bound regime (per-step cost "
+        "dominated by KV-cache reads, amortized gamma-fold by the "
+        "verify pass) — round-2 VERDICT's proposed honest win regime",
+    )
+    ap.add_argument(
+        "--draft-window", type=int, default=None,
+        help="sliding-window attention for the DRAFT model: its decode "
+        "step reads only the window band, so draft cost stays flat "
+        "while the target pays the full long-cache read",
+    )
+    ap.add_argument("--steps", type=int, default=128)
     args = ap.parse_args()
 
     import jax
@@ -72,7 +86,8 @@ def main() -> int:
     target = TinyDecoder(vocab=V, dim=512, depth=2, num_q_heads=8,
                          num_kv_heads=2, impl="flash")
     draft = TinyDecoder(vocab=V, dim=128, depth=1, num_q_heads=4,
-                        num_kv_heads=2, impl="flash")
+                        num_kv_heads=2, impl="flash",
+                        window=args.draft_window)
 
     def train(model, key, steps=250):
         toks = make_batch(16, 64)
@@ -103,8 +118,8 @@ def main() -> int:
     print(json.dumps({"target_loss": round(tl, 5),
                       "draft_loss": round(dl, 5)}))
 
-    prompt = make_batch(1, 4096)
-    steps = 128
+    prompt = make_batch(1, args.prompt_len)
+    steps = args.steps
 
     configs = {"plain": lambda: generate(target, tp, prompt, steps=steps)}
     for gamma in (int(g) for g in args.gammas.split(",")):

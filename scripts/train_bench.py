@@ -35,6 +35,14 @@ def main() -> int:
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--steps-per-trace", type=int, default=4)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize block activations (jax.checkpoint)"
+                   " — the HBM-for-FLOPs trade that fits seq=32768")
+    p.add_argument("--loss", choices=("full", "chunked"), default="full",
+                   help="'chunked' re-projects the lm head per sequence "
+                   "chunk under jax.checkpoint instead of materializing "
+                   "the (B, S, vocab) fp32 logits")
+    p.add_argument("--ce-chunk", type=int, default=2048)
     args = p.parse_args()
 
     import jax
@@ -49,7 +57,7 @@ def main() -> int:
     model = TinyDecoder(
         vocab=args.vocab, dim=args.dim, depth=args.depth,
         num_q_heads=args.q_heads, num_kv_heads=args.kv_heads,
-        impl="flash", dtype=jnp.bfloat16,
+        impl="flash", dtype=jnp.bfloat16, remat=args.remat,
     )
     toks = jnp.asarray(
         np.random.default_rng(0).integers(0, args.vocab,
@@ -67,13 +75,43 @@ def main() -> int:
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, toks):
-        def loss(p):
+        def loss_full(p):
             logits = model.apply({"params": p}, toks[:, :-1])
             lp = jax.nn.log_softmax(logits.astype(jnp.float32))
             return -jnp.mean(
                 jnp.take_along_axis(lp, toks[:, 1:, None], -1)
             )
 
+        def loss_chunked(p):
+            # (B, S, D) pre-head hidden; per-chunk head matmul + CE
+            # under jax.checkpoint so the backward recomputes each
+            # chunk's logits instead of saving the full (S, vocab) set
+            hid = model.apply({"params": p}, toks[:, :-1],
+                              return_hidden=True)
+            w = p["Dense_0"]["kernel"]
+            tgt = toks[:, 1:]
+            b_, s_, d_ = hid.shape
+            c = min(args.ce_chunk, s_)
+            if s_ % c:
+                raise ValueError(f"seq {s_} not divisible by chunk {c}")
+            hidc = hid.reshape(b_, s_ // c, c, d_).transpose(1, 0, 2, 3)
+            tgtc = tgt.reshape(b_, s_ // c, c).transpose(1, 0, 2)
+
+            @jax.checkpoint
+            def one(carry, xs):
+                h, t = xs
+                logits = jnp.einsum(
+                    "bcd,dv->bcv", h.astype(jnp.float32),
+                    w.astype(jnp.float32),
+                )
+                lp = jax.nn.log_softmax(logits)
+                tok_lp = jnp.take_along_axis(lp, t[..., None], -1)
+                return carry + jnp.sum(tok_lp), None
+
+            tot, _ = jax.lax.scan(one, jnp.float32(0.0), (hidc, tgtc))
+            return -tot / (b_ * s_)
+
+        loss = loss_chunked if args.loss == "chunked" else loss_full
         l, g = jax.value_and_grad(loss)(params)
         up, opt_state = opt.update(g, opt_state, params)
         return optax.apply_updates(params, up), opt_state, l

@@ -111,12 +111,13 @@ def test_bench_cli_smoke():
 
 def test_blocksizes_for_shape_rules():
     """The measured tile lookup: 2048x1024 for unwindowed long d<=128
-    shapes, 512x512 for windowed ones, general default elsewhere;
-    explicit block_sizes= always wins (callers pass it through)."""
+    few-head shapes, 1024x2048 for many-head (>=8, per the gqa_sweep),
+    512x512 for windowed ones, general default elsewhere; explicit
+    block_sizes= always wins (callers pass it through)."""
     from attention_tpu.ops.flash import BlockSizes
 
     assert BlockSizes.for_shape(1, 8192, 128) == BlockSizes(2048, 1024)
-    assert BlockSizes.for_shape(32, 16384, 128) == BlockSizes(2048, 1024)
+    assert BlockSizes.for_shape(32, 16384, 128) == BlockSizes(1024, 2048)
     assert BlockSizes.for_shape(1, 32768, 128, window=1024) == \
         BlockSizes(512, 512)
     assert BlockSizes.for_shape(1, 4096, 128) == BlockSizes()
@@ -153,7 +154,7 @@ def test_blocksizes_stats_and_backward_defaults():
 
     assert BlockSizes.for_shape(16, 8192, 128, returns_stats=True) == \
         BlockSizes(1024, 1024)
-    assert BlockSizes.for_shape(16, 8192, 128) == BlockSizes(2048, 1024)
+    assert BlockSizes.for_shape(16, 8192, 128) == BlockSizes(1024, 2048)
     assert default_bwd_block_sizes(128, jnp.bfloat16, None) == \
         BlockSizes(1024, 1024)
     assert default_bwd_block_sizes(128, jnp.float32, None) == \
