@@ -274,10 +274,10 @@ class GQASelfAttention(nn.Module):
                 )
             if self.mesh is None:
                 raise ValueError("cp_axis requires mesh=")
-            if self.attn_sinks:
+            if self.attn_sinks and self.cp_impl == "ring":
                 raise ValueError(
-                    "attention sinks are not yet plumbed through the "
-                    "context-parallel path"
+                    "attention sinks need the full KV resident (absolute "
+                    "positions); use cp_impl='allgather' for sink models"
                 )
         dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
             features=(heads, self.head_dim),
@@ -332,6 +332,7 @@ class GQASelfAttention(nn.Module):
                     out = cp_flash_attention(
                         q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
                         causal=self.causal, window=self.window,
+                        sinks=self.attn_sinks or None,
                         softcap=self.softcap,
                     )
                 else:

@@ -290,7 +290,8 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[...]
 
 
-def _sink_patch(q, k, v, out, lse, dout, *, scale, window, sinks, softcap):
+def _sink_patch(q, k, v, out, lse, dout, *, scale, window, sinks, softcap,
+                q_offset=None, kv_valid=None):
     """Gradient contributions of sink pairs OUTSIDE the window band.
 
     The visible set of a windowed+sinks forward partitions exactly into
@@ -302,6 +303,11 @@ def _sink_patch(q, k, v, out, lse, dout, *, scale, window, sinks, softcap):
     so each pair is counted once with the forward's probabilities.  The
     sliver is (m x sinks<=window start) — O(m·sinks·d) FLOPs, a few
     fused XLA einsums; no Pallas variant needed.
+
+    ``q_offset`` (dynamic) gives the global position of local Q row 0 —
+    sinks under context parallelism, where the caller holds a Q shard
+    against full local KV (kv_offset must be 0: sink rows are absolute
+    positions of THIS call's KV); ``kv_valid`` masks a padded KV tail.
     """
     h, m, d = q.shape
     hkv, n, dv = v.shape
@@ -321,8 +327,11 @@ def _sink_patch(q, k, v, out, lse, dout, *, scale, window, sinks, softcap):
         s = softcap * t
         dcap = 1.0 - t * t
     lse32 = lse.astype(jnp.float32)[..., None]
-    mask = (jnp.arange(se)[None, :]
-            < jnp.arange(m)[:, None] - (window - 1))[None]
+    rows = jnp.arange(m) + (0 if q_offset is None else q_offset)
+    mask = (jnp.arange(se)[None, :] < rows[:, None] - (window - 1))[None]
+    if kv_valid is not None:
+        mask = jnp.logical_and(mask,
+                               (jnp.arange(se) < kv_valid)[None, None, :])
     mask = jnp.logical_and(mask, lse32 != NEG_INF)
     p = jnp.where(mask, jnp.exp(s - jnp.where(mask, lse32, 0.0)), 0.0)
     dp = jnp.einsum("hme,hse->hms", do32, vx.astype(jnp.float32))
@@ -396,11 +405,11 @@ def flash_backward(
     orchestrated distribution, `attention-mpi.c:191-407`).  ``sinks``
     pins ABSOLUTE positions and is not supported together with offsets.
     """
-    if sinks is not None and (q_offset is not None or kv_offset is not None
-                              or kv_valid is not None):
+    if sinks is not None and kv_offset is not None:
         raise ValueError(
-            "sinks do not compose with q_offset/kv_offset/kv_valid "
-            "(sink positions are absolute)"
+            "sinks do not compose with kv_offset (sink positions are "
+            "absolute positions of THIS call's KV rows — a shifted KV "
+            "shard cannot contain them); q_offset/kv_valid are fine"
         )
     segmented = q_segment_ids is not None
     if segmented != (kv_segment_ids is not None):
@@ -660,6 +669,7 @@ def flash_backward(
         dq_s, dk_s, dv_s, se = _sink_patch(
             q, k[:, :n], v[:, :n], out, lse, dout,
             scale=scale, window=window, sinks=sinks, softcap=softcap,
+            q_offset=q_offset, kv_valid=kv_valid,
         )
         dq = (dq.astype(jnp.float32) + dq_s).astype(q.dtype)
         dk = dk.at[:, :se].add(dk_s)

@@ -255,11 +255,11 @@ def flash_attention_diff(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if bwd_impl not in ("pallas", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
-    if sinks is not None and (q_offset is not None or kv_offset is not None
-                              or kv_valid is not None):
+    if sinks is not None and kv_offset is not None:
         raise ValueError(
-            "sinks do not compose with q_offset/kv_offset/kv_valid "
-            "(sink positions are absolute)"
+            "sinks do not compose with kv_offset (sink positions are "
+            "absolute positions of THIS call's KV rows); q_offset and "
+            "kv_valid compose fine — the context-parallel case"
         )
     # None flows through: the forward resolves it via
     # BlockSizes.for_shape(returns_stats=True) and flash_backward via

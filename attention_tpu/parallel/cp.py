@@ -66,6 +66,7 @@ def cp_flash_attention(
     scale: float | None = None,
     causal: bool = True,
     window: int | None = None,
+    sinks: int | None = None,
     softcap: float | None = None,
     block_sizes: BlockSizes | None = None,
     bwd_impl: str = "pallas",
@@ -80,7 +81,10 @@ def cp_flash_attention(
     to be used).  Returns attention output sharded exactly like Q.
 
     GQA is supported (KV heads dividing Q heads); ``window`` needs
-    ``causal=True``; sinks/segments are not yet plumbed through CP.
+    ``causal=True``; ``sinks`` compose too (the gathered KV holds the
+    absolute sink positions, so only q_offset awareness is needed —
+    including the backward's sink sliver).  Packed-sequence segment ids
+    are the one remaining unplumbed feature on this path.
     """
     if axis_name not in mesh.axis_names:
         raise ValueError(f"mesh {mesh.axis_names} has no axis {axis_name!r}")
@@ -130,7 +134,7 @@ def cp_flash_attention(
             scale=scale, causal=causal,
             q_offset=idx * m_local,
             kv_valid=n if n_pad != n else None,
-            window=window, softcap=softcap,
+            window=window, sinks=sinks, softcap=softcap,
             block_sizes=block_sizes, bwd_impl=bwd_impl,
             max_mode=max_mode,
         )

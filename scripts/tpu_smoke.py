@@ -127,6 +127,42 @@ def _():
     return got, _dense(q, k, v, causal=True, window=160, sinks=4)
 
 
+@case("fwd/bound-max causal")
+def _():
+    q, k, v = _arr(4, 384, 64), _arr(4, 384, 64), _arr(4, 384, 64)
+    got = flash_attention(q, k, v, causal=True, max_mode="bound")
+    return got, _dense(q, k, v, causal=True)
+
+
+@case("fwd/bound-max gqa+softcap")
+def _():
+    q, k, v = _arr(8, 256, 64), _arr(2, 256, 64), _arr(2, 256, 64)
+    got = flash_attention(q, k, v, causal=True, softcap=12.0,
+                          max_mode="bound")
+    return got, _dense(q, k, v, causal=True, softcap=12.0)
+
+
+@case("fwd/bound-max window+sinks")
+def _():
+    q, k, v = _arr(2, 512, 64), _arr(2, 512, 64), _arr(2, 512, 64)
+    got = flash_attention(q, k, v, causal=True, window=160, sinks=4,
+                          max_mode="bound")
+    return got, _dense(q, k, v, causal=True, window=160, sinks=4)
+
+
+@case("fwd/bound-max offsets (q_offset + kv_valid)")
+def _():
+    q, k, v = _arr(2, 128, 64), _arr(2, 384, 64), _arr(2, 384, 64)
+    got = flash_attention(q, k, v, causal=True, q_offset=192,
+                          kv_valid=320, max_mode="bound")
+    return got, _dense(q, k, v, causal=True, q_offset=192, kv_valid=320)
+
+
+@case("bwd/bound-max forward in the VJP")
+def _():
+    return _grad_case(max_mode="bound")
+
+
 @case("fwd/softcap")
 def _():
     q, k, v = _arr(2, 256, 64), _arr(2, 256, 64), _arr(2, 256, 64)
