@@ -68,6 +68,8 @@ def cp_flash_attention(
     window: int | None = None,
     sinks: int | None = None,
     softcap: float | None = None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
     block_sizes: BlockSizes | None = None,
     bwd_impl: str = "pallas",
     max_mode: str = "bound",
@@ -84,7 +86,8 @@ def cp_flash_attention(
     ``causal=True``; ``sinks`` compose too (the gathered KV holds the
     absolute sink positions, so only q_offset awareness is needed —
     including the backward's sink sliver).  Packed-sequence segment ids
-    are the one remaining unplumbed feature on this path.
+    ((m,)/(n,) global int32; 3D inputs only — the kernel's segment
+    limit) shard with Q and replicate with the gathered KV.
     """
     if axis_name not in mesh.axis_names:
         raise ValueError(f"mesh {mesh.axis_names} has no axis {axis_name!r}")
@@ -116,14 +119,35 @@ def cp_flash_attention(
         spec = P(h_axis, axis_name, None)
     seq_axis = q.ndim - 2
 
+    segmented = q_segment_ids is not None
+    if segmented != (kv_segment_ids is not None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
+    if segmented and q.ndim == 4:
+        raise ValueError(
+            "segment ids support 3D inputs (ids shared across heads)"
+        )
+    in_specs = [spec, spec, spec]
+    extra = []
+    if segmented:
+        q_seg = jnp.asarray(q_segment_ids, jnp.int32)
+        kv_seg = jnp.asarray(kv_segment_ids, jnp.int32)
+        if m_pad != m:
+            q_seg = jnp.pad(q_seg, (0, m_pad - m), constant_values=-1)
+        if n_pad != n:
+            kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
+        # Q ids shard with Q rows; KV ids replicate (the gathered KV is
+        # the full sequence on every device)
+        extra = [q_seg, kv_seg]
+        in_specs += [P(axis_name), P()]
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         check_vma=False,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(in_specs),
         out_specs=spec,
     )
-    def run(q_local, k_local, v_local):
+    def run(q_local, k_local, v_local, *seg_local):
         idx = lax.axis_index(axis_name)
         k_full = lax.all_gather(k_local, axis_name, axis=seq_axis,
                                 tiled=True)
@@ -135,11 +159,13 @@ def cp_flash_attention(
             q_offset=idx * m_local,
             kv_valid=n if n_pad != n else None,
             window=window, sinks=sinks, softcap=softcap,
+            q_segment_ids=seg_local[0] if seg_local else None,
+            kv_segment_ids=seg_local[1] if seg_local else None,
             block_sizes=block_sizes, bwd_impl=bwd_impl,
             max_mode=max_mode,
         )
 
-    out = run(q, k, v)
+    out = run(q, k, v, *extra)
     if m_pad != m:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
     return out
