@@ -251,9 +251,11 @@ class GQASelfAttention(nn.Module):
     # shard_map — instead of a single-device kernel call.  Requires
     # ``impl='flash'``; ``mesh`` must be the training mesh.
     # ``cp_impl``: "allgather" (`parallel.cp`, KV gathered per device —
-    # the default training layout) or "ring" (`parallel.ring.
+    # the default training layout), "ring" (`parallel.ring.
     # ring_attention_diff`, O(n/R) KV memory in both passes — the
-    # long-context composition).  Decode/cached paths are unaffected.
+    # long-context composition), or "zigzag" (the ring with llama-3
+    # chunk interleaving: equal per-device work at every step of BOTH
+    # passes for causal models).  Decode/cached paths are unaffected.
     cp_axis: str | None = None
     cp_impl: str = "allgather"
     mesh: "jax.sharding.Mesh | None" = None
@@ -274,7 +276,7 @@ class GQASelfAttention(nn.Module):
                 )
             if self.mesh is None:
                 raise ValueError("cp_axis requires mesh=")
-            if self.attn_sinks and self.cp_impl == "ring":
+            if self.attn_sinks and self.cp_impl != "allgather":
                 raise ValueError(
                     "attention sinks need the full KV resident (absolute "
                     "positions); use cp_impl='allgather' for sink models"
@@ -316,7 +318,7 @@ class GQASelfAttention(nn.Module):
             )
         if cache is None:
             if self.cp_axis is not None:
-                if self.cp_impl == "ring":
+                if self.cp_impl in ("ring", "zigzag"):
                     from attention_tpu.parallel.ring import (
                         ring_attention_diff,
                     )
@@ -325,6 +327,8 @@ class GQASelfAttention(nn.Module):
                         q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
                         causal=self.causal, window=self.window,
                         softcap=self.softcap,
+                        schedule=("zigzag" if self.cp_impl == "zigzag"
+                                  else "contiguous"),
                     )
                 elif self.cp_impl == "allgather":
                     from attention_tpu.parallel.cp import cp_flash_attention
@@ -338,7 +342,7 @@ class GQASelfAttention(nn.Module):
                 else:
                     raise ValueError(
                         f"unknown cp_impl {self.cp_impl!r} "
-                        "(supported: ['allgather', 'ring'])"
+                        "(supported: ['allgather', 'ring', 'zigzag'])"
                     )
             else:
                 out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,

@@ -49,7 +49,7 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     ep_axis: str | None = None
     cp_axis: str | None = None  # context-parallel attention (needs mesh)
-    cp_impl: str = "allgather"  # or "ring" (O(n/R) KV memory)
+    cp_impl: str = "allgather"  # or "ring"/"zigzag" (O(n/R) KV memory)
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact

@@ -172,7 +172,8 @@ def ring_attention(
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "batch_axis", "head_axis",
-                     "scale", "block_sizes", "causal", "softcap", "window"),
+                     "scale", "block_sizes", "causal", "softcap", "window",
+                     "schedule"),
 )
 def ring_attention_diff(
     q: jax.Array,
@@ -188,6 +189,7 @@ def ring_attention_diff(
     causal: bool = False,
     softcap: float | None = None,
     window: int | None = None,
+    schedule: str = "contiguous",
 ) -> jax.Array:
     """Differentiable ring attention: O(n/R) KV memory per device in
     BOTH passes.
@@ -207,6 +209,13 @@ def ring_attention_diff(
 
     Shapes: (h, m, d) or (b, h, m, d), GQA supported; sequence axes
     sharded over ``axis_name``.  ``window`` requires ``causal``.
+
+    ``schedule="zigzag"`` (causal self-attention only) applies the
+    per-step load balance to BOTH passes: each device differentiates
+    its early+late chunk pair, so forward partials and the backward's
+    three chunk-pair kernel calls carry equal work on every device at
+    every step — the training-time answer to the contiguous causal
+    ring's R-fold per-step skew.
     """
     if mesh is None:
         mesh = default_mesh(axis_name)
@@ -215,6 +224,16 @@ def ring_attention_diff(
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if q.ndim not in (3, 4):
         raise ValueError(f"ring_attention_diff takes 3D/4D, got {q.ndim}D")
+    if schedule not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring schedule {schedule!r}")
+    if schedule == "zigzag":
+        if not causal:
+            raise ValueError("zigzag schedule requires causal=True")
+        return _zigzag_ring_diff(
+            q, k, v, mesh=mesh, axis_name=axis_name,
+            batch_axis=batch_axis, head_axis=head_axis, scale=scale,
+            block_sizes=block_sizes, softcap=softcap, window=window,
+        )
 
     m = q.shape[-2]
     n = k.shape[-2]
@@ -416,6 +435,27 @@ def _merge_step(state, out_un, lmax, lsum):
     )
 
 
+def _zig_prepare(q, k, v, n_dev):
+    """Shared zigzag preamble: self-attention shape check + pad the
+    sequence to a 2R-chunk multiple.  Returns (q, k, v, chunk, n, m,
+    c_pad, seq_axis)."""
+    m = q.shape[-2]
+    n = k.shape[-2]
+    if m != n:
+        raise ValueError(
+            f"zigzag ring is self-attention-shaped (m == n), got {m} != {n}"
+        )
+    seq_axis = q.ndim - 2
+    n_chunks = 2 * n_dev
+    c_pad = -(-n // n_chunks) * n_chunks
+    if c_pad != n:
+        pad = [(0, 0)] * (q.ndim - 2) + [(0, c_pad - n), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    return q, k, v, c_pad // n_chunks, n, m, c_pad, seq_axis
+
+
 def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
                  window=None, sinks=None):
     """Causal ring attention with the llama-3-style zigzag layout.
@@ -438,21 +478,8 @@ def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
     is empty at this step.
     """
     n_dev = mesh.shape[axis_name]
-    m = q.shape[-2]
-    n = k.shape[-2]
-    if m != n:
-        raise ValueError(
-            f"zigzag ring is self-attention-shaped (m == n), got {m} != {n}"
-        )
-    seq_axis = q.ndim - 2
+    q, k, v, chunk, n, m, c_pad, seq_axis = _zig_prepare(q, k, v, n_dev)
     n_chunks = 2 * n_dev
-    c_pad = -(-n // n_chunks) * n_chunks
-    if c_pad != n:
-        pad = [(0, 0)] * (q.ndim - 2) + [(0, c_pad - n), (0, 0)]
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
-    chunk = c_pad // n_chunks
 
     # zigzag permutation: device d's contiguous 2-chunk slice holds
     # global chunks (d, 2R-1-d); built as a static numpy gather index
@@ -472,11 +499,12 @@ def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
     v_z = jnp.take(v, idx_j, axis=seq_axis)
 
     seq_spec = P(*([None] * seq_axis), axis_name, None)
-    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
-    def chunk_valid(cid):
-        # valid rows of global chunk cid (padding lives in the tail)
-        return jnp.clip(n - cid * chunk, 0, chunk)
+    zcfg = _ZigCfg(
+        axis_name=axis_name, n_dev=n_dev, n=n, chunk=chunk, scale=scale,
+        block_sizes=block_sizes, softcap=softcap, window=window,
+        sinks=sinks,
+    )
 
     @functools.partial(
         jax.shard_map,
@@ -486,67 +514,289 @@ def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
         out_specs=seq_spec,
     )
     def run(q_local, k_local, v_local):
-        idx_d = lax.axis_index(axis_name)
-        a = idx_d  # early chunk id
-        b = n_chunks - 1 - idx_d  # late chunk id
-        sl_lo = [slice(None)] * (q_local.ndim - 2) + [slice(0, chunk)]
-        sl_hi = [slice(None)] * (q_local.ndim - 2) + [slice(chunk, None)]
-        q_lo, q_hi = q_local[tuple(sl_lo)], q_local[tuple(sl_hi)]
-
-        def fresh(q_c):
-            shape = q_c.shape[:-1]
-            return (
-                jnp.zeros(shape + (v_local.shape[-1],), jnp.float32),
-                jnp.full(shape, NEG_INF, jnp.float32),
-                jnp.zeros(shape, jnp.float32),
-            )
-
-        lo = fresh(q_lo)
-        hi = fresh(q_hi)
-
-        def partial_call(q_c, k_c, v_c, q_cid, kv_cid):
-            return flash_attention_partials(
-                q_c, k_c, v_c, scale=scale, block_sizes=block_sizes,
-                causal=True,
-                q_offset=q_cid * chunk,
-                kv_offset=kv_cid * chunk,
-                kv_valid=chunk_valid(kv_cid),
-                softcap=softcap,
-                window=window,
-                sinks=sinks,
-            )
-
-        k_cur, v_cur = k_local, v_local
-        for t in range(n_dev):
-            if t + 1 < n_dev:
-                k_next = lax.ppermute(k_cur, axis_name, perm)
-                v_next = lax.ppermute(v_cur, axis_name, perm)
-            e = (idx_d - t) % n_dev  # whose KV pair we hold now
-            ae = e
-            be = n_chunks - 1 - e
-            k_lo, k_hi = k_cur[tuple(sl_lo)], k_cur[tuple(sl_hi)]
-            v_lo, v_hi = v_cur[tuple(sl_lo)], v_cur[tuple(sl_hi)]
-            # (q_hi, kv_lo): always fully unmasked (b > ae)
-            hi = _merge_step(hi, *partial_call(q_hi, k_lo, v_lo, b, ae))
-            # (q_lo, kv_lo): nonempty iff ae <= a — dynamic kernel skip
-            lo = _merge_step(lo, *partial_call(q_lo, k_lo, v_lo, a, ae))
-            # (q_hi, kv_hi): nonempty iff be <= b — dynamic kernel skip
-            hi = _merge_step(hi, *partial_call(q_hi, k_hi, v_hi, b, be))
-            # (q_lo, kv_hi): empty by construction — skipped at trace time
-            if t + 1 < n_dev:
-                k_cur, v_cur = k_next, v_next
-
-        def finalize(state):
-            acc, _, l_run = state
-            l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
-            return (acc / l_safe[..., None]).astype(q_local.dtype)
-
-        return jnp.concatenate(
-            [finalize(lo), finalize(hi)], axis=seq_axis
-        )
+        out_lo, _, out_hi, _ = _zig_fwd_loop(q_local, k_local, v_local,
+                                             zcfg)
+        return jnp.concatenate([out_lo, out_hi], axis=seq_axis)
 
     out = run(q_z, k_z, v_z)
     out = jnp.take(out, jnp.asarray(inv), axis=seq_axis)
+    if c_pad != n:
+        out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
+    return out
+
+
+class _ZigCfg(NamedTuple):
+    axis_name: str
+    n_dev: int
+    n: int
+    chunk: int
+    scale: float
+    block_sizes: "BlockSizes | None"
+    softcap: "float | None"
+    window: "int | None"
+    sinks: "int | None" = None
+
+
+def _zig_slices(ndim, chunk):
+    sl_lo = tuple([slice(None)] * (ndim - 2) + [slice(0, chunk)])
+    sl_hi = tuple([slice(None)] * (ndim - 2) + [slice(chunk, None)])
+    return sl_lo, sl_hi
+
+
+def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
+    """The one copy of the zigzag rotate/merge schedule, shared by the
+    plain forward (which discards the lse) and the custom-VJP path.
+    Returns (out_lo, lse_lo, out_hi, lse_hi) for the device's two
+    chunks."""
+    n_chunks = 2 * z.n_dev
+    idx_d = lax.axis_index(z.axis_name)
+    a = idx_d  # early chunk id
+    b = n_chunks - 1 - idx_d  # late chunk id
+    perm = [(j, (j + 1) % z.n_dev) for j in range(z.n_dev)]
+    sl_lo, sl_hi = _zig_slices(q_local.ndim, z.chunk)
+    q_lo, q_hi = q_local[sl_lo], q_local[sl_hi]
+
+    def fresh(q_c):
+        shape = q_c.shape[:-1]
+        return (
+            jnp.zeros(shape + (v_local.shape[-1],), jnp.float32),
+            jnp.full(shape, NEG_INF, jnp.float32),
+            jnp.zeros(shape, jnp.float32),
+        )
+
+    lo = fresh(q_lo)
+    hi = fresh(q_hi)
+
+    def partial_call(q_c, k_c, v_c, q_cid, kv_cid):
+        return flash_attention_partials(
+            q_c, k_c, v_c, scale=z.scale, block_sizes=z.block_sizes,
+            causal=True,
+            q_offset=q_cid * z.chunk,
+            kv_offset=kv_cid * z.chunk,
+            kv_valid=jnp.clip(z.n - kv_cid * z.chunk, 0, z.chunk),
+            softcap=z.softcap,
+            window=z.window,
+            sinks=z.sinks,
+        )
+
+    k_cur, v_cur = k_local, v_local
+    for t in range(z.n_dev):
+        if t + 1 < z.n_dev:
+            k_next = lax.ppermute(k_cur, z.axis_name, perm)
+            v_next = lax.ppermute(v_cur, z.axis_name, perm)
+        e = (idx_d - t) % z.n_dev  # whose KV pair we hold now
+        ae = e
+        be = n_chunks - 1 - e
+        k_lo, k_hi = k_cur[sl_lo], k_cur[sl_hi]
+        v_lo, v_hi = v_cur[sl_lo], v_cur[sl_hi]
+        # (q_hi, kv_lo): always fully unmasked (b > ae)
+        hi = _merge_step(hi, *partial_call(q_hi, k_lo, v_lo, b, ae))
+        # (q_lo, kv_lo): nonempty iff ae <= a — dynamic kernel skip
+        lo = _merge_step(lo, *partial_call(q_lo, k_lo, v_lo, a, ae))
+        # (q_hi, kv_hi): nonempty iff be <= b — dynamic kernel skip
+        hi = _merge_step(hi, *partial_call(q_hi, k_hi, v_hi, b, be))
+        # (q_lo, kv_hi): empty by construction — skipped at trace time
+        if t + 1 < z.n_dev:
+            k_cur, v_cur = k_next, v_next
+
+    def finalize(state, q_c):
+        acc, m_run, l_run = state
+        l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+        out = (acc / l_safe[..., None]).astype(q_c.dtype)
+        lse = jnp.where(l_run == 0.0, NEG_INF, m_run + jnp.log(l_safe))
+        return out, lse
+
+    out_lo, lse_lo = finalize(lo, q_lo)
+    out_hi, lse_hi = finalize(hi, q_hi)
+    return out_lo, lse_lo, out_hi, lse_hi
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _zig_diff(q, k, v, z: _ZigCfg):
+    out_lo, _, out_hi, _ = _zig_fwd_loop(q, k, v, z)
+    return jnp.concatenate([out_lo, out_hi], axis=-2)
+
+
+def _zig_diff_fwd(q, k, v, z: _ZigCfg):
+    out_lo, lse_lo, out_hi, lse_hi = _zig_fwd_loop(q, k, v, z)
+    out = jnp.concatenate([out_lo, out_hi], axis=-2)
+    return out, (q, k, v, out_lo, lse_lo, out_hi, lse_hi)
+
+
+def _zig_diff_bwd(z: _ZigCfg, res, dout):
+    """Backward zigzag ring: the kv-pair gradient buffers travel with
+    their pair (add-before-rotate; one final rotation delivers home),
+    and every (device, step) carries the same 3-call balanced work as
+    the forward — the load-balance property holds in BOTH passes."""
+    from attention_tpu.ops.flash import _should_interpret
+    from attention_tpu.ops.flash_bwd import flash_backward
+
+    q, k, v, out_lo, lse_lo, out_hi, lse_hi = res
+    n_chunks = 2 * z.n_dev
+    idx_d = lax.axis_index(z.axis_name)
+    a = idx_d
+    b = n_chunks - 1 - idx_d
+    perm = [(j, (j + 1) % z.n_dev) for j in range(z.n_dev)]
+    interpret = _should_interpret()
+    sl_lo, sl_hi = _zig_slices(q.ndim, z.chunk)
+    q_lo, q_hi = q[sl_lo], q[sl_hi]
+    dout_lo, dout_hi = dout[sl_lo], dout[sl_hi]
+    dq_lo = jnp.zeros(q_lo.shape, jnp.float32)
+    dq_hi = jnp.zeros(q_hi.shape, jnp.float32)
+    dk_cur = jnp.zeros(k.shape, jnp.float32)
+    dv_cur = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+
+    def bwd_call(q_c, k_c, v_c, out_c, lse_c, dout_c, q_cid, kv_cid):
+        return flash_backward(
+            q_c, k_c, v_c, out_c, lse_c, dout_c,
+            scale=z.scale, causal=True, interpret=interpret,
+            window=z.window, softcap=z.softcap,
+            q_offset=q_cid * z.chunk,
+            kv_offset=kv_cid * z.chunk,
+            kv_valid=jnp.clip(z.n - kv_cid * z.chunk, 0, z.chunk),
+        )
+
+    for t in range(z.n_dev):
+        if t + 1 < z.n_dev:
+            k_next = lax.ppermute(k_cur, z.axis_name, perm)
+            v_next = lax.ppermute(v_cur, z.axis_name, perm)
+        e = (idx_d - t) % z.n_dev
+        ae = e
+        be = n_chunks - 1 - e
+        k_lo, k_hi = k_cur[sl_lo], k_cur[sl_hi]
+        v_lo, v_hi = v_cur[sl_lo], v_cur[sl_hi]
+        # the forward's three chunk-pair calls, differentiated
+        g1q, g1k, g1v = bwd_call(q_hi, k_lo, v_lo, out_hi, lse_hi,
+                                 dout_hi, b, ae)
+        g2q, g2k, g2v = bwd_call(q_lo, k_lo, v_lo, out_lo, lse_lo,
+                                 dout_lo, a, ae)
+        g3q, g3k, g3v = bwd_call(q_hi, k_hi, v_hi, out_hi, lse_hi,
+                                 dout_hi, b, be)
+        dq_hi = dq_hi + g1q.astype(jnp.float32) + g3q.astype(jnp.float32)
+        dq_lo = dq_lo + g2q.astype(jnp.float32)
+        # upcast each term BEFORE adding (with bf16 k/v the kernel
+        # returns bf16 grads; a bf16+bf16 add would round pre-buffer)
+        dk_cur = dk_cur.at[sl_lo].add(
+            g1k.astype(jnp.float32) + g2k.astype(jnp.float32))
+        dk_cur = dk_cur.at[sl_hi].add(g3k.astype(jnp.float32))
+        dv_cur = dv_cur.at[sl_lo].add(
+            g1v.astype(jnp.float32) + g2v.astype(jnp.float32))
+        dv_cur = dv_cur.at[sl_hi].add(g3v.astype(jnp.float32))
+        if t + 1 < z.n_dev:
+            dk_cur = lax.ppermute(dk_cur, z.axis_name, perm)
+            dv_cur = lax.ppermute(dv_cur, z.axis_name, perm)
+            k_cur, v_cur = k_next, v_next
+    dk_home = lax.ppermute(dk_cur, z.axis_name, perm)
+    dv_home = lax.ppermute(dv_cur, z.axis_name, perm)
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=-2)
+    return (dq.astype(q.dtype), dk_home.astype(k.dtype),
+            dv_home.astype(v.dtype))
+
+
+_zig_diff.defvjp(_zig_diff_fwd, _zig_diff_bwd)
+
+
+def _zigzag_exchange(x, axis_name, n_dev, chunk, *, inverse=False):
+    """Reshard between contiguous 2-chunk slices and zigzag (early,
+    late) slices WITHOUT a global gather — two half-chunk ppermutes
+    plus per-device slot selects, all inside shard_map, so the layout
+    change stays SPMD-partitionable however the caller's jit shards
+    the inputs (a plain `jnp.take` permutation over an sp-sharded
+    sequence fails XLA's partitioner).
+
+    Forward: contiguous device d holds chunks (2d, 2d+1); zigzag device
+    r wants (r, 2R-1-r).  Since 2R-1 is odd, each device's two target
+    chunks always have opposite parity, so the even-chunk and odd-chunk
+    flows each form a bijective device permutation.
+    """
+    n_chunks = 2 * n_dev
+    sl_lo, sl_hi = _zig_slices(x.ndim, chunk)
+    seq_axis = x.ndim - 2
+    r = lax.axis_index(axis_name)
+    even = (r % 2) == 0
+
+    def dest_of_chunk(c):
+        return c if c < n_dev else n_chunks - 1 - c
+
+    if not inverse:
+        h0, h1 = x[sl_lo], x[sl_hi]  # chunks 2d, 2d+1
+        perm0 = [(d, dest_of_chunk(2 * d)) for d in range(n_dev)]
+        perm1 = [(d, dest_of_chunk(2 * d + 1)) for d in range(n_dev)]
+        arr0 = lax.ppermute(h0, axis_name, perm0)  # the even chunk
+        arr1 = lax.ppermute(h1, axis_name, perm1)  # the odd chunk
+        # device r's early chunk is r (parity r%2), late is 2R-1-r
+        lo = jnp.where(even, arr0, arr1)
+        hi = jnp.where(even, arr1, arr0)
+        return jnp.concatenate([lo, hi], axis=seq_axis)
+    # inverse: zigzag device r holds (lo=chunk r, hi=chunk 2R-1-r);
+    # route the even/odd chunks back to contiguous device c//2
+    lo, hi = x[sl_lo], x[sl_hi]
+    a = jnp.where(even, lo, hi)  # the even chunk this device holds
+    b = jnp.where(even, hi, lo)  # the odd one
+    perm_a = [
+        (s, ((s if s % 2 == 0 else n_chunks - 1 - s) // 2))
+        for s in range(n_dev)
+    ]
+    perm_b = [
+        (s, (((n_chunks - 1 - s) if s % 2 == 0 else s) // 2))
+        for s in range(n_dev)
+    ]
+    arr_a = lax.ppermute(a, axis_name, perm_a)  # chunk 2d -> h0
+    arr_b = lax.ppermute(b, axis_name, perm_b)  # chunk 2d+1 -> h1
+    return jnp.concatenate([arr_a, arr_b], axis=seq_axis)
+
+
+def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
+                      scale, block_sizes, softcap, window):
+    """Differentiable zigzag ring: in-shard_map layout exchange ->
+    _zig_diff -> inverse exchange (all collective-based; autodiff
+    transposes the ppermutes)."""
+    n_dev = mesh.shape[axis_name]
+    q, k, v, chunk, n, m, c_pad, seq_axis = _zig_prepare(q, k, v, n_dev)
+
+    from attention_tpu.parallel.cp import _maybe_axis
+
+    h_axis = _maybe_axis(mesh, head_axis, q.shape[-3])
+    if h_axis is not None and k.shape[-3] % mesh.shape[h_axis] != 0:
+        h_axis = None
+    if q.ndim == 4:
+        b_axis = _maybe_axis(mesh, batch_axis, q.shape[0])
+        seq_spec = P(b_axis, h_axis, axis_name, None)
+    else:
+        seq_spec = P(h_axis, axis_name, None)
+
+    zcfg = _ZigCfg(
+        axis_name=axis_name, n_dev=n_dev, n=n, chunk=chunk, scale=scale,
+        block_sizes=block_sizes, softcap=softcap, window=window,
+    )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+    )
+    def run(q_local, k_local, v_local):
+        exch = functools.partial(_zigzag_exchange, axis_name=axis_name,
+                                 n_dev=n_dev, chunk=chunk)
+        q_z, k_z, v_z = exch(q_local), exch(k_local), exch(v_local)
+        if q_z.ndim == 4:
+            bq, h, mm, d = q_z.shape
+            bk, hkv, nn, dk_ = k_z.shape
+            out = _zig_diff(
+                q_z.reshape(bq * h, mm, d),
+                k_z.reshape(bk * hkv, nn, dk_),
+                v_z.reshape(bk * hkv, nn, v_z.shape[-1]),
+                zcfg,
+            )
+            out = out.reshape(bq, h, mm, -1)
+        else:
+            out = _zig_diff(q_z, k_z, v_z, zcfg)
+        return exch(out, inverse=True)
+
+    out = run(q, k, v)
     if c_pad != n:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
     return out
