@@ -36,3 +36,19 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_compiled_programs_between_modules():
+    """Free each module's compiled XLA programs when it finishes.
+
+    One pytest process compiles thousands of XLA:CPU executables across
+    the suite; each holds mmapped code, and the accumulation can exhaust
+    the kernel's per-process mapping budget (vm.max_map_count, default
+    65530) — observed as a deterministic SIGSEGV inside
+    ``backend_compile_and_load`` once the suite grew past ~370 tests.
+    Modules share almost no jitted functions, so clearing between
+    modules costs little recompilation and keeps the map count flat.
+    """
+    yield
+    jax.clear_caches()
