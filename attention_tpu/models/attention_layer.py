@@ -253,9 +253,12 @@ class GQASelfAttention(nn.Module):
     # ``cp_impl``: "allgather" (`parallel.cp`, KV gathered per device —
     # the default training layout), "ring" (`parallel.ring.
     # ring_attention_diff`, O(n/R) KV memory in both passes — the
-    # long-context composition), or "zigzag" (the ring with llama-3
+    # long-context composition), "zigzag" (the ring with llama-3
     # chunk interleaving: equal per-device work at every step of BOTH
-    # passes for causal models).  Decode/cached paths are unaffected.
+    # passes for causal models), or "ulysses" (`parallel.ulysses`,
+    # head/seq all-to-all — two collectives per pass, zero softmax
+    # collectives; needs q heads and seq divisible by the cp mesh
+    # size).  Decode/cached paths are unaffected.
     cp_axis: str | None = None
     cp_impl: str = "allgather"
     mesh: "jax.sharding.Mesh | None" = None
@@ -276,10 +279,12 @@ class GQASelfAttention(nn.Module):
                 )
             if self.mesh is None:
                 raise ValueError("cp_axis requires mesh=")
-            if self.attn_sinks and self.cp_impl != "allgather":
+            if self.attn_sinks and self.cp_impl not in ("allgather",
+                                                        "ulysses"):
                 raise ValueError(
                     "attention sinks need the full KV resident (absolute "
-                    "positions); use cp_impl='allgather' for sink models"
+                    "positions); use cp_impl='allgather' or 'ulysses' "
+                    "for sink models"
                 )
         dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
             features=(heads, self.head_dim),
@@ -339,10 +344,21 @@ class GQASelfAttention(nn.Module):
                         sinks=self.attn_sinks or None,
                         softcap=self.softcap,
                     )
+                elif self.cp_impl == "ulysses":
+                    from attention_tpu.parallel.ulysses import (
+                        ulysses_attention,
+                    )
+
+                    out = ulysses_attention(
+                        q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
+                        causal=self.causal, window=self.window,
+                        sinks=self.attn_sinks or None,
+                        softcap=self.softcap,
+                    )
                 else:
                     raise ValueError(
-                        f"unknown cp_impl {self.cp_impl!r} "
-                        "(supported: ['allgather', 'ring', 'zigzag'])"
+                        f"unknown cp_impl {self.cp_impl!r} (supported: "
+                        "['allgather', 'ring', 'zigzag', 'ulysses'])"
                     )
             else:
                 out = ATTN_IMPLS[self.impl](q, k, v, causal=self.causal,

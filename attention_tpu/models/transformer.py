@@ -49,7 +49,7 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     ep_axis: str | None = None
     cp_axis: str | None = None  # context-parallel attention (needs mesh)
-    cp_impl: str = "allgather"  # or "ring"/"zigzag" (O(n/R) KV memory)
+    cp_impl: str = "allgather"  # "ring"/"zigzag" (O(n/R) KV) or "ulysses"
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
@@ -120,7 +120,7 @@ class TinyDecoder(nn.Module):
     # `parallel.cp`).  This is what makes the SHARDED train step execute
     # the framework's own kernels rather than XLA's auto-SPMD einsums.
     cp_axis: str | None = None
-    cp_impl: str = "allgather"  # or "ring"
+    cp_impl: str = "allgather"  # or "ring"/"zigzag"/"ulysses"
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact

@@ -74,13 +74,14 @@ def ring_attention(
     lacks (early-shard devices spend most steps on fully-masked
     partials).  See :func:`_zigzag_ring`.
 
-    The kernel's masking surface flows through: ``window``/``sinks``
-    (expressed in GLOBAL positions via each step's rotating
-    ``kv_offset`` — sink contributions arrive when the shard holding
-    the sequence head rotates in) and, on the contiguous schedule,
-    packed-sequence segment ids (1D global ids; each device slices its
-    Q shard's ids, and each ring step slices the arriving KV shard's
-    ids from the replicated vector — cheaper than rotating them).
+    The kernel's masking surface flows through BOTH schedules:
+    ``window``/``sinks`` (expressed in GLOBAL positions via each step's
+    rotating ``kv_offset`` — sink contributions arrive when the shard
+    holding the sequence head rotates in) and packed-sequence segment
+    ids (1D global ids; segment matching is equality-based, so the
+    zigzag layout change costs nothing — each chunk-pair call just
+    slices its chunks' ids from a replicated vector, cheaper than
+    rotating a second buffer).
     """
     if mesh is None:
         mesh = default_mesh(axis_name)
@@ -98,15 +99,13 @@ def ring_attention(
                 "zigzag schedule only helps causal attention (non-causal "
                 "ring work is already balanced); use schedule='contiguous'"
             )
-        if segmented:
-            raise ValueError(
-                "segment ids are supported on the contiguous ring "
-                "schedule (zigzag reorders the sequence; combine packed "
-                "segments with schedule='contiguous')"
-            )
-        return _zigzag_ring(q, k, v, mesh=mesh, axis_name=axis_name,
-                            scale=scale, block_sizes=block_sizes,
-                            softcap=softcap, window=window, sinks=sinks)
+        return _zigzag_ring(
+            q, k, v, mesh=mesh, axis_name=axis_name, scale=scale,
+            block_sizes=block_sizes, softcap=softcap, window=window,
+            sinks=sinks,
+            segment_ids=(q_segment_ids, kv_segment_ids) if segmented
+            else None,
+        )
 
     m = q.shape[-2]
     n = k.shape[-2]
@@ -130,15 +129,10 @@ def ring_attention(
     in_specs = [seq_spec, seq_spec, seq_spec]
     extra = []
     if segmented:
-        q_seg = jnp.asarray(q_segment_ids, jnp.int32)
-        kv_seg = jnp.asarray(kv_segment_ids, jnp.int32)
-        if m_pad != m:
-            q_seg = jnp.pad(q_seg, (0, m_pad - m), constant_values=-1)
-        if n_pad != n:
-            kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
         # Q ids sharded with Q; KV ids replicated — each step slices the
         # arriving shard's ids instead of rotating a second buffer
-        extra = [q_seg, kv_seg]
+        extra = list(_ring_pad_ids(q_segment_ids, kv_segment_ids,
+                                   m, n, m_pad, n_pad))
         in_specs += [P(axis_name), P()]
 
     run_cfg = _RingCfg(
@@ -190,6 +184,8 @@ def ring_attention_diff(
     softcap: float | None = None,
     window: int | None = None,
     schedule: str = "contiguous",
+    q_segment_ids=None,
+    kv_segment_ids=None,
 ) -> jax.Array:
     """Differentiable ring attention: O(n/R) KV memory per device in
     BOTH passes.
@@ -209,6 +205,12 @@ def ring_attention_diff(
 
     Shapes: (h, m, d) or (b, h, m, d), GQA supported; sequence axes
     sharded over ``axis_name``.  ``window`` requires ``causal``.
+    Packed-sequence segment ids ((m,)/(n,) global int32 vectors; 3D
+    inputs only — the kernel's ids-shared-across-heads limit) flow
+    through BOTH passes of BOTH schedules: Q ids shard with Q on the
+    contiguous ring and ride replicated on the zigzag (whose chunk
+    calls slice by chunk id — segment matching is positionless), KV
+    ids stay replicated and are sliced per visiting shard.
 
     ``schedule="zigzag"`` (causal self-attention only) applies the
     per-step load balance to BOTH passes: each device differentiates
@@ -226,6 +228,13 @@ def ring_attention_diff(
         raise ValueError(f"ring_attention_diff takes 3D/4D, got {q.ndim}D")
     if schedule not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring schedule {schedule!r}")
+    segmented = q_segment_ids is not None
+    if segmented != (kv_segment_ids is not None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
+    if segmented and q.ndim == 4:
+        raise ValueError(
+            "segment ids support 3D inputs (ids shared across heads)"
+        )
     if schedule == "zigzag":
         if not causal:
             raise ValueError("zigzag schedule requires causal=True")
@@ -233,6 +242,8 @@ def ring_attention_diff(
             q, k, v, mesh=mesh, axis_name=axis_name,
             batch_axis=batch_axis, head_axis=head_axis, scale=scale,
             block_sizes=block_sizes, softcap=softcap, window=window,
+            segment_ids=(q_segment_ids, kv_segment_ids) if segmented
+            else None,
         )
 
     m = q.shape[-2]
@@ -269,17 +280,26 @@ def ring_attention_diff(
         causal=causal, softcap=softcap, window=window,
     )
 
+    in_specs = [seq_spec, seq_spec, seq_spec]
+    extra = []
+    if segmented:
+        # Q ids shard with Q rows; KV ids replicate (sliced per shard)
+        extra = list(_ring_pad_ids(q_segment_ids, kv_segment_ids,
+                                   m, n, m_pad, n_pad))
+        in_specs += [P(axis_name), P()]
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         check_vma=False,
-        in_specs=(seq_spec, seq_spec, seq_spec),
+        in_specs=tuple(in_specs),
         out_specs=seq_spec,
     )
-    def run(q_local, k_local, v_local):
+    def run(q_local, k_local, v_local, *seg_local):
         if q_local.ndim == 4:
             # fold batch into heads (grouping per batch stays aligned:
-            # hh // group lands on that batch's kv head)
+            # hh // group lands on that batch's kv head); segments are
+            # 3D-only, so this arm never carries them
             b, h, mm, d = q_local.shape
             bk, hkv, nn, dk_ = k_local.shape
             out = _ring_diff(
@@ -289,9 +309,10 @@ def ring_attention_diff(
                 _RingCfg(**cfg),
             )
             return out.reshape(b, h, mm, -1)
-        return _ring_diff(q_local, k_local, v_local, _RingCfg(**cfg))
+        return _ring_diff(q_local, k_local, v_local, _RingCfg(**cfg),
+                          *seg_local)
 
-    out = run(q, k, v)
+    out = run(q, k, v, *extra)
     if m_pad != m:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
     return out
@@ -312,8 +333,8 @@ class _RingCfg(NamedTuple):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _ring_diff(q, k, v, cfg: _RingCfg):
-    out, _ = _ring_diff_fwd_impl(q, k, v, cfg)
+def _ring_diff(q, k, v, cfg: _RingCfg, q_ids=None, kv_ids=None):
+    out, _ = _ring_diff_fwd_impl(q, k, v, cfg, q_ids, kv_ids)
     return out
 
 
@@ -363,21 +384,23 @@ def _ring_fwd_loop(q, k, v, cfg: _RingCfg, seg=None):
     return out, lse
 
 
-def _ring_diff_fwd_impl(q, k, v, cfg: _RingCfg):
-    out, lse = _ring_fwd_loop(q, k, v, cfg)
-    return out, (q, k, v, out, lse)
+def _ring_diff_fwd_impl(q, k, v, cfg: _RingCfg, q_ids=None, kv_ids=None):
+    seg = None if q_ids is None else (q_ids, kv_ids)
+    out, lse = _ring_fwd_loop(q, k, v, cfg, seg=seg)
+    return out, (q, k, v, q_ids, kv_ids, out, lse)
 
 
-def _ring_diff_fwd(q, k, v, cfg: _RingCfg):
-    out, res = _ring_diff_fwd_impl(q, k, v, cfg)
+def _ring_diff_fwd(q, k, v, cfg: _RingCfg, q_ids=None, kv_ids=None):
+    out, res = _ring_diff_fwd_impl(q, k, v, cfg, q_ids, kv_ids)
     return out, res
 
 
 def _ring_diff_bwd(cfg: _RingCfg, res, dout):
     from attention_tpu.ops.flash import _should_interpret
     from attention_tpu.ops.flash_bwd import flash_backward
+    from attention_tpu.ops.flash_vjp import _seg_zeros
 
-    q, k, v, out, lse = res
+    q, k, v, q_ids, kv_ids, out, lse = res
     idx = lax.axis_index(cfg.axis_name)
     perm = [(j, (j + 1) % cfg.n_dev) for j in range(cfg.n_dev)]
     interpret = _should_interpret()
@@ -390,6 +413,14 @@ def _ring_diff_bwd(cfg: _RingCfg, res, dout):
             k_next = lax.ppermute(k_cur, cfg.axis_name, perm)
             v_next = lax.ppermute(v_cur, cfg.axis_name, perm)
         shard = (idx - t) % cfg.n_dev
+        seg_kw = {}
+        if q_ids is not None:
+            seg_kw = {
+                "q_segment_ids": q_ids,
+                "kv_segment_ids": lax.dynamic_slice(
+                    kv_ids, (shard * cfg.n_local,), (cfg.n_local,)
+                ),
+            }
         dq_i, dk_i, dv_i = flash_backward(
             q, k_cur, v_cur, out, lse, dout,
             scale=cfg.scale, causal=cfg.causal,
@@ -398,6 +429,7 @@ def _ring_diff_bwd(cfg: _RingCfg, res, dout):
             q_offset=idx * cfg.m_local,
             kv_offset=shard * cfg.n_local,
             kv_valid=jnp.clip(cfg.n - shard * cfg.n_local, 0, cfg.n_local),
+            **seg_kw,
         )
         dq = dq + dq_i.astype(jnp.float32)
         # accumulate into the buffer of the shard CURRENTLY resident,
@@ -414,7 +446,7 @@ def _ring_diff_bwd(cfg: _RingCfg, res, dout):
     dk_home = lax.ppermute(dk_cur, cfg.axis_name, perm)
     dv_home = lax.ppermute(dv_cur, cfg.axis_name, perm)
     return (dq.astype(q.dtype), dk_home.astype(k.dtype),
-            dv_home.astype(v.dtype))
+            dv_home.astype(v.dtype), _seg_zeros(q_ids), _seg_zeros(kv_ids))
 
 
 _ring_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)
@@ -456,8 +488,39 @@ def _zig_prepare(q, k, v, n_dev):
     return q, k, v, c_pad // n_chunks, n, m, c_pad, seq_axis
 
 
+def _ring_pad_ids(q_segment_ids, kv_segment_ids, m, n, m_pad, n_pad):
+    """Validate a (q_ids, kv_ids) pair and pad to the ring-padded
+    lengths with -1 (padded rows match no non-negative id).  Length
+    mismatches must fail at trace time: ``lax.dynamic_slice`` CLAMPS
+    out-of-bounds starts, so a wrong-length id vector would otherwise
+    hand shards silently wrong ids."""
+    q_seg = jnp.asarray(q_segment_ids, jnp.int32)
+    kv_seg = jnp.asarray(kv_segment_ids, jnp.int32)
+    if q_seg.ndim != 1 or kv_seg.ndim != 1:
+        raise ValueError("ring segment ids are 1D global vectors")
+    if q_seg.shape[0] != m or kv_seg.shape[0] != n:
+        raise ValueError(
+            f"segment id lengths ({q_seg.shape[0]}, {kv_seg.shape[0]}) "
+            f"must match the sequence lengths ({m}, {n})"
+        )
+    if m_pad != m:
+        q_seg = jnp.pad(q_seg, (0, m_pad - m), constant_values=-1)
+    if n_pad != n:
+        kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
+    return q_seg, kv_seg
+
+
+def _zig_pad_ids(segment_ids, m, n, c_pad):
+    """Zigzag variant of :func:`_ring_pad_ids`: both vectors pad to the
+    2R-chunk-padded length.  Ids stay in GLOBAL order — segment matching
+    is equality-based, so the zigzag layout never permutes them; chunk
+    calls slice by chunk id instead."""
+    return _ring_pad_ids(segment_ids[0], segment_ids[1], m, n,
+                         c_pad, c_pad)
+
+
 def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
-                 window=None, sinks=None):
+                 window=None, sinks=None, segment_ids=None):
     """Causal ring attention with the llama-3-style zigzag layout.
 
     The sequence is split into 2R chunks; device d owns chunks
@@ -506,19 +569,28 @@ def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
         sinks=sinks,
     )
 
+    extra = []
+    in_specs = [seq_spec, seq_spec, seq_spec]
+    if segment_ids is not None:
+        # both id vectors replicated in GLOBAL order; chunk calls slice
+        extra = list(_zig_pad_ids(segment_ids, m, n, c_pad))
+        in_specs += [P(), P()]
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         check_vma=False,
-        in_specs=(seq_spec, seq_spec, seq_spec),
+        in_specs=tuple(in_specs),
         out_specs=seq_spec,
     )
-    def run(q_local, k_local, v_local):
-        out_lo, _, out_hi, _ = _zig_fwd_loop(q_local, k_local, v_local,
-                                             zcfg)
+    def run(q_local, k_local, v_local, *seg_local):
+        out_lo, _, out_hi, _ = _zig_fwd_loop(
+            q_local, k_local, v_local, zcfg,
+            seg=tuple(seg_local) if seg_local else None,
+        )
         return jnp.concatenate([out_lo, out_hi], axis=seq_axis)
 
-    out = run(q_z, k_z, v_z)
+    out = run(q_z, k_z, v_z, *extra)
     out = jnp.take(out, jnp.asarray(inv), axis=seq_axis)
     if c_pad != n:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
@@ -543,11 +615,20 @@ def _zig_slices(ndim, chunk):
     return sl_lo, sl_hi
 
 
-def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
+def _zig_chunk_ids(ids_full, cid, chunk):
+    """Slice chunk ``cid``'s ids from a replicated global id vector
+    (``cid`` is a traced device-dependent chunk index)."""
+    return lax.dynamic_slice(ids_full, (cid * chunk,), (chunk,))
+
+
+def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg, seg=None):
     """The one copy of the zigzag rotate/merge schedule, shared by the
     plain forward (which discards the lse) and the custom-VJP path.
-    Returns (out_lo, lse_lo, out_hi, lse_hi) for the device's two
-    chunks."""
+    ``seg`` is an optional (q_ids_full, kv_ids_full) pair of replicated
+    GLOBAL id vectors; every chunk-pair call slices its chunks' ids
+    (segment matching is positionless, so the zigzag layout needs no id
+    permutation).  Returns (out_lo, lse_lo, out_hi, lse_hi) for the
+    device's two chunks."""
     n_chunks = 2 * z.n_dev
     idx_d = lax.axis_index(z.axis_name)
     a = idx_d  # early chunk id
@@ -555,6 +636,9 @@ def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
     perm = [(j, (j + 1) % z.n_dev) for j in range(z.n_dev)]
     sl_lo, sl_hi = _zig_slices(q_local.ndim, z.chunk)
     q_lo, q_hi = q_local[sl_lo], q_local[sl_hi]
+    if seg is not None:
+        q_seg_lo = _zig_chunk_ids(seg[0], a, z.chunk)
+        q_seg_hi = _zig_chunk_ids(seg[0], b, z.chunk)
 
     def fresh(q_c):
         shape = q_c.shape[:-1]
@@ -567,7 +651,13 @@ def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
     lo = fresh(q_lo)
     hi = fresh(q_hi)
 
-    def partial_call(q_c, k_c, v_c, q_cid, kv_cid):
+    def partial_call(q_c, k_c, v_c, q_cid, kv_cid, q_seg_c=None):
+        seg_kw = {}
+        if seg is not None:
+            seg_kw = {
+                "q_segment_ids": q_seg_c,
+                "kv_segment_ids": _zig_chunk_ids(seg[1], kv_cid, z.chunk),
+            }
         return flash_attention_partials(
             q_c, k_c, v_c, scale=z.scale, block_sizes=z.block_sizes,
             causal=True,
@@ -577,8 +667,11 @@ def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
             softcap=z.softcap,
             window=z.window,
             sinks=z.sinks,
+            **seg_kw,
         )
 
+    seg_lo = None if seg is None else q_seg_lo
+    seg_hi = None if seg is None else q_seg_hi
     k_cur, v_cur = k_local, v_local
     for t in range(z.n_dev):
         if t + 1 < z.n_dev:
@@ -590,11 +683,11 @@ def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
         k_lo, k_hi = k_cur[sl_lo], k_cur[sl_hi]
         v_lo, v_hi = v_cur[sl_lo], v_cur[sl_hi]
         # (q_hi, kv_lo): always fully unmasked (b > ae)
-        hi = _merge_step(hi, *partial_call(q_hi, k_lo, v_lo, b, ae))
+        hi = _merge_step(hi, *partial_call(q_hi, k_lo, v_lo, b, ae, seg_hi))
         # (q_lo, kv_lo): nonempty iff ae <= a — dynamic kernel skip
-        lo = _merge_step(lo, *partial_call(q_lo, k_lo, v_lo, a, ae))
+        lo = _merge_step(lo, *partial_call(q_lo, k_lo, v_lo, a, ae, seg_lo))
         # (q_hi, kv_hi): nonempty iff be <= b — dynamic kernel skip
-        hi = _merge_step(hi, *partial_call(q_hi, k_hi, v_hi, b, be))
+        hi = _merge_step(hi, *partial_call(q_hi, k_hi, v_hi, b, be, seg_hi))
         # (q_lo, kv_hi): empty by construction — skipped at trace time
         if t + 1 < z.n_dev:
             k_cur, v_cur = k_next, v_next
@@ -612,15 +705,17 @@ def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _zig_diff(q, k, v, z: _ZigCfg):
-    out_lo, _, out_hi, _ = _zig_fwd_loop(q, k, v, z)
+def _zig_diff(q, k, v, z: _ZigCfg, q_ids=None, kv_ids=None):
+    seg = None if q_ids is None else (q_ids, kv_ids)
+    out_lo, _, out_hi, _ = _zig_fwd_loop(q, k, v, z, seg=seg)
     return jnp.concatenate([out_lo, out_hi], axis=-2)
 
 
-def _zig_diff_fwd(q, k, v, z: _ZigCfg):
-    out_lo, lse_lo, out_hi, lse_hi = _zig_fwd_loop(q, k, v, z)
+def _zig_diff_fwd(q, k, v, z: _ZigCfg, q_ids=None, kv_ids=None):
+    seg = None if q_ids is None else (q_ids, kv_ids)
+    out_lo, lse_lo, out_hi, lse_hi = _zig_fwd_loop(q, k, v, z, seg=seg)
     out = jnp.concatenate([out_lo, out_hi], axis=-2)
-    return out, (q, k, v, out_lo, lse_lo, out_hi, lse_hi)
+    return out, (q, k, v, q_ids, kv_ids, out_lo, lse_lo, out_hi, lse_hi)
 
 
 def _zig_diff_bwd(z: _ZigCfg, res, dout):
@@ -630,8 +725,9 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
     the forward — the load-balance property holds in BOTH passes."""
     from attention_tpu.ops.flash import _should_interpret
     from attention_tpu.ops.flash_bwd import flash_backward
+    from attention_tpu.ops.flash_vjp import _seg_zeros
 
-    q, k, v, out_lo, lse_lo, out_hi, lse_hi = res
+    q, k, v, q_ids, kv_ids, out_lo, lse_lo, out_hi, lse_hi = res
     n_chunks = 2 * z.n_dev
     idx_d = lax.axis_index(z.axis_name)
     a = idx_d
@@ -641,13 +737,24 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
     sl_lo, sl_hi = _zig_slices(q.ndim, z.chunk)
     q_lo, q_hi = q[sl_lo], q[sl_hi]
     dout_lo, dout_hi = dout[sl_lo], dout[sl_hi]
+    seg_lo = seg_hi = None
+    if q_ids is not None:
+        seg_lo = _zig_chunk_ids(q_ids, a, z.chunk)
+        seg_hi = _zig_chunk_ids(q_ids, b, z.chunk)
     dq_lo = jnp.zeros(q_lo.shape, jnp.float32)
     dq_hi = jnp.zeros(q_hi.shape, jnp.float32)
     dk_cur = jnp.zeros(k.shape, jnp.float32)
     dv_cur = jnp.zeros(v.shape, jnp.float32)
     k_cur, v_cur = k, v
 
-    def bwd_call(q_c, k_c, v_c, out_c, lse_c, dout_c, q_cid, kv_cid):
+    def bwd_call(q_c, k_c, v_c, out_c, lse_c, dout_c, q_cid, kv_cid,
+                 q_seg_c=None):
+        seg_kw = {}
+        if q_ids is not None:
+            seg_kw = {
+                "q_segment_ids": q_seg_c,
+                "kv_segment_ids": _zig_chunk_ids(kv_ids, kv_cid, z.chunk),
+            }
         return flash_backward(
             q_c, k_c, v_c, out_c, lse_c, dout_c,
             scale=z.scale, causal=True, interpret=interpret,
@@ -655,6 +762,7 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
             q_offset=q_cid * z.chunk,
             kv_offset=kv_cid * z.chunk,
             kv_valid=jnp.clip(z.n - kv_cid * z.chunk, 0, z.chunk),
+            **seg_kw,
         )
 
     for t in range(z.n_dev):
@@ -668,11 +776,11 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
         v_lo, v_hi = v_cur[sl_lo], v_cur[sl_hi]
         # the forward's three chunk-pair calls, differentiated
         g1q, g1k, g1v = bwd_call(q_hi, k_lo, v_lo, out_hi, lse_hi,
-                                 dout_hi, b, ae)
+                                 dout_hi, b, ae, seg_hi)
         g2q, g2k, g2v = bwd_call(q_lo, k_lo, v_lo, out_lo, lse_lo,
-                                 dout_lo, a, ae)
+                                 dout_lo, a, ae, seg_lo)
         g3q, g3k, g3v = bwd_call(q_hi, k_hi, v_hi, out_hi, lse_hi,
-                                 dout_hi, b, be)
+                                 dout_hi, b, be, seg_hi)
         dq_hi = dq_hi + g1q.astype(jnp.float32) + g3q.astype(jnp.float32)
         dq_lo = dq_lo + g2q.astype(jnp.float32)
         # upcast each term BEFORE adding (with bf16 k/v the kernel
@@ -691,7 +799,7 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
     dv_home = lax.ppermute(dv_cur, z.axis_name, perm)
     dq = jnp.concatenate([dq_lo, dq_hi], axis=-2)
     return (dq.astype(q.dtype), dk_home.astype(k.dtype),
-            dv_home.astype(v.dtype))
+            dv_home.astype(v.dtype), _seg_zeros(q_ids), _seg_zeros(kv_ids))
 
 
 _zig_diff.defvjp(_zig_diff_fwd, _zig_diff_bwd)
@@ -748,10 +856,13 @@ def _zigzag_exchange(x, axis_name, n_dev, chunk, *, inverse=False):
 
 
 def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
-                      scale, block_sizes, softcap, window):
+                      scale, block_sizes, softcap, window,
+                      segment_ids=None):
     """Differentiable zigzag ring: in-shard_map layout exchange ->
     _zig_diff -> inverse exchange (all collective-based; autodiff
-    transposes the ppermutes)."""
+    transposes the ppermutes).  Segment ids ride replicated in GLOBAL
+    order — they never enter the exchange (chunk calls slice by chunk
+    id; segment matching is positionless)."""
     n_dev = mesh.shape[axis_name]
     q, k, v, chunk, n, m, c_pad, seq_axis = _zig_prepare(q, k, v, n_dev)
 
@@ -771,18 +882,25 @@ def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
         block_sizes=block_sizes, softcap=softcap, window=window,
     )
 
+    in_specs = [seq_spec, seq_spec, seq_spec]
+    extra = []
+    if segment_ids is not None:
+        extra = list(_zig_pad_ids(segment_ids, m, n, c_pad))
+        in_specs += [P(), P()]
+
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         check_vma=False,
-        in_specs=(seq_spec, seq_spec, seq_spec),
+        in_specs=tuple(in_specs),
         out_specs=seq_spec,
     )
-    def run(q_local, k_local, v_local):
+    def run(q_local, k_local, v_local, *seg_local):
         exch = functools.partial(_zigzag_exchange, axis_name=axis_name,
                                  n_dev=n_dev, chunk=chunk)
         q_z, k_z, v_z = exch(q_local), exch(k_local), exch(v_local)
         if q_z.ndim == 4:
+            # segments are 3D-only, so this arm never carries them
             bq, h, mm, d = q_z.shape
             bk, hkv, nn, dk_ = k_z.shape
             out = _zig_diff(
@@ -793,10 +911,10 @@ def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
             )
             out = out.reshape(bq, h, mm, -1)
         else:
-            out = _zig_diff(q_z, k_z, v_z, zcfg)
+            out = _zig_diff(q_z, k_z, v_z, zcfg, *seg_local)
         return exch(out, inverse=True)
 
-    out = run(q, k, v)
+    out = run(q, k, v, *extra)
     if c_pad != n:
         out = lax.slice_in_dim(out, 0, m, axis=seq_axis)
     return out

@@ -25,14 +25,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from attention_tpu.ops.flash import BlockSizes, flash_attention
+from attention_tpu.ops.flash import BlockSizes
+from attention_tpu.ops.flash_vjp import flash_attention_diff
 from attention_tpu.parallel.mesh import default_mesh
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
-                     "softcap", "window", "sinks"),
+    static_argnames=("mesh", "axis_name", "batch_axis", "scale",
+                     "block_sizes", "causal", "softcap", "window", "sinks"),
 )
 def ulysses_attention(
     q: jax.Array,
@@ -41,6 +42,7 @@ def ulysses_attention(
     *,
     mesh: Mesh | None = None,
     axis_name: str = "sp",
+    batch_axis: str | None = "dp",
     scale: float | None = None,
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
@@ -53,9 +55,16 @@ def ulysses_attention(
     """All-to-all sequence-parallel attention for multi-head inputs.
 
     Shapes: (h, m, d) or (b, h, m, d); the sequence axes are sharded over
-    ``axis_name`` on the way in and out.  Requires the Q head count to be
-    a multiple of the mesh size and sequence lengths to be multiples of
-    the mesh size.
+    ``axis_name`` on the way in and out (4D batches may additionally
+    shard over ``batch_axis`` when the mesh has it and it divides).
+    Requires the Q head count to be a multiple of the mesh size and
+    sequence lengths to be multiples of the mesh size.
+
+    Differentiable end to end: the inner kernel is the flash custom VJP
+    and both all-to-alls (plus the GQA repeat) are transposed by
+    autodiff — the backward is two more all-to-alls around the Pallas
+    backward kernels, so ``cp_impl="ulysses"`` trains
+    (`models/attention_layer.py`).
 
     Carries the single-device kernel's full masking surface (the
     reference's orchestrator supports its kernel's entire surface,
@@ -102,7 +111,13 @@ def ulysses_attention(
 
     head_axis = q.ndim - 3
     seq_axis = q.ndim - 2
-    seq_spec = P(*([None] * seq_axis), axis_name, None)
+    if q.ndim == 4:
+        from attention_tpu.parallel.cp import _maybe_axis
+
+        b_axis = _maybe_axis(mesh, batch_axis, q.shape[0])
+        seq_spec = P(b_axis, None, axis_name, None)
+    else:
+        seq_spec = P(None, axis_name, None)
 
     @functools.partial(
         jax.shard_map,
@@ -116,7 +131,7 @@ def ulysses_attention(
         qh = lax.all_to_all(q_local, axis_name, head_axis, seq_axis, tiled=True)
         kh = lax.all_to_all(k_local, axis_name, head_axis, seq_axis, tiled=True)
         vh = lax.all_to_all(v_local, axis_name, head_axis, seq_axis, tiled=True)
-        out = flash_attention(
+        out = flash_attention_diff(
             qh, kh, vh, scale=scale, block_sizes=block_sizes, causal=causal,
             softcap=softcap, window=window, sinks=sinks,
             q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,

@@ -32,6 +32,8 @@ def main() -> int:
     p.add_argument("--n-short", type=int, default=2)
     p.add_argument("--n-long", type=int, default=8)
     p.add_argument("--causal", action="store_true")
+    p.add_argument("--max-mode", type=str, default="bound",
+                   choices=("online", "bound"))
     p.add_argument(
         "--configs", type=str,
         default="256x1024,512x1024,1024x1024,256x2048,512x2048,512x512",
@@ -57,7 +59,8 @@ def main() -> int:
         def chained(x0, kk_, vv_, n):
             def body(carry, _):
                 out = flash_attention(carry, kk_, vv_, block_sizes=bs,
-                                      causal=args.causal)
+                                      causal=args.causal,
+                                      max_mode=args.max_mode)
                 return out.astype(x0.dtype), None
 
             out, _ = lax.scan(body, x0, None, length=n)

@@ -11,6 +11,12 @@ at a 131k-analog causal shape, per-device busy time from the device
 trace — merged union of compute intervals per device thread — must be
 within ~10% (max/min) for zigzag, vs the large spread of contiguous.
 Also oracle-checks both schedules against the single-device kernel.
+
+``--grad`` profiles the BACKWARD instead (`ring_attention_diff`,
+value_and_grad over all three inputs): the zigzag claim is that the
+balance holds in BOTH passes — the backward's three chunk-pair
+`flash_backward` calls per step mirror the forward's — and this mode
+measures it rather than asserting it.
 """
 
 from __future__ import annotations
@@ -78,6 +84,8 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seq", type=int, default=8192)
     p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--grad", action="store_true",
+                   help="profile the backward pass (ring_attention_diff)")
     args = p.parse_args()
 
     from __graft_entry__ import _force_cpu_mesh
@@ -87,26 +95,45 @@ def main() -> int:
     import numpy as np
 
     from attention_tpu.ops.flash import flash_attention
+    from attention_tpu.ops.flash_vjp import flash_attention_diff
     from attention_tpu.parallel.mesh import default_mesh
-    from attention_tpu.parallel.ring import ring_attention
+    from attention_tpu.parallel.ring import ring_attention, ring_attention_diff
     from attention_tpu.utils.profiling import trace
 
     mesh = default_mesh("sp")
     q = jax.random.normal(jax.random.PRNGKey(0), (args.seq, args.dim),
                           jnp.float32)
-    ref = np.asarray(flash_attention(q, q, q, causal=True))
+    if args.grad:
+        q = q[None]  # (1, s, d): the diff path takes 3D/4D
+
+        def ref_loss(x):
+            return jnp.sum(jnp.sin(flash_attention_diff(x, x, x,
+                                                        causal=True)))
+
+        ref = np.asarray(jax.grad(ref_loss)(q))
+    else:
+        ref = np.asarray(flash_attention(q, q, q, causal=True))
 
     results = {}
     for schedule in ("contiguous", "zigzag"):
-        f = jax.jit(
-            lambda x: ring_attention(
-                x, x, x, mesh=mesh, axis_name="sp", causal=True,
-                schedule=schedule,
+        if args.grad:
+            def loss(x, _schedule=schedule):
+                return jnp.sum(jnp.sin(ring_attention_diff(
+                    x, x, x, mesh=mesh, axis_name="sp", causal=True,
+                    schedule=_schedule,
+                )))
+
+            f = jax.jit(jax.grad(loss))
+        else:
+            f = jax.jit(
+                lambda x, _schedule=schedule: ring_attention(
+                    x, x, x, mesh=mesh, axis_name="sp", causal=True,
+                    schedule=_schedule,
+                )
             )
-        )
         out = jax.block_until_ready(f(q))
         err = float(np.max(np.abs(np.asarray(out) - ref)))
-        log = f"/tmp/ring_balance_{schedule}"
+        log = f"/tmp/ring_balance_{schedule}{'_grad' if args.grad else ''}"
         shutil.rmtree(log, ignore_errors=True)
         with trace(log):
             jax.block_until_ready(f(q))

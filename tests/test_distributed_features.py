@@ -95,15 +95,18 @@ def test_kv_sharded_segments(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
-def test_ring_segments(rng):
-    """Each ring step slices the arriving KV shard's ids from the
-    replicated id vector; merge must equal the single-device mask."""
+@pytest.mark.parametrize("schedule", ["contiguous", "zigzag"])
+def test_ring_segments(rng, schedule):
+    """Each ring step slices the arriving KV shard's (or, on zigzag,
+    chunk pair's) ids from the replicated id vector; merge must equal
+    the single-device mask."""
     mesh = _mesh()
     q, k, v = _qkv(rng, 2, 250, 32)
     ids = _packed_ids(rng, 250)
     want = flash_attention(q, k, v, causal=True, q_segment_ids=ids,
                            kv_segment_ids=ids)
     got = ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True,
+                         schedule=schedule,
                          q_segment_ids=ids, kv_segment_ids=ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
@@ -119,13 +122,9 @@ def test_ulysses_segments(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
-def test_zigzag_rejects_segments_and_noncausal(rng):
+def test_zigzag_rejects_noncausal(rng):
     mesh = _mesh()
     q, k, v = _qkv(rng, 2, 128, 16)
-    ids = _packed_ids(rng, 128)
-    with pytest.raises(ValueError, match="contiguous"):
-        ring_attention(q, k, v, mesh=mesh, schedule="zigzag", causal=True,
-                       q_segment_ids=ids, kv_segment_ids=ids)
     with pytest.raises(ValueError, match="zigzag"):
         ring_attention(q, k, v, mesh=mesh, schedule="zigzag", causal=False)
 
