@@ -279,13 +279,6 @@ class GQASelfAttention(nn.Module):
                 )
             if self.mesh is None:
                 raise ValueError("cp_axis requires mesh=")
-            if self.attn_sinks and self.cp_impl not in ("allgather",
-                                                        "ulysses"):
-                raise ValueError(
-                    "attention sinks need the full KV resident (absolute "
-                    "positions); use cp_impl='allgather' or 'ulysses' "
-                    "for sink models"
-                )
         dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
             features=(heads, self.head_dim),
             use_bias=False,
@@ -331,6 +324,7 @@ class GQASelfAttention(nn.Module):
                     out = ring_attention_diff(
                         q, k, v, mesh=self.mesh, axis_name=self.cp_axis,
                         causal=self.causal, window=self.window,
+                        sinks=self.attn_sinks or None,
                         softcap=self.softcap,
                         schedule=("zigzag" if self.cp_impl == "zigzag"
                                   else "contiguous"),

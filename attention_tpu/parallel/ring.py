@@ -167,7 +167,7 @@ def ring_attention(
     jax.jit,
     static_argnames=("mesh", "axis_name", "batch_axis", "head_axis",
                      "scale", "block_sizes", "causal", "softcap", "window",
-                     "schedule"),
+                     "sinks", "schedule"),
 )
 def ring_attention_diff(
     q: jax.Array,
@@ -183,6 +183,7 @@ def ring_attention_diff(
     causal: bool = False,
     softcap: float | None = None,
     window: int | None = None,
+    sinks: int | None = None,
     schedule: str = "contiguous",
     q_segment_ids=None,
     kv_segment_ids=None,
@@ -212,6 +213,14 @@ def ring_attention_diff(
     calls slice by chunk id — segment matching is positionless), KV
     ids stay replicated and are sliced per visiting shard.
 
+    ``sinks`` (StreamingLLM, requires ``window``) train under the ring
+    too: the forward's banded partials handle the sink blocks through
+    each step's ``kv_offset``; the backward adds the out-of-window sink
+    sliver (`flash_bwd._sink_patch`) exactly once — gated to the ring
+    step where the shard holding the absolute sink rows (shard 0, or
+    zigzag chunk 0) is resident, so its dK/dV land in that shard's
+    traveling gradient buffer.  Sinks must fit in one shard/chunk.
+
     ``schedule="zigzag"`` (causal self-attention only) applies the
     per-step load balance to BOTH passes: each device differentiates
     its early+late chunk pair, so forward partials and the backward's
@@ -235,6 +244,11 @@ def ring_attention_diff(
         raise ValueError(
             "segment ids support 3D inputs (ids shared across heads)"
         )
+    if sinks is not None:
+        if window is None:
+            raise ValueError("sinks require window= (see flash_attention)")
+        if segmented:
+            raise ValueError("sinks do not compose with segment_ids")
     if schedule == "zigzag":
         if not causal:
             raise ValueError("zigzag schedule requires causal=True")
@@ -242,6 +256,7 @@ def ring_attention_diff(
             q, k, v, mesh=mesh, axis_name=axis_name,
             batch_axis=batch_axis, head_axis=head_axis, scale=scale,
             block_sizes=block_sizes, softcap=softcap, window=window,
+            sinks=sinks,
             segment_ids=(q_segment_ids, kv_segment_ids) if segmented
             else None,
         )
@@ -274,10 +289,14 @@ def ring_attention_diff(
     else:
         seq_spec = P(h_axis, axis_name, None)
 
+    if sinks is not None and sinks > n_local:
+        raise ValueError(
+            f"sinks ({sinks}) must fit in one KV shard ({n_local} rows)"
+        )
     cfg = dict(
         axis_name=axis_name, n_dev=n_dev, n=n, m_local=m_local,
         n_local=n_local, scale=scale, block_sizes=block_sizes,
-        causal=causal, softcap=softcap, window=window,
+        causal=causal, softcap=softcap, window=window, sinks=sinks,
     )
 
     in_specs = [seq_spec, seq_spec, seq_spec]
@@ -437,6 +456,28 @@ def _ring_diff_bwd(cfg: _RingCfg, res, dout):
         # the arriving buffer belongs to the NEXT shard)
         dk_cur = dk_cur + dk_i.astype(jnp.float32)
         dv_cur = dv_cur + dv_i.astype(jnp.float32)
+        if cfg.sinks is not None:
+            # out-of-window sink pairs: the banded kernel above covers
+            # only the window band, so add the sliver — gated to the
+            # step where shard 0 (the absolute sink rows) is resident,
+            # so its dK/dV land in that shard's traveling buffer
+            from attention_tpu.ops.flash_bwd import _sink_patch
+
+            # kv_valid=None: shard 0 is always fully real (sequence
+            # padding lives in the LAST shard) and sinks <= n_local is
+            # enforced at entry, so the sink columns can't be padded
+            dq_s, dk_s, dv_s, se = _sink_patch(
+                q, k_cur, v_cur, out, lse, dout, scale=cfg.scale,
+                window=cfg.window, sinks=cfg.sinks, softcap=cfg.softcap,
+                q_offset=idx * cfg.m_local,
+            )
+            # jnp.where, not a 0/1 multiply: on non-sink steps the
+            # sliver is computed against the WRONG shard's rows and may
+            # overflow — 0 * inf would poison the buffer with NaN
+            gate = shard == 0
+            dq = dq + jnp.where(gate, dq_s, 0.0)
+            dk_cur = dk_cur.at[:, :se].add(jnp.where(gate, dk_s, 0.0))
+            dv_cur = dv_cur.at[:, :se].add(jnp.where(gate, dv_s, 0.0))
         if t + 1 < cfg.n_dev:
             dk_cur = lax.ppermute(dk_cur, cfg.axis_name, perm)
             dv_cur = lax.ppermute(dv_cur, cfg.axis_name, perm)
@@ -791,6 +832,31 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
         dv_cur = dv_cur.at[sl_lo].add(
             g1v.astype(jnp.float32) + g2v.astype(jnp.float32))
         dv_cur = dv_cur.at[sl_hi].add(g3v.astype(jnp.float32))
+        if z.sinks is not None:
+            # out-of-window sink pairs (see the contiguous backward):
+            # absolute sink rows live in global chunk 0, resident as
+            # the visiting EARLY chunk when ae == 0; both local q
+            # chunks get a sliver against it.  jnp.where, not 0/1
+            # multiply — the wrong-chunk sliver may overflow and
+            # 0 * inf would NaN-poison the buffers
+            from attention_tpu.ops.flash_bwd import _sink_patch
+
+            # kv_valid=None: chunk 0 is always fully real (sequence
+            # padding lives in the LAST chunks) and sinks <= chunk is
+            # enforced at entry, so the sink columns can't be padded
+            s1q, s1k, s1v, se = _sink_patch(
+                q_hi, k_lo, v_lo, out_hi, lse_hi, dout_hi,
+                scale=z.scale, window=z.window, sinks=z.sinks,
+                softcap=z.softcap, q_offset=b * z.chunk)
+            s2q, s2k, s2v, _ = _sink_patch(
+                q_lo, k_lo, v_lo, out_lo, lse_lo, dout_lo,
+                scale=z.scale, window=z.window, sinks=z.sinks,
+                softcap=z.softcap, q_offset=a * z.chunk)
+            gate = ae == 0
+            dq_hi = dq_hi + jnp.where(gate, s1q, 0.0)
+            dq_lo = dq_lo + jnp.where(gate, s2q, 0.0)
+            dk_cur = dk_cur.at[:, :se].add(jnp.where(gate, s1k + s2k, 0.0))
+            dv_cur = dv_cur.at[:, :se].add(jnp.where(gate, s1v + s2v, 0.0))
         if t + 1 < z.n_dev:
             dk_cur = lax.ppermute(dk_cur, z.axis_name, perm)
             dv_cur = lax.ppermute(dv_cur, z.axis_name, perm)
@@ -856,7 +922,7 @@ def _zigzag_exchange(x, axis_name, n_dev, chunk, *, inverse=False):
 
 
 def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
-                      scale, block_sizes, softcap, window,
+                      scale, block_sizes, softcap, window, sinks=None,
                       segment_ids=None):
     """Differentiable zigzag ring: in-shard_map layout exchange ->
     _zig_diff -> inverse exchange (all collective-based; autodiff
@@ -877,9 +943,14 @@ def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
     else:
         seq_spec = P(h_axis, axis_name, None)
 
+    if sinks is not None and sinks > chunk:
+        raise ValueError(
+            f"sinks ({sinks}) must fit in one zigzag chunk ({chunk} rows)"
+        )
     zcfg = _ZigCfg(
         axis_name=axis_name, n_dev=n_dev, n=n, chunk=chunk, scale=scale,
         block_sizes=block_sizes, softcap=softcap, window=window,
+        sinks=sinks,
     )
 
     in_specs = [seq_spec, seq_spec, seq_spec]
