@@ -106,11 +106,13 @@ def _fsdp_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
 
 
 def _legal_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
-    """Drop sharding on any dim the mesh axis doesn't divide (e.g. a
+    """Drop sharding on any dim whose axis the mesh lacks (e.g. a
+    2-axis (dp, sp) multi-host mesh has no tp) or doesn't divide (a
     single shared KV head can't be split over tp) — replicate instead."""
     fixed = []
     for dim, axis in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
-        if axis is not None and dim % mesh.shape[axis] != 0:
+        if axis is not None and (axis not in mesh.shape
+                                 or dim % mesh.shape[axis] != 0):
             axis = None
         fixed.append(axis)
     return P(*fixed)

@@ -13,5 +13,7 @@ from attention_tpu.parallel.ring import (  # noqa: F401
 from attention_tpu.parallel.serving import (  # noqa: F401
     cache_sharded_decode,
     head_sharded_decode,
+    head_sharded_decode_paged,
+    head_sharded_decode_quantized,
 )
 from attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

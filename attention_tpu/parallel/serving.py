@@ -15,6 +15,11 @@ the way the batch path does:
     pmax/psum scheme as the batch path (`kv_sharded.merge_partials`,
     the reference's `attention-mpi.c:340-380` algorithm applied to a
     single query row).
+  * :func:`head_sharded_decode_quantized` / :func:`head_sharded_decode_paged`
+    — the tensor-parallel layout applied to the int8 and paged cache
+    types (values+scales / pools shard by KV head; page tables
+    replicate), so every cache type the framework serves also serves
+    sharded.
 
 Both are `shard_map`s over a 1D mesh axis and compose with an outer
 batch/data-parallel axis via pjit.
@@ -35,10 +40,38 @@ from attention_tpu.parallel.kv_sharded import merge_partials
 from attention_tpu.parallel.mesh import default_mesh
 
 
+def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
+                       operand_specs):
+    """Shared tensor-parallel scaffold for every cache type: validate
+    KV-head divisibility, shard ``q`` (and whatever cache pytree
+    ``operands`` carries, per ``operand_specs``) along the KV-head dim,
+    and run ``kernel`` per shard.  Adding a decode option means
+    threading it through ONE wrapper's kernel closure, not three copies
+    of this plumbing."""
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    if hkv % n_dev:
+        raise ValueError(f"kv heads {hkv} not divisible by mesh size {n_dev}")
+    q_spec = P(None, axis_name, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(q_spec, *operand_specs),
+        out_specs=q_spec,
+    )
+    def run(q_local, *ops):
+        return kernel(q_local, *ops)
+
+    return run(q, *operands)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "scale", "block_k", "interpret",
-                     "softcap"),
+                     "softcap", "window", "sinks"),
 )
 def head_sharded_decode(
     q: jax.Array,        # (B, H, d)
@@ -52,6 +85,8 @@ def head_sharded_decode(
     block_k: int = 2048,
     interpret: bool | None = None,
     softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
 ) -> jax.Array:
     """Tensor-parallel decode: KV heads sharded, zero collectives.
 
@@ -60,33 +95,113 @@ def head_sharded_decode(
     [r·H/R, (r+1)·H/R) and exactly their kv heads [r·Hkv/R, ...)), so
     each chip runs a complete :func:`flash_decode` on its slice.
     """
-    if mesh is None:
-        mesh = default_mesh(axis_name)
-    n_dev = mesh.shape[axis_name]
-    b, h, d = q.shape
-    hkv = k_cache.shape[1]
-    if hkv % n_dev:
-        raise ValueError(f"kv heads {hkv} not divisible by mesh size {n_dev}")
-    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
-
-    q_spec = P(None, axis_name, None)
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (q.shape[0],))
     c_spec = P(None, axis_name, None, None)
 
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(q_spec, c_spec, c_spec, P(None)),
-        out_specs=q_spec,
-    )
-    def run(q_local, k_local, v_local, lens_full):
+    def kernel(q_local, k_local, v_local, lens_full):
         return flash_decode(
             q_local, k_local, v_local, lens_full,
             scale=scale, block_k=block_k, interpret=interpret,
-            softcap=softcap,
+            softcap=softcap, window=window, sinks=sinks,
         )
 
-    return run(q, k_cache, v_cache, lens)
+    return _head_sharded_call(
+        q, k_cache.shape[1], mesh, axis_name, kernel,
+        (k_cache, v_cache, lens), (c_spec, c_spec, P(None)),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "scale", "block_k", "interpret",
+                     "softcap", "window", "sinks"),
+)
+def head_sharded_decode_quantized(
+    q: jax.Array,  # (B, H, d)
+    cache,         # ops.quant.QuantizedKV (int8 values + fp32 scales)
+    lengths: jax.Array,  # (B,) or scalar
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "tp",
+    scale: float | None = None,
+    block_k: int = 4096,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """Tensor-parallel decode against an int8 KV cache.
+
+    The same contiguous-head-chunk layout as :func:`head_sharded_decode`
+    applied to every field of the ``QuantizedKV`` pytree (values AND
+    their sublane-replicated scales shard along the KV-head dim), so
+    each chip runs a complete :func:`flash_decode_quantized` on its
+    slice — zero collectives per token, at 0.63x the per-chip cache HBM
+    of the bf16 path.  ``window``/``sinks`` serve sliding-window models
+    through the same sharding.
+    """
+    from attention_tpu.ops.quant import QuantizedKV, flash_decode_quantized
+
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (q.shape[0],))
+    f_spec = P(None, axis_name, None, None)  # every field: (B, Hkv, ...)
+    cache_specs = QuantizedKV(f_spec, f_spec, f_spec, f_spec)
+
+    def kernel(q_local, cache_local, lens_full):
+        return flash_decode_quantized(
+            q_local, cache_local, lens_full,
+            scale=scale, block_k=block_k, interpret=interpret,
+            softcap=softcap, window=window, sinks=sinks,
+        )
+
+    return _head_sharded_call(
+        q, cache.k_q.shape[1], mesh, axis_name, kernel,
+        (cache, lens), (cache_specs, P(None)),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "scale", "interpret", "softcap",
+                     "window", "sinks"),
+)
+def head_sharded_decode_paged(
+    q: jax.Array,  # (B, H, d)
+    cache,         # ops.paged.PagedKV (pools + page table + lengths)
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "tp",
+    scale: float | None = None,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """Tensor-parallel decode through a paged KV pool.
+
+    The physical pools (P, Hkv, page_size, d) shard along their KV-head
+    dim; the page table and lengths replicate (page ids are head-
+    agnostic), so each chip translates the same logical pages into its
+    own head slice of the pool and runs a complete
+    :func:`paged_flash_decode` — zero collectives per token.  A serving
+    stack can therefore combine prefix sharing (forked page tables) with
+    tensor parallelism without resharding the pool.
+    """
+    from attention_tpu.ops.paged import PagedKV, paged_flash_decode
+
+    pool_spec = P(None, axis_name, None, None)
+    cache_specs = PagedKV(pool_spec, pool_spec, P(None, None), P(None))
+
+    def kernel(q_local, cache_local):
+        return paged_flash_decode(
+            q_local, cache_local,
+            scale=scale, interpret=interpret,
+            softcap=softcap, window=window, sinks=sinks,
+        )
+
+    return _head_sharded_call(
+        q, cache.k_pool.shape[1], mesh, axis_name, kernel,
+        (cache,), (cache_specs,),
+    )
 
 
 @functools.partial(

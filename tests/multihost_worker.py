@@ -85,6 +85,33 @@ def main() -> int:
                    np.asarray(lsum[pid]))
     np.testing.assert_allclose(got[0, 0], want, atol=1e-5)
 
+    # Phase 2: a FULL context-parallel train step across the processes —
+    # the flash custom VJP under each host's local sp axis (ICI analog),
+    # the data-parallel gradient psum crossing processes (DCN analog).
+    # This is the reference's whole multi-node story (kernel + comm in
+    # one orchestrated step over `mpirun` ranks, `attention-mpi.c`) run
+    # as multi-controller training.  Every process builds identical
+    # global values (single-controller semantics) and reports the loss;
+    # the parent test matches it against a one-process 8-device run of
+    # the same config.
+    from attention_tpu.models.train import init_sharded, make_train_step
+    from attention_tpu.models.transformer import TinyDecoder
+
+    mesh2 = hybrid_mesh(inner_axis="sp", outer_axis="dp")
+    model = TinyDecoder(vocab=32, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", cp_axis="sp",
+                        mesh=mesh2, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, 32, (2, 33)), jnp.int32
+    )
+    params, opt, opt_state = init_sharded(model, mesh2, batch=2, seq=32)
+    step = make_train_step(model, opt, mesh2)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    params, opt_state, loss2 = step(params, opt_state, tokens)
+    l1, l2 = float(loss), float(loss2)
+    assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1, (l1, l2)
+    print(f"proc {pid}: cp-loss {l1:.6f} {l2:.6f}", flush=True)
+
     print(f"proc {pid}: OK", flush=True)
     return 0
 

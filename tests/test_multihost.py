@@ -58,3 +58,36 @@ def test_two_process_hybrid_mesh_merge():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"proc {pid}: OK" in out, out
+
+    # Phase-2 cross-check: every process reported the same cp-train-step
+    # losses (the DCN-analog gradient psum kept them in lockstep), and
+    # they match a single-controller run of the IDENTICAL config on this
+    # process's 8 devices reshaped to the same (dp=2, sp=4) mesh.
+    import re
+
+    losses = sorted(set(re.findall(r"cp-loss ([\d.]+) ([\d.]+)", "".join(outs))))
+    assert len(losses) == 1, f"processes disagree: {losses}"
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from attention_tpu.models.train import init_sharded, make_train_step
+    from attention_tpu.models.transformer import TinyDecoder
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    model = TinyDecoder(vocab=32, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", cp_axis="sp",
+                        mesh=mesh, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, 32, (2, 33)), jnp.int32
+    )
+    params, opt, opt_state = init_sharded(model, mesh, batch=2, seq=32)
+    step = make_train_step(model, opt, mesh)
+    params, opt_state, l1 = step(params, opt_state, tokens)
+    params, opt_state, l2 = step(params, opt_state, tokens)
+    np.testing.assert_allclose(
+        [float(x) for x in losses[0]], [float(l1), float(l2)], atol=1e-4,
+        err_msg="multi-process cp losses != single-controller losses",
+    )

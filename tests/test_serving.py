@@ -80,3 +80,77 @@ def test_head_sharded_bf16_tolerance(rng):
     want = np.asarray(flash_decode(q, kc, vc, 200), np.float32)
     # the reference's ±0.02 mixed-precision contract (attention.c:143)
     np.testing.assert_allclose(got, want, atol=0.02)
+
+
+def test_head_sharded_quantized_matches_single_device(rng):
+    """int8 serving under tensor parallelism: every QuantizedKV field
+    (values AND sublane-replicated scales) shards along the KV-head
+    dim; per-shard decode must equal the unsharded int8 kernel."""
+    from attention_tpu.ops.quant import flash_decode_quantized, quantize_kv
+    from attention_tpu.parallel import head_sharded_decode_quantized
+
+    q, kc, vc = _setup(rng, 2, 8, 4, 512, 64)
+    cache = quantize_kv(kc, vc)
+    lens = jnp.asarray([512, 77], jnp.int32)
+    mesh = default_mesh("tp", devices=jax.devices()[:4])
+    got = head_sharded_decode_quantized(q, cache, lens, mesh=mesh,
+                                        block_k=128)
+    want = flash_decode_quantized(q, cache, lens, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_head_sharded_quantized_window_sinks(rng):
+    from attention_tpu.ops.quant import flash_decode_quantized, quantize_kv
+    from attention_tpu.parallel import head_sharded_decode_quantized
+
+    q, kc, vc = _setup(rng, 2, 8, 4, 512, 64)
+    cache = quantize_kv(kc, vc)
+    lens = jnp.asarray([512, 300], jnp.int32)
+    mesh = default_mesh("tp", devices=jax.devices()[:4])
+    kw = dict(window=128, sinks=4, block_k=128)
+    got = head_sharded_decode_quantized(q, cache, lens, mesh=mesh, **kw)
+    want = flash_decode_quantized(q, cache, lens, **kw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_head_sharded_paged_matches_single_device(rng):
+    """Paged serving under tensor parallelism: pools shard by KV head,
+    the (head-agnostic) page table and lengths replicate — prefix
+    sharing composes with tensor parallelism without resharding."""
+    from attention_tpu.ops.paged import PagedKV, paged_flash_decode
+    from attention_tpu.parallel import head_sharded_decode_paged
+
+    b, h, hkv, d, page, npages = 2, 8, 4, 64, 128, 10
+    q = jnp.asarray(rng.standard_normal((b, h, d)), np.float32)
+    k_pool = jnp.asarray(
+        rng.standard_normal((npages, hkv, page, d)), np.float32)
+    v_pool = jnp.asarray(
+        rng.standard_normal((npages, hkv, page, d)), np.float32)
+    # scrambled physical pages, 4 logical pages per sequence
+    table = jnp.asarray([[7, 2, 9, 0], [3, 8, 1, 5]], jnp.int32)
+    cache = PagedKV(k_pool, v_pool, table, jnp.asarray([512, 300],
+                                                       jnp.int32))
+    mesh = default_mesh("tp", devices=jax.devices()[:4])
+    got = head_sharded_decode_paged(q, cache, mesh=mesh)
+    want = paged_flash_decode(q, cache)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_head_sharded_paged_window_sinks(rng):
+    from attention_tpu.ops.paged import PagedKV, paged_flash_decode
+    from attention_tpu.parallel import head_sharded_decode_paged
+
+    b, h, hkv, d, page, npages = 2, 8, 4, 64, 128, 10
+    q = jnp.asarray(rng.standard_normal((b, h, d)), np.float32)
+    k_pool = jnp.asarray(
+        rng.standard_normal((npages, hkv, page, d)), np.float32)
+    v_pool = jnp.asarray(
+        rng.standard_normal((npages, hkv, page, d)), np.float32)
+    table = jnp.asarray([[7, 2, 9, 0], [3, 8, 1, 5]], jnp.int32)
+    cache = PagedKV(k_pool, v_pool, table, jnp.asarray([512, 300],
+                                                       jnp.int32))
+    mesh = default_mesh("tp", devices=jax.devices()[:4])
+    kw = dict(window=128, sinks=4)
+    got = head_sharded_decode_paged(q, cache, mesh=mesh, **kw)
+    want = paged_flash_decode(q, cache, **kw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
