@@ -261,6 +261,16 @@ class GQASelfAttention(nn.Module):
     # size).  Decode/cached paths are unaffected.
     cp_axis: str | None = None
     cp_impl: str = "allgather"
+    # ``tp_axis``: tensor-parallel SERVING — every cached-path kernel
+    # call (decode on dense/rolling/ragged/int8/paged caches, chunked
+    # prefill) runs head-sharded over this mesh axis via the
+    # `parallel.serving` wrappers, while the projections around it stay
+    # in ordinary jit for XLA's auto-SPMD to partition (the same
+    # composition as cp_axis uses for training: auto-SPMD everywhere,
+    # explicit shard_map only at the Pallas kernel).  Requires
+    # ``impl='flash'`` and ``mesh``; the axis size must divide the KV
+    # head count.
+    tp_axis: str | None = None
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
@@ -279,6 +289,21 @@ class GQASelfAttention(nn.Module):
                 )
             if self.mesh is None:
                 raise ValueError("cp_axis requires mesh=")
+        if self.tp_axis is not None:
+            if self.impl != "flash":
+                raise ValueError(
+                    "tp_axis (head-sharded serving) runs the fused flash "
+                    f"kernels; impl {self.impl!r} is not supported (the "
+                    "'xla' impl already auto-partitions under jit)"
+                )
+            if self.mesh is None:
+                raise ValueError("tp_axis requires mesh=")
+            tp_size = self.mesh.shape[self.tp_axis]
+            if self.num_kv_heads % tp_size:
+                raise ValueError(
+                    f"kv heads {self.num_kv_heads} not divisible by "
+                    f"tp_axis {self.tp_axis!r} size {tp_size}"
+                )
         dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
             features=(heads, self.head_dim),
             use_bias=False,
@@ -375,6 +400,30 @@ class GQASelfAttention(nn.Module):
         )(out.astype(self.dtype))
         return proj if cache is None else (proj, cache)
 
+    def _decode_call(self, q1, kr, vc, lens, **kw):
+        """The fused decode kernel — head-sharded over ``tp_axis`` when
+        serving tensor-parallel, local otherwise.  Shared by the dense,
+        rolling, and ragged cache paths."""
+        if self.tp_axis is not None:
+            from attention_tpu.parallel.serving import head_sharded_decode
+
+            return head_sharded_decode(
+                q1, kr, vc, lens, mesh=self.mesh,
+                axis_name=self.tp_axis, **kw,
+            )
+        return flash_decode(q1, kr, vc, lens, **kw)
+
+    def _batch_flash_call(self, q, k, v, **kw):
+        """The batch flash kernel for cached prefill / chunked append —
+        head-sharded over ``tp_axis`` (`serving.head_sharded_prefill`),
+        local otherwise."""
+        if self.tp_axis is None:
+            return flash_attention(q, k, v, **kw)
+        from attention_tpu.parallel.serving import head_sharded_prefill
+
+        return head_sharded_prefill(q, k, v, mesh=self.mesh,
+                                    axis_name=self.tp_axis, **kw)
+
     def _cached_attention(self, q, k, v, cache: KVCache):
         """Append S new KV rows at ``cache.length``, attend over the
         valid prefix.  ``impl='flash'``: S == 1 -> fused flash-decode
@@ -418,13 +467,14 @@ class GQASelfAttention(nn.Module):
             # windowed decode included: the decode kernel's per-sequence
             # [len-w, len) band + pinned sinks clamps out-of-window block
             # DMAs, so bandwidth scales with the window, not the prefix
-            out = flash_decode(q[:, :, 0, :], kr, vc, new_len,
-                               softcap=self.softcap, window=self.window,
-                               sinks=self.attn_sinks or None)[:, :, None, :]
+            out = self._decode_call(
+                q[:, :, 0, :], kr, vc, new_len,
+                softcap=self.softcap, window=self.window,
+                sinks=self.attn_sinks or None)[:, :, None, :]
         else:
             # chunked prefill / multi-token append: the banded flash
             # kernel applies the window over the cache
-            out = flash_attention(
+            out = self._batch_flash_call(
                 q, kr, vc, causal=self.causal,
                 q_offset=cache.length, kv_valid=new_len, window=self.window,
                 softcap=self.softcap,
@@ -482,15 +532,16 @@ class GQASelfAttention(nn.Module):
             if self.rope and sinks:
                 kr = _sink_read_keys(kc, cache.length + 1, ring, sinks,
                                      self.rope_theta)
-            out = flash_decode(q[:, :, 0, :], kr, vc, valid,
-                               softcap=self.softcap)[:, :, None, :]
+            out = self._decode_call(q[:, :, 0, :], kr, vc, valid,
+                                    softcap=self.softcap)[:, :, None, :]
         else:
             # fresh-cache prefill: the chunk sees only itself.  A
             # non-fresh cache would silently drop in-window history, so
             # poison that case loudly (the convention of this module).
-            out = flash_attention(q, k, v, causal=True, window=self.window,
-                                  softcap=self.softcap,
-                                  sinks=sinks or None)
+            out = self._batch_flash_call(q, k, v, causal=True,
+                                         window=self.window,
+                                         softcap=self.softcap,
+                                         sinks=sinks or None)
             out = jnp.where(cache.length == 0, out, jnp.nan).astype(out.dtype)
             kc, vc = cache.k, cache.v
             sink_keep = min(s_new, sinks)
@@ -560,7 +611,7 @@ class GQASelfAttention(nn.Module):
         if self.rope and self.attn_sinks and self.window is not None:
             kr = _sink_read_keys(kc, new_lengths, self.window,
                                  self.attn_sinks, self.rope_theta)
-        out = flash_decode(
+        out = self._decode_call(
             q[:, :, 0, :], kr, vc, new_lengths, softcap=self.softcap,
             window=self.window, sinks=self.attn_sinks or None,
         )[:, :, None, :]
@@ -582,6 +633,14 @@ class GQASelfAttention(nn.Module):
                 "a dense KVCache, then ops.paged.paged_from_dense"
             )
         cache = paged_append(cache, k, v)
+        if (self.tp_axis is not None and self.rope and self.attn_sinks
+                and self.window is not None):
+            raise ValueError(
+                "rope+sinks on the paged cache reads a per-sequence "
+                "rotated sink copy (paged_sink_decode), which has no "
+                "head-sharded form yet; serve rope+sink models "
+                "tensor-parallel on the dense/ragged/int8 caches"
+            )
         if self.rope and self.attn_sinks and self.window is not None:
             # in-cache sink re-rotation can't touch pool pages (they may
             # be prefix-shared across sequences with different deltas);
@@ -595,6 +654,16 @@ class GQASelfAttention(nn.Module):
                 q[:, :, 0, :], cache, window=self.window,
                 sinks=self.attn_sinks, theta=self.rope_theta,
                 softcap=self.softcap,
+            )[:, :, None, :]
+        elif self.tp_axis is not None:
+            from attention_tpu.parallel.serving import (
+                head_sharded_decode_paged,
+            )
+
+            out = head_sharded_decode_paged(
+                q[:, :, 0, :], cache, mesh=self.mesh,
+                axis_name=self.tp_axis, softcap=self.softcap,
+                window=self.window, sinks=self.attn_sinks or None,
             )[:, :, None, :]
         else:
             out = paged_flash_decode(
@@ -625,9 +694,19 @@ class GQASelfAttention(nn.Module):
             # so — unlike paged pool pages — re-rotation is legal)
             kr = sink_read_rotation(kv, new_len, self.window,
                                     self.attn_sinks, self.rope_theta)
-        out = flash_decode_quantized(q[:, :, 0, :], kr, new_len,
-                                     softcap=self.softcap,
-                                     window=self.window,
-                                     sinks=self.attn_sinks or None)
+        if self.tp_axis is not None:
+            from attention_tpu.parallel.serving import (
+                head_sharded_decode_quantized,
+            )
+
+            out = head_sharded_decode_quantized(
+                q[:, :, 0, :], kr, new_len, mesh=self.mesh,
+                axis_name=self.tp_axis, softcap=self.softcap,
+                window=self.window, sinks=self.attn_sinks or None)
+        else:
+            out = flash_decode_quantized(q[:, :, 0, :], kr, new_len,
+                                         softcap=self.softcap,
+                                         window=self.window,
+                                         sinks=self.attn_sinks or None)
         # overflow already NaN-poisons via update_quantized_kv's scales
         return out[:, :, None, :].astype(q.dtype), QuantKVCache(kv, new_len)

@@ -50,6 +50,7 @@ class TransformerBlock(nn.Module):
     ep_axis: str | None = None
     cp_axis: str | None = None  # context-parallel attention (needs mesh)
     cp_impl: str = "allgather"  # "ring"/"zigzag" (O(n/R) KV) or "ulysses"
+    tp_axis: str | None = None  # head-sharded serving on cached paths
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
@@ -69,6 +70,7 @@ class TransformerBlock(nn.Module):
             softcap=self.softcap,
             cp_axis=self.cp_axis,
             cp_impl=self.cp_impl,
+            tp_axis=self.tp_axis,
             mesh=self.mesh,
         )(y, cache)
         if cache is not None:
@@ -121,6 +123,12 @@ class TinyDecoder(nn.Module):
     # the framework's own kernels rather than XLA's auto-SPMD einsums.
     cp_axis: str | None = None
     cp_impl: str = "allgather"  # or "ring"/"zigzag"/"ulysses"
+    # Tensor-parallel serving: every cached-path kernel call (decode on
+    # any cache type, chunked prefill) runs head-sharded over
+    # ``tp_axis`` via `parallel.serving`, with the projections left to
+    # XLA auto-SPMD — generate()/generate_ragged()/... then serve
+    # tensor-parallel with the framework's own kernels.
+    tp_axis: str | None = None
     mesh: "jax.sharding.Mesh | None" = None
 
     @nn.compact
@@ -154,6 +162,7 @@ class TinyDecoder(nn.Module):
                 ep_axis=self.ep_axis,
                 cp_axis=self.cp_axis,
                 cp_impl=self.cp_impl,
+                tp_axis=self.tp_axis,
                 mesh=self.mesh,
                 name=f"TransformerBlock_{i}",
             )

@@ -53,7 +53,8 @@ def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
     n_dev = mesh.shape[axis_name]
     if hkv % n_dev:
         raise ValueError(f"kv heads {hkv} not divisible by mesh size {n_dev}")
-    q_spec = P(None, axis_name, None)
+    # q is (B, H, d) for decode, (B, H, S, d) for prefill — heads at dim 1
+    q_spec = P(None, axis_name, *([None] * (q.ndim - 2)))
 
     @functools.partial(
         jax.shard_map,
@@ -66,6 +67,25 @@ def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
         return kernel(q_local, *ops)
 
     return run(q, *operands)
+
+
+def head_sharded_prefill(q, k, v, *, mesh=None, axis_name="tp", **kw):
+    """Batch flash attention (cached prefill / chunked append) with the
+    heads sharded over ``axis_name`` — per-head math is independent, so
+    the shard_map needs no collectives and contiguous head chunks keep
+    GQA groups aligned.  ``kw`` passes straight to
+    :func:`ops.flash.flash_attention`; traced scalars in it (q_offset,
+    kv_valid) ride in as replicated closures.  Shapes: (B, H, S, d)."""
+    from attention_tpu.ops.flash import flash_attention
+
+    spec = P(None, axis_name, None, None)
+
+    def kernel(q_local, k_local, v_local):
+        return flash_attention(q_local, k_local, v_local, **kw)
+
+    return _head_sharded_call(
+        q, k.shape[1], mesh, axis_name, kernel, (k, v), (spec, spec),
+    )
 
 
 @functools.partial(
