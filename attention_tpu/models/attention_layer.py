@@ -298,6 +298,11 @@ class GQASelfAttention(nn.Module):
                 )
             if self.mesh is None:
                 raise ValueError("tp_axis requires mesh=")
+            if self.tp_axis not in self.mesh.shape:
+                raise ValueError(
+                    f"tp_axis {self.tp_axis!r} is not an axis of the "
+                    f"mesh {tuple(self.mesh.axis_names)}"
+                )
             tp_size = self.mesh.shape[self.tp_axis]
             if self.num_kv_heads % tp_size:
                 raise ValueError(
@@ -633,15 +638,14 @@ class GQASelfAttention(nn.Module):
                 "a dense KVCache, then ops.paged.paged_from_dense"
             )
         cache = paged_append(cache, k, v)
-        if (self.tp_axis is not None and self.rope and self.attn_sinks
-                and self.window is not None):
-            raise ValueError(
-                "rope+sinks on the paged cache reads a per-sequence "
-                "rotated sink copy (paged_sink_decode), which has no "
-                "head-sharded form yet; serve rope+sink models "
-                "tensor-parallel on the dense/ragged/int8 caches"
-            )
         if self.rope and self.attn_sinks and self.window is not None:
+            if self.tp_axis is not None:
+                raise ValueError(
+                    "rope+sinks on the paged cache reads a per-sequence "
+                    "rotated sink copy (paged_sink_decode), which has no "
+                    "head-sharded form yet; serve rope+sink models "
+                    "tensor-parallel on the dense/ragged/int8 caches"
+                )
             # in-cache sink re-rotation can't touch pool pages (they may
             # be prefix-shared across sequences with different deltas);
             # paged_sink_decode instead rotates a per-sequence READ COPY

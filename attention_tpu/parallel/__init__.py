@@ -15,5 +15,6 @@ from attention_tpu.parallel.serving import (  # noqa: F401
     head_sharded_decode,
     head_sharded_decode_paged,
     head_sharded_decode_quantized,
+    head_sharded_prefill,
 )
 from attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -20,6 +20,9 @@ the way the batch path does:
     types (values+scales / pools shard by KV head; page tables
     replicate), so every cache type the framework serves also serves
     sharded.
+  * :func:`head_sharded_prefill` — the batch flash kernel (cached
+    prefill / chunked append) under the same head sharding, so a
+    ``tp_axis`` model's whole generate loop stays sharded.
 
 Both are `shard_map`s over a 1D mesh axis and compose with an outer
 batch/data-parallel axis via pjit.

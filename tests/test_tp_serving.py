@@ -116,3 +116,27 @@ def test_tp_rejects_indivisible_kv_heads(rng):
     with pytest.raises(ValueError, match="not divisible"):
         TinyDecoder(tp_axis="tp", mesh=_mesh(4), **cfg).init(
             jax.random.PRNGKey(0), tok)
+
+
+def test_tp_speculative_matches_target_greedy(rng):
+    """Speculative decoding composes with tp serving: the verify chunk
+    is a multi-token cached append, exercising head_sharded_prefill
+    with a nonzero q_offset; output stays exactly target-greedy."""
+    from attention_tpu.models.speculative import generate_speculative
+
+    mesh = _mesh(2)
+    tkw = dict(vocab=41, dim=64, depth=2, num_q_heads=4, num_kv_heads=2,
+               impl="flash", dtype=jnp.float32)
+    dkw = dict(vocab=41, dim=32, depth=1, num_q_heads=2, num_kv_heads=2,
+               impl="flash", dtype=jnp.float32)
+    t1 = TinyDecoder(**tkw)
+    t2 = TinyDecoder(tp_axis="tp", mesh=mesh, **tkw)
+    d2 = TinyDecoder(tp_axis="tp", mesh=mesh, **dkw)
+    prompt = jnp.asarray(rng.integers(0, 41, (1, 7)), jnp.int32)
+    tparams = t1.init(jax.random.PRNGKey(0), prompt)["params"]
+    dparams = TinyDecoder(**dkw).init(jax.random.PRNGKey(1),
+                                      prompt)["params"]
+    want = np.asarray(generate(t1, tparams, prompt, steps=10))
+    got = np.asarray(generate_speculative(
+        t2, tparams, d2, dparams, prompt, steps=10, gamma=3))
+    np.testing.assert_array_equal(got, want)
