@@ -12,6 +12,11 @@ from attention_tpu.models.pipeline import (  # noqa: F401
     pipelined_forward,
 )
 from attention_tpu.models.resilient import train_with_recovery  # noqa: F401
+from attention_tpu.models.seq2seq import (  # noqa: F401
+    TinySeq2Seq,
+    generate_seq2seq,
+    seq2seq_loss,
+)
 from attention_tpu.models.speculative import generate_speculative  # noqa: F401
 from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # noqa: F401
 from attention_tpu.models.decode import (  # noqa: F401

@@ -1,0 +1,264 @@
+"""Encoder-decoder (seq2seq) model family.
+
+The reference kernel takes independent m and n (`attention.c:20-75`), so
+cross-shaped attention is native to the framework's ops; this module is
+the model family that actually USES it — a bidirectional encoder over
+the source, a causal cached decoder over the target, and per-layer
+cross-attention from the decoder stream into the encoded memory
+(`GQACrossAttention`), assembled into training and generation flows.
+Before this module the cross-attention layer existed standalone; the
+repeated lesson of this repo (training round 2, serving round 3) is
+that components must be composed into the flows users run, not exist
+beside them.
+
+Serving shape: ``encode`` runs once, ``project_memory`` projects each
+decoder layer's cross K/V once (reused across every decode step — the
+``GQACrossAttention.project_kv`` contract), and the token loop is the
+same one-jit ``lax.scan`` of cached self-attention steps the decoder
+family uses.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from attention_tpu.models.attention_layer import GQASelfAttention, KVCache
+from attention_tpu.models.cross_attention import GQACrossAttention
+from attention_tpu.models.transformer import MLP
+
+
+class EncoderBlock(nn.Module):
+    """Pre-norm bidirectional block: full (non-causal) self-attention
+    over the source sequence + MLP.  ``rope`` gives the encoder its
+    source positions — without them embed+attention+MLP are all
+    permutation-equivariant and cross-attention is permutation-invariant
+    over memory rows, i.e. the model could not represent source word
+    order at all."""
+
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    impl: str = "flash"
+    dtype: jnp.dtype = jnp.bfloat16
+    rope: bool = True
+    softcap: float | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.RMSNorm(dtype=self.dtype)(x)
+        x = x + GQASelfAttention(
+            num_q_heads=self.num_q_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            impl=self.impl,
+            causal=False,
+            dtype=self.dtype,
+            rope=self.rope,
+            softcap=self.softcap,
+        )(y)
+        y = nn.RMSNorm(dtype=self.dtype)(x)
+        return x + MLP(dtype=self.dtype)(y)
+
+
+class Seq2SeqDecoderBlock(nn.Module):
+    """Pre-norm decoder block: causal (cached) self-attention, then
+    cross-attention into the encoded memory, then MLP."""
+
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    impl: str = "flash"
+    dtype: jnp.dtype = jnp.bfloat16
+    rope: bool = False
+    softcap: float | None = None
+
+    def setup(self):
+        self.self_attn = GQASelfAttention(
+            num_q_heads=self.num_q_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            impl=self.impl,
+            causal=True,
+            dtype=self.dtype,
+            rope=self.rope,
+            softcap=self.softcap,
+        )
+        self.cross_attn = GQACrossAttention(
+            num_q_heads=self.num_q_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            impl=self.impl,
+            dtype=self.dtype,
+            softcap=self.softcap,
+        )
+        self.norm_self = nn.RMSNorm(dtype=self.dtype)
+        self.norm_cross = nn.RMSNorm(dtype=self.dtype)
+        self.norm_mlp = nn.RMSNorm(dtype=self.dtype)
+        self.mlp = MLP(dtype=self.dtype)
+
+    def __call__(self, x, memory=None, cross_kv=None, cache=None):
+        y = self.norm_self(x)
+        sa = self.self_attn(y, cache)
+        if cache is not None:
+            sa, cache = sa
+        x = x + sa
+        y = self.norm_cross(x)
+        x = x + self.cross_attn(y, memory=memory, kv=cross_kv)
+        y = self.norm_mlp(x)
+        x = x + self.mlp(y)
+        return x if cache is None else (x, cache)
+
+
+class TinySeq2Seq(nn.Module):
+    """Encoder-decoder LM: ``__call__(src, tgt)`` -> (B, S_tgt, vocab)
+    teacher-forcing logits; ``encode``/``project_memory``/``decode``
+    split the flow for cached generation (:func:`generate_seq2seq`)."""
+
+    vocab: int
+    dim: int = 128
+    enc_depth: int = 2
+    dec_depth: int = 2
+    num_q_heads: int = 4
+    num_kv_heads: int = 2
+    impl: str = "flash"
+    dtype: jnp.dtype = jnp.bfloat16
+    rope: bool = True  # positions for encoder AND decoder self-attention
+    softcap: float | None = None
+
+    def setup(self):
+        head_dim = self.dim // self.num_q_heads
+        self.embed_src = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        self.embed_tgt = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        self.enc_blocks = [
+            EncoderBlock(
+                num_q_heads=self.num_q_heads,
+                num_kv_heads=self.num_kv_heads,
+                head_dim=head_dim,
+                impl=self.impl,
+                dtype=self.dtype,
+                rope=self.rope,
+                softcap=self.softcap,
+            )
+            for _ in range(self.enc_depth)
+        ]
+        self.enc_norm = nn.RMSNorm(dtype=self.dtype)
+        self.dec_blocks = [
+            Seq2SeqDecoderBlock(
+                num_q_heads=self.num_q_heads,
+                num_kv_heads=self.num_kv_heads,
+                head_dim=head_dim,
+                impl=self.impl,
+                dtype=self.dtype,
+                rope=self.rope,
+                softcap=self.softcap,
+            )
+            for _ in range(self.dec_depth)
+        ]
+        self.dec_norm = nn.RMSNorm(dtype=self.dtype)
+        self.lm_head = nn.Dense(self.vocab, use_bias=False,
+                                dtype=self.dtype)
+
+    def encode(self, src: jax.Array) -> jax.Array:
+        """(B, S_src) int32 -> (B, S_src, D) memory."""
+        x = self.embed_src(src)
+        for blk in self.enc_blocks:
+            x = blk(x)
+        return self.enc_norm(x)
+
+    def project_memory(self, memory: jax.Array):
+        """Each decoder layer's cross K/V, projected ONCE for reuse
+        across every decode step — `GQACrossAttention.project_kv`
+        applied inside the module (no param-tree spelunking for
+        callers).  Returns a tuple of (B, Hkv, T, dh) pairs."""
+        p = self.variables["params"]
+        return tuple(
+            self.dec_blocks[i].cross_attn.project_kv(
+                p[f"dec_blocks_{i}"]["cross_attn"], memory
+            )
+            for i in range(self.dec_depth)
+        )
+
+    def decode(self, tgt: jax.Array, memory=None, cross_kvs=None,
+               caches=None):
+        """Teacher-forcing (caches=None) or cached step.  Pass either
+        ``memory`` (projects cross K/V inline — training) or
+        ``cross_kvs`` from :meth:`project_memory` (serving)."""
+        x = self.embed_tgt(tgt)
+        new_caches = []
+        for i, blk in enumerate(self.dec_blocks):
+            kv = None if cross_kvs is None else cross_kvs[i]
+            if caches is None:
+                x = blk(x, memory=memory, cross_kv=kv)
+            else:
+                x, c = blk(x, memory=memory, cross_kv=kv,
+                           cache=caches[i])
+                new_caches.append(c)
+        x = self.dec_norm(x)
+        logits = self.lm_head(x).astype(jnp.float32)
+        return logits if caches is None else (logits, tuple(new_caches))
+
+    def __call__(self, src: jax.Array, tgt: jax.Array) -> jax.Array:
+        return self.decode(tgt, memory=self.encode(src))
+
+    def init_caches(self, batch: int, capacity: int,
+                    cache_dtype=None) -> tuple:
+        head_dim = self.dim // self.num_q_heads
+        return tuple(
+            KVCache.create(batch, self.num_kv_heads, capacity, head_dim,
+                           cache_dtype or self.dtype)
+            for _ in range(self.dec_depth)
+        )
+
+
+def seq2seq_loss(params, model: TinySeq2Seq, src: jax.Array,
+                 tgt: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy of ``tgt[1:]`` given ``tgt[:-1]``
+    and the encoded ``src`` (teacher forcing)."""
+    logits = model.apply({"params": params}, src, tgt[:, :-1])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, tgt[:, 1:, None], axis=-1)
+    return -jnp.mean(picked)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "steps", "capacity"))
+def generate_seq2seq(
+    model: TinySeq2Seq,
+    params,
+    src: jax.Array,  # (B, S_src) int32
+    *,
+    steps: int,
+    bos: int = 1,
+    capacity: int | None = None,
+) -> jax.Array:
+    """Greedy seq2seq generation: encode once, project each layer's
+    cross K/V once, then one `lax.scan` of cached decode steps —
+    (B, steps) continuation starting from ``bos``."""
+    b, _ = src.shape
+    if capacity is not None and capacity < steps + 1:
+        raise ValueError(
+            f"capacity {capacity} < steps+1 ({steps + 1}): the decode "
+            "cache would overflow (and NaN-poison) mid-generation"
+        )
+    # the decode kernel's cache capacity granule is 128 rows
+    capacity = -(-(capacity or steps + 1) // 128) * 128
+    memory = model.apply({"params": params}, src, method=model.encode)
+    cross_kvs = model.apply({"params": params}, memory,
+                            method=model.project_memory)
+    caches = model.init_caches(b, capacity)
+    tok0 = jnp.full((b,), bos, jnp.int32)
+
+    def step(carry, _):
+        tok, caches = carry
+        logits, caches = model.apply(
+            {"params": params}, tok[:, None], cross_kvs=cross_kvs,
+            caches=caches, method=model.decode,
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (nxt, caches), nxt
+
+    (_, _), toks = jax.lax.scan(step, (tok0, caches), None, length=steps)
+    return toks.T  # (B, steps)
