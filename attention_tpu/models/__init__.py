@@ -22,6 +22,7 @@ from attention_tpu.models.transformer import TransformerBlock, TinyDecoder  # no
 from attention_tpu.models.decode import (  # noqa: F401
     decode_step,
     generate,
+    generate_beam,
     generate_paged,
     generate_ragged,
     prefill,

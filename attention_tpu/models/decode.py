@@ -98,6 +98,20 @@ def _validate_sampling(model, temperature, top_k, top_p, rng):
     return rng
 
 
+def _resolve_capacity(s: int, steps: int, capacity: int | None) -> int:
+    """The dense-cache capacity contract, in ONE place: default to the
+    smallest 128-multiple holding prompt+steps; reject a caller value
+    that is short (the cache would overflow and NaN-poison) or off the
+    flash_decode 128-row granule."""
+    if capacity is None:
+        return -(-(s + steps) // 128) * 128
+    if capacity < s + steps or capacity % 128:
+        raise ValueError(
+            f"capacity {capacity} must be a 128-multiple >= {s + steps}"
+        )
+    return capacity
+
+
 def generate(
     model: TinyDecoder,
     params,
@@ -163,18 +177,9 @@ def _generate_jit(
         logits, caches = model.apply({"params": params}, prompt, caches)
         last_logits = logits[:, -1]
     else:
-        if capacity is None:
-            capacity = -(-(s + steps) // 128) * 128
-        if capacity < s + steps:
-            raise ValueError(
-                f"capacity {capacity} < prompt+steps {s + steps}"
-            )
-        if capacity % 128:
-            # flash_decode's cache-capacity contract, checked up front so
-            # the error doesn't surface from inside the jitted scan
-            raise ValueError(
-                f"capacity {capacity} must be a multiple of 128"
-            )
+        # checked up front so the error doesn't surface from inside
+        # the jitted scan
+        capacity = _resolve_capacity(s, steps, capacity)
         if int8_cache and model.impl != "flash":
             raise ValueError(
                 f"int8_cache requires impl='flash' (model has {model.impl!r})"
@@ -199,6 +204,97 @@ def _generate_jit(
     keys = jax.random.split(key_loop, steps) if sampled else None
     (_, _), toks = jax.lax.scan(step, (first, caches), keys, length=steps)
     return jnp.moveaxis(toks, 0, 1)  # (B, steps)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "steps", "beams", "capacity",
+                     "return_scores"),
+)
+def generate_beam(
+    model: TinyDecoder,
+    params,
+    prompt: jax.Array,  # (B, S) int32
+    *,
+    steps: int,
+    beams: int = 4,
+    capacity: int | None = None,
+    return_scores: bool = False,
+) -> jax.Array:
+    """Beam search: (B, S) prompt -> (B, steps) highest-total-logprob
+    continuation found over ``beams`` beams.
+
+    One jit, same machinery as greedy `generate`: one prefill at batch
+    B, caches replicated to a (B*beams)-row batch (beam-major within
+    each batch row), then a `lax.scan` whose step scores all
+    beams x vocab candidates, keeps the top ``beams`` per batch, and
+    GATHERS the KV caches along the beam dim to follow the surviving
+    hypotheses (the cache reorder is the part greedy decoding never
+    needs).  Fixed horizon, no EOS convention (the model family has
+    none) — scores are plain summed log-probabilities, so no length
+    normalization is needed.  ``beams=1`` is exactly greedy.  Dense
+    KVCache only.
+    """
+    b, s = prompt.shape
+    w = beams
+    if w < 1:
+        raise ValueError(f"beams must be >= 1, got {w}")
+    capacity = _resolve_capacity(s, steps, capacity)
+    last_logits, caches = prefill(model, params, prompt, capacity)
+    vocab = last_logits.shape[-1]
+    if w > vocab:
+        raise ValueError(f"beams {w} > vocab {vocab}")
+
+    def beam_rows(x):
+        # replicate each batch row w times: row b*w + j is beam j of b
+        return jnp.repeat(x, w, axis=0) if (
+            hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == b
+        ) else x
+
+    caches = jax.tree_util.tree_map(beam_rows, caches)
+
+    # first expansion: top-w tokens of the prefill logits seed the beams
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+    scores, tok0 = jax.lax.top_k(logp0, w)  # (B, w)
+    seqs = jnp.zeros((b, w, steps), jnp.int32)
+    seqs = seqs.at[:, :, 0].set(tok0)
+
+    def step(carry, t):
+        tok, caches, scores, seqs = carry
+        logits, caches = decode_step(model, params,
+                                     tok.reshape(b * w), caches)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        cand = scores[:, :, None] + logp.reshape(b, w, vocab)
+        new_scores, flat = jax.lax.top_k(cand.reshape(b, w * vocab), w)
+        parent = flat // vocab  # (B, w): surviving hypothesis per slot
+        token = (flat % vocab).astype(jnp.int32)
+        rows = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
+
+        def reorder(x):
+            return x[rows] if (
+                hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == b * w
+            ) else x
+
+        caches = jax.tree_util.tree_map(reorder, caches)
+        seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
+        seqs = jax.lax.dynamic_update_index_in_dim(
+            seqs, token, t, axis=2
+        )
+        return (token, caches, new_scores, seqs), None
+
+    (tok, caches, scores, seqs), _ = jax.lax.scan(
+        step, (tok0, caches, scores, seqs), jnp.arange(1, steps),
+    )
+    best = jnp.argmax(scores, axis=-1)  # (B,)
+    toks = jnp.take_along_axis(
+        seqs, best[:, None, None], axis=1
+    )[:, 0]  # (B, steps)
+    if return_scores:
+        # the step-accumulated total logprob of the returned hypothesis;
+        # must equal a teacher-forced re-score of ``toks`` (tested) —
+        # the end-to-end check on the per-step cache gather
+        return toks, jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+    return toks
 
 
 def _validate_lengths(prompt_lengths, s_max: int) -> jax.Array:
@@ -250,13 +346,7 @@ def generate_ragged(
         )
     b, s_max = prompt.shape
     lengths = _validate_lengths(prompt_lengths, s_max)
-    if capacity is None:
-        capacity = -(-(s_max + steps) // 128) * 128
-    if capacity < s_max + steps or capacity % 128:
-        raise ValueError(
-            f"capacity {capacity} must be a 128-multiple >= "
-            f"{s_max + steps}"
-        )
+    capacity = _resolve_capacity(s_max, steps, capacity)
     return _generate_ragged_jit(
         model, params, prompt, lengths,
         jnp.float32(temperature), top_p, rng,

@@ -1,0 +1,91 @@
+"""Beam-search decoding: cache-reordering correctness and score bounds.
+
+The part greedy decoding never exercises is the per-step KV-cache
+GATHER along the beam dim (surviving hypotheses adopt their parent's
+cache); these tests pin it via exactness at beams=1 and via the
+total-logprob bound (a correct beam search can never score below
+greedy, and its returned score must equal the teacher-forced re-score
+of its own tokens — a cache reorder bug breaks both).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attention_tpu.models import TinyDecoder, generate, generate_beam
+
+KW = dict(vocab=29, dim=64, depth=2, num_q_heads=4, num_kv_heads=2,
+          impl="flash", rope=True, dtype=jnp.float32)
+
+
+def _setup(rng, b=2, s=6):
+    model = TinyDecoder(**KW)
+    prompt = jnp.asarray(rng.integers(0, 29, (b, s)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    return model, params, prompt
+
+
+def _score(model, params, prompt, cont):
+    """Teacher-forced total logprob of ``cont`` given ``prompt``."""
+    full = jnp.concatenate([prompt, cont], axis=1)
+    logits = model.apply({"params": params}, full)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    s = prompt.shape[1]
+    picked = jnp.take_along_axis(
+        logp[:, s - 1:-1], cont[:, :, None], axis=-1
+    )[..., 0]
+    return np.asarray(jnp.sum(picked, axis=-1))
+
+
+def test_beam_one_equals_greedy(rng):
+    model, params, prompt = _setup(rng)
+    want = np.asarray(generate(model, params, prompt, steps=7))
+    got = np.asarray(generate_beam(model, params, prompt, steps=7,
+                                   beams=1))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_improves_on_greedy_here(rng):
+    """Empirical regression check on THIS pinned configuration (seed,
+    shapes, init key): beam-4 finds higher-total-logprob continuations
+    than greedy.  NOT a universal invariant — finite-width beam search
+    may prune the greedy path mid-search and land below it, and width
+    monotonicity doesn't hold either — so if a deliberate config change
+    flips this, re-pin rather than suspect the cache gather (that
+    invariant is test_beam_internal_score_matches_rescore's job)."""
+    model, params, prompt = _setup(rng)
+    steps = 7
+    greedy = generate(model, params, prompt, steps=steps)
+    s_greedy = _score(model, params, prompt, greedy)
+    beam = generate_beam(model, params, prompt, steps=steps, beams=4)
+    s_beam = _score(model, params, prompt, beam)
+    assert (s_beam >= s_greedy - 1e-4).all(), (s_beam, s_greedy)
+
+
+def test_beam_internal_score_matches_rescore(rng):
+    """The score beam search accumulated step by step (through the
+    reordered caches) must equal the teacher-forced re-score of the
+    tokens it returned — the end-to-end check on the per-step cache
+    gather: a wrong reorder makes the accumulated logp trajectory
+    diverge from the re-score of the same tokens."""
+    model, params, prompt = _setup(rng)
+    steps, w = 6, 3
+    beam, s_int = generate_beam(model, params, prompt, steps=steps,
+                                beams=w, return_scores=True)
+    s_re = _score(model, params, prompt, beam)
+    np.testing.assert_allclose(np.asarray(s_int), s_re, atol=1e-4)
+
+
+def test_beam_composes_with_tp_serving(rng):
+    """Beam search under a tp_axis model: the per-step beam gather
+    reorders head-sharded caches; tokens match single-device."""
+    from jax.sharding import Mesh
+
+    model, params, prompt = _setup(rng)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    m_tp = TinyDecoder(tp_axis="tp", mesh=mesh, **KW)
+    want = np.asarray(generate_beam(model, params, prompt, steps=6,
+                                    beams=3))
+    got = np.asarray(generate_beam(m_tp, params, prompt, steps=6,
+                                   beams=3))
+    np.testing.assert_array_equal(got, want)
