@@ -98,6 +98,15 @@ def _validate_sampling(model, temperature, top_k, top_p, rng):
     return rng
 
 
+def _require_flash_for_int8(model) -> None:
+    """The int8 decode path is fused-kernel only — shared precondition
+    of `generate` and `generate_beam` (one site, like _resolve_capacity)."""
+    if model.impl != "flash":
+        raise ValueError(
+            f"int8_cache requires impl='flash' (model has {model.impl!r})"
+        )
+
+
 def _resolve_capacity(s: int, steps: int, capacity: int | None) -> int:
     """The dense-cache capacity contract, in ONE place: default to the
     smallest 128-multiple holding prompt+steps; reject a caller value
@@ -180,10 +189,8 @@ def _generate_jit(
         # checked up front so the error doesn't surface from inside
         # the jitted scan
         capacity = _resolve_capacity(s, steps, capacity)
-        if int8_cache and model.impl != "flash":
-            raise ValueError(
-                f"int8_cache requires impl='flash' (model has {model.impl!r})"
-            )
+        if int8_cache:
+            _require_flash_for_int8(model)
         last_logits, caches = prefill(model, params, prompt, capacity)
         if int8_cache:
             caches = tuple(c.quantize() for c in caches)
@@ -209,7 +216,7 @@ def _generate_jit(
 @functools.partial(
     jax.jit,
     static_argnames=("model", "steps", "beams", "capacity",
-                     "return_scores"),
+                     "int8_cache", "return_scores"),
 )
 def generate_beam(
     model: TinyDecoder,
@@ -219,6 +226,7 @@ def generate_beam(
     steps: int,
     beams: int = 4,
     capacity: int | None = None,
+    int8_cache: bool = False,
     return_scores: bool = False,
 ) -> jax.Array:
     """Beam search: (B, S) prompt -> (B, steps) highest-total-logprob
@@ -232,15 +240,22 @@ def generate_beam(
     hypotheses (the cache reorder is the part greedy decoding never
     needs).  Fixed horizon, no EOS convention (the model family has
     none) — scores are plain summed log-probabilities, so no length
-    normalization is needed.  ``beams=1`` is exactly greedy.  Dense
-    KVCache only.
+    normalization is needed.  ``beams=1`` is exactly greedy.
+    ``int8_cache=True`` (flash impl only) quantizes the caches once
+    after prefill and runs the beam loop against int8 KV — the beam
+    gather is pytree-generic, so the quantized cache's value AND scale
+    arrays reorder the same way as the dense KVCache.
     """
     b, s = prompt.shape
     w = beams
     if w < 1:
         raise ValueError(f"beams must be >= 1, got {w}")
     capacity = _resolve_capacity(s, steps, capacity)
+    if int8_cache:
+        _require_flash_for_int8(model)
     last_logits, caches = prefill(model, params, prompt, capacity)
+    if int8_cache:
+        caches = tuple(c.quantize() for c in caches)
     vocab = last_logits.shape[-1]
     if w > vocab:
         raise ValueError(f"beams {w} > vocab {vocab}")
