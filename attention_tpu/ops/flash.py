@@ -42,14 +42,22 @@ _LOG2E = 1.4426950408889634  # log2(e)
 _LN2 = 0.6931471805599453  # 1/log2(e)
 
 
-def _compiler_params(semantics):
+def _compiler_params(semantics, vmem_limit_bytes=None):
     """CompilerParams with dimension semantics, tolerant of API spelling
     drift across pallas versions (shared by the forward and backward
-    kernels)."""
+    kernels).  ``vmem_limit_bytes`` raises Mosaic's scoped-VMEM budget —
+    the fused backward kernel's VMEM-resident (m_pad, d) fp32 dQ block
+    legitimately exceeds the default budget."""
+    kw = {"dimension_semantics": semantics}
+    if vmem_limit_bytes is not None:
+        kw["vmem_limit_bytes"] = vmem_limit_bytes
     try:
-        return pltpu.CompilerParams(dimension_semantics=semantics)
+        return pltpu.CompilerParams(**kw)
     except TypeError:  # older/newer param spelling
-        return None
+        try:
+            return pltpu.CompilerParams(dimension_semantics=semantics)
+        except TypeError:
+            return None
 
 
 class BlockSizes(NamedTuple):

@@ -118,6 +118,29 @@ def _recompute_p(qs, k, lse_col, *, causal, q_base, k_base,
     return p, dcap
 
 
+def _p_and_ds(qs, k, v, do, lse_ref, delta_ref, *, causal, q_base, k_base,
+              q_off, kv_off, valid, q_seg_ref, kv_seg_ref, window,
+              softcap2):
+    """Shared tile derivation for all three backward kernels: recompute
+    P from the saved lse, form dP = dO Vᵀ and dS = P ∘ (dP - D) with the
+    softcap chain factor applied.  One definition keeps the fused and
+    two-kernel gradients provably identical."""
+    p, dcap = _recompute_p(
+        qs, k, _stat_col(lse_ref), causal=causal,
+        q_base=q_base, k_base=k_base, q_off=q_off, kv_off=kv_off,
+        valid=valid, q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
+        window=window, softcap2=softcap2,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (block_q, block_k) = dO Vᵀ
+    ds = p * (dp - _stat_col(delta_ref))
+    if dcap is not None:
+        ds = ds * dcap  # chain through cap*tanh(s/cap)
+    return p, ds
+
+
 def _dq_kernel(
     offsets_ref, lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref, *rest,
     causal, block_q, block_k, scale, out_dtype, compute_dtype, segmented,
@@ -147,22 +170,15 @@ def _dq_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p, dcap = _recompute_p(
-            qs, k, _stat_col(lse_ref), causal=causal,
-            q_base=q_base, k_base=k_base,
+        qs, k = qs_ref[0], k_ref[0]
+        _, ds = _p_and_ds(
+            qs, k, v_ref[0], do_ref[0], lse_ref, delta_ref,
+            causal=causal, q_base=q_base, k_base=k_base,
             q_off=q_off, kv_off=kv_off,
             valid=offsets_ref[2] if dynamic_valid else None,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
             softcap2=softcap2,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (block_q, block_k) = dO Vᵀ
-        ds = p * (dp - _stat_col(delta_ref))
-        if dcap is not None:
-            ds = ds * dcap  # chain through cap*tanh(s/cap)
         acc_scr[...] += jax.lax.dot_general(
             ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -227,10 +243,10 @@ def _dkv_kernel(
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        qs, k, v, do = qs_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p, dcap = _recompute_p(
-            qs, k, _stat_col(lse_ref), causal=causal,
-            q_base=q_base, k_base=k_base,
+        qs, do = qs_ref[0], do_ref[0]
+        p, ds = _p_and_ds(
+            qs, k_ref[0], v_ref[0], do, lse_ref, delta_ref,
+            causal=causal, q_base=q_base, k_base=k_base,
             q_off=q_off, kv_off=kv_off,
             valid=offsets_ref[2] if dynamic_valid else None,
             q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
@@ -240,13 +256,6 @@ def _dkv_kernel(
             p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_k, dv) = Pᵀ dO — contraction over the q dim
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
-        ds = p * (dp - _stat_col(delta_ref))
-        if dcap is not None:
-            ds = ds * dcap  # chain through cap*tanh(s/cap)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(compute_dtype), qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -288,6 +297,228 @@ def _dkv_kernel(
         # Q_scaled carries scale·log2(e); ln2 restores the plain `scale`.
         dk_ref[0] = dk_scr[...] * _LN2
         dv_ref[0] = dv_scr[...]
+
+
+def _fused_bwd_kernel(
+    offsets_ref, lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref,
+    dq_ref, dkp_ref, dvp_ref, dk_scr, dv_scr, *,
+    causal, block_q, block_k, scale, compute_dtype, softcap2,
+    dynamic_valid,
+):
+    """Single-pass fused backward: S, dO·Vᵀ and dS are computed ONCE per
+    (q, kv) tile and all three gradients come out of the same sweep —
+    10·m·n·d backward matmul FLOPs, the algorithmic minimum under lse
+    recompute, vs the two-kernel path's 14·m·n·d (which re-derives S and
+    dO·Vᵀ in both kernels).
+
+    Grid is (head, kv-block, q-block) with the q sweep innermost:
+
+      * dK/dV accumulate in VMEM scratch across the q sweep and are
+        written once per (head, kv-block) — per-Q-head PARTIALS under
+        GQA (the group sum is a cheap XLA reduction outside; unlike the
+        two-kernel dK/dV kernel there is no in-kernel group run).
+      * dQ accumulates directly in its OUTPUT block: the out spec maps
+        on the head alone, so the whole (m_pad, d) fp32 buffer stays
+        VMEM-resident across the entire (kv, q) sweep of one head and is
+        DMA'd out exactly once — the revisits are all consecutive, which
+        is what makes out-ref accumulation legal.  This is also the
+        kernel's capacity bound: m_pad·d fp32 (double-buffered) must fit
+        VMEM next to the tiles, so `flash_backward` only dispatches here
+        for m_pad ≤ ~32k at d=128 (the benchmark headline shape).
+    """
+    q_off = offsets_ref[0]
+    kv_off = offsets_ref[1]
+    jb = pl.program_id(1)
+    ib = pl.program_id(2)
+    q_base = ib * block_q
+    k_base = jb * block_k
+
+    @pl.when(jnp.logical_and(jb == 0, ib == 0))
+    def _zero_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    @pl.when(ib == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        qs, k, do = qs_ref[0], k_ref[0], do_ref[0]
+        p, ds = _p_and_ds(
+            qs, k, v_ref[0], do, lse_ref, delta_ref,
+            causal=causal, q_base=q_base, k_base=k_base,
+            q_off=q_off, kv_off=kv_off,
+            valid=offsets_ref[2] if dynamic_valid else None,
+            q_seg_ref=None, kv_seg_ref=None, window=None,
+            softcap2=softcap2,
+        )
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, dv) = Pᵀ dO
+        ds_c = ds.astype(compute_dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds_c, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, d) = dSᵀ Q_scaled
+        dq_tile = jax.lax.dot_general(
+            ds_c, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, d) = dS K
+        sl = pl.dslice(q_base, block_q)
+        dq_ref[0, sl, :] += dq_tile * scale
+
+    keep = True
+    guarded = False
+    if causal:
+        # q tiles wholly above the diagonal contribute nothing
+        keep = jnp.logical_and(
+            keep, k_base + kv_off <= q_base + block_q - 1 + q_off
+        )
+        guarded = True
+    if dynamic_valid:
+        keep = jnp.logical_and(keep, k_base < offsets_ref[2])
+        guarded = True
+    if guarded:
+        pl.when(keep)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ib == pl.num_programs(2) - 1)
+    def _finalize():
+        # Q_scaled carries scale·log2(e); ln2 restores the plain `scale`.
+        dkp_ref[0] = dk_scr[...] * _LN2
+        dvp_ref[0] = dv_scr[...]
+
+
+# VMEM budget for the fused kernel's working set (dQ out block,
+# double-buffered, plus the fp32 P/dP/dS tile temporaries and the
+# double-buffered input blocks).  88 MB reproduces the on-chip
+# compile-success boundary: 512x4096 and 1024x2048 at 32k compile
+# (~70 MB by this model), 1024x4096 / 2048x2048 / 512x8192 do not
+# (~100 MB).
+_FUSED_VMEM_BUDGET = 88 * 2**20
+
+
+def _vmem_limit_supported() -> bool:
+    """The fused kernel NEEDS the raised scoped-VMEM budget; if this
+    pallas version's CompilerParams rejects `vmem_limit_bytes`, the
+    dispatch must stay on the two-kernel path rather than ship a kernel
+    that cannot compile."""
+    try:
+        pltpu.CompilerParams(dimension_semantics=("parallel",),
+                             vmem_limit_bytes=2**20)
+        return True
+    except TypeError:
+        return False
+
+
+def _fused_plan(m, n, d, dv, block_sizes, dtype):
+    """The (BlockSizes, vmem_estimate) the fused kernel would run with,
+    or None when its working set (including the caller's explicit tiles
+    and the REAL block-multiple padding) exceeds the VMEM budget."""
+    bs = block_sizes or default_fused_bwd_block_sizes(d, dtype)
+    bq = min(bs.block_q, _ceil_to(m, 128))
+    bk = min(bs.block_k, _ceil_to(n, 128))
+    m_pad = _ceil_to(m, bq)
+    itemsize = jnp.dtype(dtype).itemsize
+    vmem = (
+        2 * m_pad * d * 4           # double-buffered dQ out block
+        + 4 * bq * bk * 4           # P/dP/dS fp32 tile temporaries
+        + 2 * (bq + bk) * (d + dv) * itemsize  # in blocks, double-buffered
+        + bk * (d + dv) * 4         # dK/dV scratch accumulators
+    )
+    if vmem > _FUSED_VMEM_BUDGET:
+        return None
+    return bs
+
+
+def fused_backward_applicable(m: int, d: int, *, window, sinks,
+                              segmented: bool, n: int | None = None,
+                              dv: int | None = None,
+                              block_sizes: BlockSizes | None = None,
+                              dtype=jnp.bfloat16) -> bool:
+    """True when `flash_backward` will take the fused single-pass kernel
+    (bench.py keys its executed-FLOPs accounting off this: fused executes
+    10·mnd backward FLOPs, the two-kernel path 14·mnd)."""
+    return (window is None and sinks is None and not segmented
+            and _vmem_limit_supported()
+            and _fused_plan(m, n if n is not None else m, d,
+                            dv if dv is not None else d,
+                            block_sizes, dtype) is not None)
+
+
+def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
+                    h, hkv, m_pad, n_pad, d, dv, causal, scale,
+                    block_q, block_k, softcap, dynamic_valid, interpret):
+    """Drive `_fused_bwd_kernel`; returns (dq, dk, dv) with dk/dv already
+    group-summed (fp32)."""
+    group = h // hkv
+    num_i = m_pad // block_q
+    num_j = n_pad // block_k
+    stat_spec = pl.BlockSpec(
+        (1, block_q, _STAT_LANES), lambda hh, jj, ii, off: (hh, ii, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, num_j, num_i),
+        in_specs=[
+            stat_spec,
+            stat_spec,
+            pl.BlockSpec((1, block_q, d),
+                         lambda hh, jj, ii, off: (hh, ii, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda hh, jj, ii, off: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_k, dv),
+                         lambda hh, jj, ii, off: (hh // group, jj, 0)),
+            pl.BlockSpec((1, block_q, dv),
+                         lambda hh, jj, ii, off: (hh, ii, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, m_pad, d), lambda hh, jj, ii, off: (hh, 0, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda hh, jj, ii, off: (hh, jj, 0)),
+            pl.BlockSpec((1, block_k, dv),
+                         lambda hh, jj, ii, off: (hh, jj, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, dv), jnp.float32),
+        ],
+    )
+    dq, dkp, dvp = pl.pallas_call(
+        functools.partial(
+            _fused_bwd_kernel,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            scale=scale,
+            compute_dtype=qs.dtype,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+            dynamic_valid=dynamic_valid,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((h, m_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, n_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, n_pad, dv), jnp.float32),
+        ],
+        compiler_params=_compiler_params(
+            ("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=110 * 2**20),
+        cost_estimate=pl.CostEstimate(
+            flops=10 * h * m_pad * n_pad * d,
+            bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
+            + h * (k.size + v.size) // hkv * k.dtype.itemsize
+            + (h * m_pad * d + h * n_pad * (d + dv)) * 4,
+            transcendentals=h * m_pad * n_pad,
+        ),
+        interpret=interpret,
+    )(offsets, lse_rep, delta_rep, qs, k, v, do)
+    if group > 1:
+        dkp = dkp.reshape(hkv, group, n_pad, d).sum(axis=1)
+        dvp = dvp.reshape(hkv, group, n_pad, dv).sum(axis=1)
+    return dq, dkp, dvp
 
 
 def _sink_patch(q, k, v, out, lse, dout, *, scale, window, sinks, softcap,
@@ -368,6 +599,18 @@ def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
     return BlockSizes(512, 1024)
 
 
+def default_fused_bwd_block_sizes(d: int, dtype) -> BlockSizes:
+    """Tile defaults for the fused single-pass backward kernel (swept
+    separately from the two-kernel path: the fused kernel's VMEM also
+    holds the per-head (m_pad, d) fp32 dQ block, so its tile budget is
+    tighter).  Device-clock sweep on the real v5e chip: a wide
+    **512x4096** wins every shape tried — 32k single-head 10.32 ms (vs
+    10.66 for 1024x1024, 10.49 for 512x2048), 32k causal 6.17, GQA
+    8q/2kv 32k causal 51.2 (vs 55.9), fp32 4h/8k 3.10 (vs 3.19 for the
+    old 512x1024); 512x8192 and 1024x4096 fail to compile (VMEM)."""
+    return BlockSizes(512, 4096)
+
+
 def flash_backward(
     q: jax.Array,  # (h, m, d)
     k: jax.Array,  # (hkv, n, d)
@@ -432,11 +675,18 @@ def flash_backward(
     # though it compiles standalone), so fp32 takes 512x1024 (still 15%
     # over the old default: 8.98 vs 10.60 ms).  Larger head dims keep
     # the smallest footprint.
-    bs = block_sizes or default_bwd_block_sizes(
-        q.shape[-1], q.dtype, window)
     h, m, d = q.shape
     hkv, n, dv = v.shape
     group = h // hkv
+    use_fused = fused_backward_applicable(
+        m, d, window=window, sinks=sinks, segmented=segmented,
+        n=n, dv=dv, block_sizes=block_sizes, dtype=q.dtype)
+    if use_fused:
+        bs = _fused_plan(m, n, d, dv, block_sizes, q.dtype)
+    elif block_sizes is not None:
+        bs = block_sizes
+    else:
+        bs = default_bwd_block_sizes(q.shape[-1], q.dtype, window)
 
     # Same pre-scaled (and re-rounded) Q the forward kernel saw, so the
     # recomputed P matches the forward probabilities bit-for-bit modulo
@@ -489,6 +739,18 @@ def flash_backward(
             jnp.asarray(n if kv_valid is None else kv_valid, jnp.int32),
         ]
     )
+
+    if use_fused:
+        # single-pass fused kernel: 10·mnd executed backward FLOPs vs the
+        # two-kernel path's 14·mnd (S and dO·Vᵀ computed once, not twice)
+        dq_f, dk_f, dv_f = _fused_backward(
+            qs, k, v, lse_rep, delta_rep, do, offsets,
+            h=h, hkv=hkv, m_pad=m_pad, n_pad=n_pad, d=d, dv=dv,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            softcap=softcap, dynamic_valid=dynamic_valid,
+            interpret=interpret)
+        return (dq_f[:, :m].astype(q.dtype), dk_f[:, :n].astype(k.dtype),
+                dv_f[:, :n].astype(v.dtype))
 
     def j_abs(ii, jj, off):
         # clamp band-tail steps to the last block the row actually

@@ -510,13 +510,18 @@ def main(argv=None) -> int:
         # forward+backward at the headline shape (round-2 VERDICT #8: the
         # BENCH record carried forward-only numbers).  FLOPs accounting,
         # exact matmul counts for dk=dv=d (fwd = 4·m·n·d):
-        #   * executed: the two-kernel backward recomputes QK^T and
-        #     dO·V^T in both kernels (dq: 6mnd, dkv: 8mnd) -> fwd+bwd
-        #     executes 18mnd = 4.5x fwd; utilization of the MXU is
-        #     measured against this.
         #   * algorithmic: the math needs fwd 4mnd + bwd 10mnd (S, dP,
         #     dV, dQ, dK once each) = 3.5x fwd — the "useful" rate.
-        bwd_fl_exec = int(4.5 * flops)
+        #   * executed: the fused single-pass backward (flash_bwd.py,
+        #     round 4) computes S and dO·V^T ONCE, so it executes exactly
+        #     the algorithmic 14mnd; the two-kernel fallback (large m,
+        #     window/sinks/segments) re-derives both in each kernel and
+        #     executes 18mnd = 4.5x fwd.
+        from attention_tpu.ops.flash_bwd import fused_backward_applicable
+
+        bwd_fused = fused_backward_applicable(
+            args.seq, args.dim, window=None, sinks=None, segmented=False)
+        bwd_fl_exec = int((3.5 if bwd_fused else 4.5) * flops)
         bwd_s, bwd_ok = _measure_plausible(
             lambda: _bench_flash_s(args.seq, args.dim, args.repeats,
                                    args.block_q, args.block_k,
@@ -524,6 +529,7 @@ def main(argv=None) -> int:
                                    n_short=2, n_long=8), bwd_fl_exec)
         ladder["fwd_bwd_32k"] = {
             "ms": round(bwd_s * 1e3, 3),
+            "bwd_impl": "fused" if bwd_fused else "two_kernel",
             "util_executed_flops": round(
                 bwd_fl_exec / bwd_s / peak_flops(), 4),
             "util_algorithmic_flops": round(
