@@ -236,15 +236,20 @@ def generate_seq2seq(
 ) -> jax.Array:
     """Greedy seq2seq generation: encode once, project each layer's
     cross K/V once, then one `lax.scan` of cached decode steps —
-    (B, steps) continuation starting from ``bos``."""
+    (B, steps) continuation starting from ``bos``.
+
+    ``capacity`` follows the decoder family's contract
+    (`decode.py::_resolve_capacity`): a 128-multiple >= steps+1, or
+    None for the smallest such value.  (Earlier releases silently
+    rounded non-conforming values up; they are now rejected so both
+    generate families enforce one contract.)"""
     b, _ = src.shape
-    if capacity is not None and capacity < steps + 1:
-        raise ValueError(
-            f"capacity {capacity} < steps+1 ({steps + 1}): the decode "
-            "cache would overflow (and NaN-poison) mid-generation"
-        )
-    # the decode kernel's cache capacity granule is 128 rows
-    capacity = -(-(capacity or steps + 1) // 128) * 128
+    # one capacity contract across both generate families (the decoder
+    # fills 1 bos row + steps generated rows): default to the smallest
+    # 128-multiple, reject short or off-granule caller values
+    from attention_tpu.models.decode import _resolve_capacity
+
+    capacity = _resolve_capacity(1, steps, capacity)
     memory = model.apply({"params": params}, src, method=model.encode)
     cross_kvs = model.apply({"params": params}, memory,
                             method=model.project_memory)

@@ -4,7 +4,10 @@ from attention_tpu.parallel.mesh import (  # noqa: F401
     default_mesh,
 )
 from attention_tpu.parallel.cp import cp_flash_attention  # noqa: F401
-from attention_tpu.parallel.kv_sharded import kv_sharded_attention  # noqa: F401
+from attention_tpu.parallel.kv_sharded import (  # noqa: F401
+    kv_sharded_attention,
+    q_sharded_attention,
+)
 from attention_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 from attention_tpu.parallel.ring import (  # noqa: F401
     ring_attention,

@@ -427,6 +427,29 @@ def _ring_diff_bwd(cfg: _RingCfg, res, dout):
     dk_cur = jnp.zeros(k.shape, jnp.float32)
     dv_cur = jnp.zeros(v.shape, jnp.float32)
     k_cur, v_cur = k, v
+    dk_s = dv_s = None
+    if cfg.sinks is not None:
+        # Out-of-window sink pairs: the banded kernel covers only the
+        # window band, so the sliver supplies the rest.  The sink rows
+        # are shard 0's first `sinks` KV rows — fetch JUST that sliver
+        # once (all_gather of O(sinks·d), then shard 0's copy) and
+        # compute the patch ONCE per device instead of per ring step
+        # (it used to run every step and be where-gated off on all but
+        # one — O(n_dev · m · sinks · d) redundant work).
+        # kv_valid=None: shard 0 is always fully real (sequence padding
+        # lives in the LAST shard) and sinks <= n_local is enforced at
+        # entry, so the sink columns can't be padded.
+        from attention_tpu.ops.flash_bwd import _sink_patch
+
+        se0 = min(cfg.sinks, k.shape[-2])
+        k_sink = lax.all_gather(k[:, :se0], cfg.axis_name)[0]
+        v_sink = lax.all_gather(v[:, :se0], cfg.axis_name)[0]
+        dq_s, dk_s, dv_s, se = _sink_patch(
+            q, k_sink, v_sink, out, lse, dout, scale=cfg.scale,
+            window=cfg.window, sinks=cfg.sinks, softcap=cfg.softcap,
+            q_offset=idx * cfg.m_local,
+        )
+        dq = dq + dq_s
     for t in range(cfg.n_dev):
         if t + 1 < cfg.n_dev:
             k_next = lax.ppermute(k_cur, cfg.axis_name, perm)
@@ -457,25 +480,11 @@ def _ring_diff_bwd(cfg: _RingCfg, res, dout):
         dk_cur = dk_cur + dk_i.astype(jnp.float32)
         dv_cur = dv_cur + dv_i.astype(jnp.float32)
         if cfg.sinks is not None:
-            # out-of-window sink pairs: the banded kernel above covers
-            # only the window band, so add the sliver — gated to the
-            # step where shard 0 (the absolute sink rows) is resident,
-            # so its dK/dV land in that shard's traveling buffer
-            from attention_tpu.ops.flash_bwd import _sink_patch
-
-            # kv_valid=None: shard 0 is always fully real (sequence
-            # padding lives in the LAST shard) and sinks <= n_local is
-            # enforced at entry, so the sink columns can't be padded
-            dq_s, dk_s, dv_s, se = _sink_patch(
-                q, k_cur, v_cur, out, lse, dout, scale=cfg.scale,
-                window=cfg.window, sinks=cfg.sinks, softcap=cfg.softcap,
-                q_offset=idx * cfg.m_local,
-            )
-            # jnp.where, not a 0/1 multiply: on non-sink steps the
-            # sliver is computed against the WRONG shard's rows and may
-            # overflow — 0 * inf would poison the buffer with NaN
+            # the precomputed sink dK/dV must land in shard 0's
+            # traveling buffer — gate the (tiny) add to the step where
+            # shard 0 is resident; the sliver itself was computed once
+            # before the loop against the true sink rows
             gate = shard == 0
-            dq = dq + jnp.where(gate, dq_s, 0.0)
             dk_cur = dk_cur.at[:, :se].add(jnp.where(gate, dk_s, 0.0))
             dv_cur = dv_cur.at[:, :se].add(jnp.where(gate, dv_s, 0.0))
         if t + 1 < cfg.n_dev:
@@ -531,10 +540,14 @@ def _zig_prepare(q, k, v, n_dev):
 
 def _ring_pad_ids(q_segment_ids, kv_segment_ids, m, n, m_pad, n_pad):
     """Validate a (q_ids, kv_ids) pair and pad to the ring-padded
-    lengths with -1 (padded rows match no non-negative id).  Length
-    mismatches must fail at trace time: ``lax.dynamic_slice`` CLAMPS
-    out-of-bounds starts, so a wrong-length id vector would otherwise
-    hand shards silently wrong ids."""
+    lengths with DISTINCT negative sentinels (-1 for Q, -2 for KV):
+    padded rows match no non-negative real id, and the distinct values
+    keep padded Q rows from matching padded KV rows either — the
+    output slice-off makes that unobservable today, but the invariant
+    no longer depends on it.  Length mismatches must fail at trace
+    time: ``lax.dynamic_slice`` CLAMPS out-of-bounds starts, so a
+    wrong-length id vector would otherwise hand shards silently wrong
+    ids."""
     q_seg = jnp.asarray(q_segment_ids, jnp.int32)
     kv_seg = jnp.asarray(kv_segment_ids, jnp.int32)
     if q_seg.ndim != 1 or kv_seg.ndim != 1:
@@ -547,7 +560,7 @@ def _ring_pad_ids(q_segment_ids, kv_segment_ids, m, n, m_pad, n_pad):
     if m_pad != m:
         q_seg = jnp.pad(q_seg, (0, m_pad - m), constant_values=-1)
     if n_pad != n:
-        kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-1)
+        kv_seg = jnp.pad(kv_seg, (0, n_pad - n), constant_values=-2)
     return q_seg, kv_seg
 
 
@@ -787,6 +800,32 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
     dk_cur = jnp.zeros(k.shape, jnp.float32)
     dv_cur = jnp.zeros(v.shape, jnp.float32)
     k_cur, v_cur = k, v
+    s12k = s12v = None
+    if z.sinks is not None:
+        # out-of-window sink pairs (see the contiguous backward): the
+        # absolute sink rows live in global chunk 0 = device 0's early
+        # chunk; fetch just that sliver once and compute both local q
+        # chunks' patches ONCE instead of twice per ring step.
+        # kv_valid=None: chunk 0 is always fully real (sequence padding
+        # lives in the LAST chunks) and sinks <= chunk is enforced at
+        # entry, so the sink columns can't be padded.
+        from attention_tpu.ops.flash_bwd import _sink_patch
+
+        se0 = min(z.sinks, z.chunk)
+        k_sink = lax.all_gather(k[sl_lo][:, :se0], z.axis_name)[0]
+        v_sink = lax.all_gather(v[sl_lo][:, :se0], z.axis_name)[0]
+        s1q, s1k, s1v, se = _sink_patch(
+            q_hi, k_sink, v_sink, out_hi, lse_hi, dout_hi,
+            scale=z.scale, window=z.window, sinks=z.sinks,
+            softcap=z.softcap, q_offset=b * z.chunk)
+        s2q, s2k, s2v, _ = _sink_patch(
+            q_lo, k_sink, v_sink, out_lo, lse_lo, dout_lo,
+            scale=z.scale, window=z.window, sinks=z.sinks,
+            softcap=z.softcap, q_offset=a * z.chunk)
+        dq_hi = dq_hi + s1q
+        dq_lo = dq_lo + s2q
+        s12k = s1k + s2k
+        s12v = s1v + s2v
 
     def bwd_call(q_c, k_c, v_c, out_c, lse_c, dout_c, q_cid, kv_cid,
                  q_seg_c=None):
@@ -833,30 +872,13 @@ def _zig_diff_bwd(z: _ZigCfg, res, dout):
             g1v.astype(jnp.float32) + g2v.astype(jnp.float32))
         dv_cur = dv_cur.at[sl_hi].add(g3v.astype(jnp.float32))
         if z.sinks is not None:
-            # out-of-window sink pairs (see the contiguous backward):
-            # absolute sink rows live in global chunk 0, resident as
-            # the visiting EARLY chunk when ae == 0; both local q
-            # chunks get a sliver against it.  jnp.where, not 0/1
-            # multiply — the wrong-chunk sliver may overflow and
-            # 0 * inf would NaN-poison the buffers
-            from attention_tpu.ops.flash_bwd import _sink_patch
-
-            # kv_valid=None: chunk 0 is always fully real (sequence
-            # padding lives in the LAST chunks) and sinks <= chunk is
-            # enforced at entry, so the sink columns can't be padded
-            s1q, s1k, s1v, se = _sink_patch(
-                q_hi, k_lo, v_lo, out_hi, lse_hi, dout_hi,
-                scale=z.scale, window=z.window, sinks=z.sinks,
-                softcap=z.softcap, q_offset=b * z.chunk)
-            s2q, s2k, s2v, _ = _sink_patch(
-                q_lo, k_lo, v_lo, out_lo, lse_lo, dout_lo,
-                scale=z.scale, window=z.window, sinks=z.sinks,
-                softcap=z.softcap, q_offset=a * z.chunk)
+            # the precomputed sink dK/dV land in global chunk 0's
+            # traveling buffer — resident as the visiting EARLY chunk
+            # when ae == 0; the slivers themselves were computed once
+            # before the loop against the true sink rows
             gate = ae == 0
-            dq_hi = dq_hi + jnp.where(gate, s1q, 0.0)
-            dq_lo = dq_lo + jnp.where(gate, s2q, 0.0)
-            dk_cur = dk_cur.at[:, :se].add(jnp.where(gate, s1k + s2k, 0.0))
-            dv_cur = dv_cur.at[:, :se].add(jnp.where(gate, s1v + s2v, 0.0))
+            dk_cur = dk_cur.at[:, :se].add(jnp.where(gate, s12k, 0.0))
+            dv_cur = dv_cur.at[:, :se].add(jnp.where(gate, s12v, 0.0))
         if t + 1 < z.n_dev:
             dk_cur = lax.ppermute(dk_cur, z.axis_name, perm)
             dv_cur = lax.ppermute(dv_cur, z.axis_name, perm)

@@ -519,8 +519,19 @@ def main(argv=None) -> int:
         #     executes 18mnd = 4.5x fwd.
         from attention_tpu.ops.flash_bwd import fused_backward_applicable
 
+        # mirror _bench_flash_s's effective-tile resolution: explicit
+        # --block-q/--block-k flow into flash_backward and can flip the
+        # dispatch (oversized tiles fail the fused VMEM plan), so the
+        # accounting must ask with the same tiles the run uses
+        if args.block_q is None and args.block_k is None:
+            bwd_bs = None
+        else:
+            _eff = BlockSizes.for_shape(1, args.seq, args.dim, None)
+            bwd_bs = BlockSizes(args.block_q or _eff.block_q,
+                                args.block_k or _eff.block_k)
         bwd_fused = fused_backward_applicable(
-            args.seq, args.dim, window=None, sinks=None, segmented=False)
+            args.seq, args.dim, window=None, sinks=None, segmented=False,
+            block_sizes=bwd_bs)
         bwd_fl_exec = int((3.5 if bwd_fused else 4.5) * flops)
         bwd_s, bwd_ok = _measure_plausible(
             lambda: _bench_flash_s(args.seq, args.dim, args.repeats,
