@@ -82,11 +82,15 @@ def benchmark_attention(fn, q, k, v, *, repeats: int = 5, warmup: int = 2,
     """Time an attention call with the honest clock for the transport.
 
     On direct backends (cpu/gpu/tpu) this is plain fence timing
-    (:func:`benchmark`).  On tunnel transports the fence lies, so the call
-    is timed by amortized scan slope instead, chaining each iteration's
-    output back into the next Q (sliced/zero-padded when dv != dk — the
-    iterated values are garbage, but the per-iteration work is identical);
-    the returned ``Timing`` then holds the single per-iteration estimate.
+    (:func:`benchmark`).  On tunnel transports the fence lies, so the
+    call is timed by the chained-scan clock instead
+    (:func:`benchmark_auto`: device-trace time preferred — wall-clock
+    slope drowns in tens-of-ms tunnel variance for sub-ms ops, observed
+    reporting a 45 us flash call as 4.4 ms — with the slope as
+    fallback), chaining each iteration's output back into the next Q
+    (sliced/zero-padded when dv != dk — the iterated values are
+    garbage, but the per-iteration work is identical); the returned
+    ``Timing`` then holds the single per-iteration estimate.
     """
     if not _tunnel_transport():
         return benchmark(fn, q, k, v, repeats=repeats, warmup=warmup, **kwargs)
@@ -104,8 +108,8 @@ def benchmark_attention(fn, q, k, v, *, repeats: int = 5, warmup: int = 2,
             out = jnp.pad(out, [(0, 0)] * (out.ndim - 1) + [(0, dk - dv)])
         return out
 
-    per = benchmark_amortized(step, q, repeats=max(2, repeats // 2),
-                              operands=(k, v))
+    per = benchmark_auto(step, q, repeats=max(2, repeats // 2),
+                         operands=(k, v))
     return Timing(times_s=[per])
 
 

@@ -1,0 +1,245 @@
+"""Render the four reference charts from sweep JSONL.
+
+The reference publishes four figures (`/root/reference/images/*.png`:
+relative_speedup_ratio, strong_scalability, weak_scalability,
+process_placement — report Q2/Q4/Q5/Q7); this renders the framework's
+analogs from `scaling_sweep.py` output into `artifacts/`.
+
+One command, collection included:
+
+  python scripts/plot_sweeps.py --collect
+
+runs the sweeps in subprocesses (ablation on the env's default platform
+— the real chip when present; strong/weak/placement on the 8-device
+virtual CPU mesh, which validates the SCHEDULE only — the figures carry
+that label, see `benchmarks.placement_table`'s honesty note), writes
+`artifacts/sweeps.jsonl`, then plots.  Without `--collect` it re-plots
+from the existing JSONL.
+
+Chart conventions follow the repo's dataviz method: light surface,
+recessive grid, thin marks, categorical hues assigned in the palette's
+fixed validated order (slots 1-3 per chart; the order's adjacent-pair
+CVD validation is documented with the palette — this environment has no
+node runtime, so the documented validation stands in for a local run),
+identity by axis position where there is only one measure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+ART = os.path.join(ROOT, "artifacts")
+JSONL = os.path.join(ART, "sweeps.jsonl")
+
+# reference palette, light mode (validated fixed order; see docstring)
+S1, S2, S3 = "#2a78d6", "#eb6834", "#1baf7a"
+SURFACE = "#fcfcfb"
+INK = "#0b0b0b"
+INK2 = "#52514e"
+GRID = "#e5e4e0"
+
+
+def _style(ax, title, xlabel, ylabel):
+    ax.set_facecolor(SURFACE)
+    ax.set_title(title, color=INK, fontsize=11, loc="left", pad=12)
+    ax.set_xlabel(xlabel, color=INK2, fontsize=9)
+    ax.set_ylabel(ylabel, color=INK2, fontsize=9)
+    ax.tick_params(colors=INK2, labelsize=8)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(GRID)
+    ax.grid(True, color=GRID, linewidth=0.6, axis="y")
+    ax.set_axisbelow(True)
+
+
+def _fig(w=5.4, h=3.4):
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(w, h), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    return fig, ax
+
+
+def _save(fig, name):
+    path = os.path.join(ART, name)
+    fig.tight_layout()
+    fig.savefig(path, facecolor=SURFACE)
+    print(f"wrote {path}")
+
+
+def plot_ablation(rows):
+    fig, ax = _fig()
+    order = ["baseline", "mixed", "fused", "full"]
+    labels = {
+        "baseline": "XLA fp32\n(baseline)",
+        "mixed": "XLA bf16\n(precision only)",
+        "fused": "flash fp32\n(kernel only)",
+        "full": "flash bf16\n(full)",
+    }
+    rows = {r["variant"]: r for r in rows}
+    keys = [k for k in order if k in rows]
+    vals = [rows[k]["extra"]["speedup_vs_baseline"] for k in keys]
+    platform = rows[keys[0]].get("device_kind", "?") if keys else "?"
+    shape = f"{rows[keys[0]]['m']}x{rows[keys[0]]['n']}" if keys else "?"
+    # one measure across categories -> identity by position, one hue
+    bars = ax.bar([labels[k] for k in keys], vals, color=S1, width=0.62,
+                  zorder=2)
+    for b, v in zip(bars, vals):
+        ax.annotate(f"{v:.2f}x", (b.get_x() + b.get_width() / 2,
+                                  b.get_height()),
+                    ha="center", va="bottom", fontsize=8, color=INK)
+    ax.axhline(1.0, color=INK2, linewidth=0.8, linestyle=":")
+    _style(ax, f"Ablation: speedup vs XLA fp32 baseline\n({platform}, "
+               f"{shape}, d=128)",
+           "", "speedup (x)")
+    _save(fig, "relative_speedup_ratio.png")
+
+
+def plot_strong(rows):
+    fig, ax = _fig()
+    rows = sorted(rows, key=lambda r: r["n_devices"])
+    devs = [r["n_devices"] for r in rows]
+    base = rows[0]["best_us"]
+    sp = [base / r["best_us"] for r in rows]
+    ax.plot(devs, devs, color=INK2, linewidth=1.2, linestyle="--",
+            label="ideal", zorder=2)
+    ax.plot(devs, sp, color=S1, linewidth=2, marker="o", markersize=5,
+            label="kv-sharded", zorder=3)
+    ax.annotate(f"{sp[-1]:.2f}x", (devs[-1], sp[-1]),
+                textcoords="offset points", xytext=(-4, -12),
+                ha="right", fontsize=8, color=INK)
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(devs, [str(d) for d in devs])
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK2)
+    _style(ax, "Strong scaling, fixed 4096x8192 problem\n"
+               "(8-device virtual CPU mesh - schedule validation only)",
+           "devices", "speedup vs 1 device")
+    _save(fig, "strong_scalability.png")
+
+
+def plot_weak(rows):
+    fig, ax = _fig()
+    fams = {}
+    for r in rows:
+        fams.setdefault(r["extra"]["n_per_device"], []).append(r)
+    colors = [S1, S2, S3]
+    for color, (npd, recs) in zip(colors, sorted(fams.items())):
+        recs = sorted(recs, key=lambda r: r["n_devices"])
+        devs = [r["n_devices"] for r in recs]
+        ms = [r["best_us"] / 1e3 for r in recs]
+        ax.plot(devs, ms, color=color, linewidth=2, marker="o",
+                markersize=5, label=f"{npd} KV rows/device", zorder=3)
+        ax.annotate(f"{ms[-1]:.1f}", (devs[-1], ms[-1]),
+                    textcoords="offset points", xytext=(4, -3),
+                    fontsize=8, color=INK)
+    ax.set_xscale("log", base=2)
+    devs_all = sorted({r["n_devices"] for r in rows})
+    ax.set_xticks(devs_all, [str(d) for d in devs_all])
+    ax.set_ylim(bottom=0)
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK2)
+    _style(ax, "Weak scaling, KV grows with the mesh\n"
+               "(8-device virtual CPU mesh - schedule validation only)",
+           "devices", "min execution time (ms)")
+    _save(fig, "weak_scalability.png")
+
+
+def plot_placement(rows):
+    fig, ax = _fig()
+    rows = {r["variant"]: r for r in rows}
+    # identity is the chart's implicit 1.0 baseline — normalize to it
+    # explicitly, not to whichever row the JSONL happened to list first
+    keys = ["identity"] + sorted(k for k in rows if k != "identity")
+    keys = [k for k in keys if k in rows]
+    base = rows["identity"]["best_us"]
+    vals = [base / rows[k]["best_us"] for k in keys]
+    bars = ax.bar(keys, vals, color=S1, width=0.55, zorder=2)
+    for b, v in zip(bars, vals):
+        ax.annotate(f"{v:.3f}", (b.get_x() + b.get_width() / 2,
+                                 b.get_height()),
+                    ha="center", va="bottom", fontsize=8, color=INK)
+    ax.set_ylim(0, max(vals) * 1.2)
+    _style(ax, "Device-order placement, kv-sharded 2048x8192\n"
+               "(virtual CPU mesh - schedule validation only;\n"
+               "ICI-order effects need a real multi-chip mesh)",
+           "device order", "relative throughput")
+    _save(fig, "process_placement.png")
+
+
+def collect() -> None:
+    """Run the sweeps in subprocesses and write artifacts/sweeps.jsonl."""
+    os.makedirs(ART, exist_ok=True)
+    rows = []
+
+    def run(cmd):
+        # platform selection happens inside the child via --platform;
+        # the environment is inherited unchanged
+        print("+", " ".join(cmd), file=sys.stderr)
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             cwd=ROOT, check=True).stdout
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                if "skipped" not in row:
+                    rows.append(row)
+
+    py = sys.executable
+    sweep = os.path.join(ROOT, "scripts", "scaling_sweep.py")
+    # Ablation on the env's default platform (the real chip when
+    # present), at 16k: at 4096 the whole fp32 score matrix (67 MB)
+    # fits in VMEM and XLA's dense baseline ties the flash kernel
+    # (~62 us both, measured) — the reference likewise ran its ablation
+    # at sizes where the un-optimized baseline actually pays
+    # (report Q2 scale1..5).  At 16k the scores are 1 GB and dense
+    # attention must round-trip HBM.
+    run([py, sweep, "ablation", "--m", "16384", "--n", "16384"])
+    # mesh sweeps on the 8-device virtual CPU mesh
+    run([py, sweep, "strong", "--platform", "cpu8"])
+    for npd in (1024, 2048, 4096):
+        run([py, sweep, "weak", "--platform", "cpu8",
+             "--n-per-device", str(npd)])
+    run([py, sweep, "placement", "--platform", "cpu8"])
+    with open(JSONL, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"wrote {JSONL} ({len(rows)} rows)")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--collect", action="store_true",
+                   help="run the sweeps first (else plot existing JSONL)")
+    args = p.parse_args()
+    if args.collect:
+        collect()
+    if not os.path.exists(JSONL):
+        print(f"{JSONL} missing — run with --collect", file=sys.stderr)
+        return 1
+    import matplotlib
+
+    matplotlib.use("Agg")
+    rows = [json.loads(x) for x in open(JSONL)]
+    by = {}
+    for r in rows:
+        by.setdefault(r["sweep"], []).append(r)
+    if "ablation" in by:
+        plot_ablation(by["ablation"])
+    if "strong" in by:
+        plot_strong(by["strong"])
+    if "weak" in by:
+        plot_weak(by["weak"])
+    if "placement" in by:
+        plot_placement(by["placement"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

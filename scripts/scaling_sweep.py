@@ -54,6 +54,11 @@ def main() -> int:
     p.add_argument("--m", type=int, default=None)
     p.add_argument("--n", type=int, default=None)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument(
+        "--n-per-device", type=int, default=None,
+        help="weak-scaling KV rows per device (one M/P family per run; "
+        "plot_sweeps.py --collect sweeps several)",
+    )
     args = p.parse_args()
 
     _setup_platform(args.platform)
@@ -86,9 +91,14 @@ def main() -> int:
                 print(json.dumps({"sweep": sweep, "skipped":
                                   "needs >1 device; use --platform cpu8"}))
                 continue
-            fn = (benchmarks.strong_scaling if sweep == "strong"
-                  else benchmarks.weak_scaling)
-            for rec in fn(repeats=args.repeats):
+            if sweep == "strong":
+                recs = benchmarks.strong_scaling(repeats=args.repeats)
+            else:
+                kw = {}
+                if args.n_per_device:
+                    kw["n_per_device"] = args.n_per_device
+                recs = benchmarks.weak_scaling(repeats=args.repeats, **kw)
+            for rec in recs:
                 _emit(sweep, f"{rec.n_devices}dev", rec)
         elif sweep == "placement":
             if not multi:
