@@ -399,6 +399,12 @@ def _fused_bwd_kernel(
 # (~100 MB).
 _FUSED_VMEM_BUDGET = 88 * 2**20
 
+# Q-row chunk sizes tried (largest first) when a sequence exceeds the
+# fused kernel's resident-dQ budget as a whole — see the chunk loop in
+# `flash_backward`.  Module-level so tests can shrink it to exercise
+# the chunked path at test scale.
+_FUSED_CHUNK_CANDIDATES = (65536, 32768, 16384, 8192)
+
 
 def _vmem_limit_supported() -> bool:
     """The fused kernel NEEDS the raised scoped-VMEM budget; if this
@@ -439,13 +445,23 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
                               block_sizes: BlockSizes | None = None,
                               dtype=jnp.bfloat16) -> bool:
     """True when `flash_backward` will take the fused single-pass kernel
-    (bench.py keys its executed-FLOPs accounting off this: fused executes
-    10·mnd backward FLOPs, the two-kernel path 14·mnd)."""
-    return (window is None and sinks is None and not segmented
-            and _vmem_limit_supported()
-            and _fused_plan(m, n if n is not None else m, d,
-                            dv if dv is not None else d,
-                            block_sizes, dtype) is not None)
+    — whole (the resident-dQ plan fits) or Q-chunked (default tiles
+    only, any chunk candidate fits).  bench.py keys its executed-FLOPs
+    accounting off this: fused executes 10·mnd backward FLOPs, the
+    two-kernel path 14·mnd."""
+    if window is not None or sinks is not None or segmented:
+        return False
+    if not _vmem_limit_supported():
+        return False
+    n_eff = n if n is not None else m
+    dv_eff = dv if dv is not None else d
+    if _fused_plan(m, n_eff, d, dv_eff, block_sizes, dtype) is not None:
+        return True
+    # the chunked path engages only with library-default tiles
+    return block_sizes is None and any(
+        c < m and _fused_plan(c, n_eff, d, dv_eff, None, dtype)
+        for c in _FUSED_CHUNK_CANDIDATES
+    )
 
 
 def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
@@ -678,6 +694,46 @@ def flash_backward(
     h, m, d = q.shape
     hkv, n, dv = v.shape
     group = h // hkv
+
+    # Long sequences exceed the fused kernel's resident-dQ budget as a
+    # WHOLE but not per Q-row chunk — the context-parallel decomposition
+    # applied locally: run the fused kernel per chunk with the chunk's
+    # global q_offset and sum the dK/dV contributions (exactly what the
+    # CP orchestrator does across devices, `parallel/cp.py`).  10·mnd
+    # executed FLOPs instead of the two-kernel fallback's 14·mnd at
+    # 131k.  Chunk rounding to bf16 before the sum matches the CP
+    # path's per-shard precision (each shard's dK/dV are cast before
+    # the psum there too).
+    if (window is None and sinks is None and not segmented
+            and block_sizes is None and _vmem_limit_supported()
+            and _fused_plan(m, n, d, dv, None, q.dtype) is None):
+        chunk = next(
+            (c for c in _FUSED_CHUNK_CANDIDATES
+             if c < m and _fused_plan(c, n, d, dv, None, q.dtype)),
+            None,
+        )
+        if chunk is not None:
+            base_off = 0 if q_offset is None else q_offset
+            dq_parts = []
+            dk32 = dv32 = None
+            for s0 in range(0, m, chunk):
+                e0 = min(m, s0 + chunk)
+                off = (base_off + s0
+                       if causal or q_offset is not None else None)
+                dq_c, dk_c, dv_c = flash_backward(
+                    q[:, s0:e0], k, v, out[:, s0:e0], lse[:, s0:e0],
+                    dout[:, s0:e0], scale=scale, causal=causal,
+                    softcap=softcap, interpret=interpret, q_offset=off,
+                    kv_offset=kv_offset, kv_valid=kv_valid,
+                )
+                dq_parts.append(dq_c)
+                dk_c = dk_c.astype(jnp.float32)
+                dv_c = dv_c.astype(jnp.float32)
+                dk32 = dk_c if dk32 is None else dk32 + dk_c
+                dv32 = dv_c if dv32 is None else dv32 + dv_c
+            return (jnp.concatenate(dq_parts, axis=1),
+                    dk32.astype(k.dtype), dv32.astype(v.dtype))
+
     use_fused = fused_backward_applicable(
         m, d, window=window, sinks=sinks, segmented=segmented,
         n=n, dv=dv, block_sizes=block_sizes, dtype=q.dtype)
@@ -855,7 +911,9 @@ def flash_backward(
         ),
         grid_spec=dq_grid_spec,
         out_shape=jax.ShapeDtypeStruct((h, m_pad, d), q.dtype),
-        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=110 * 2**20),
         cost_estimate=pl.CostEstimate(
             flops=6 * h * m_pad * (band_j * block_k) * d,
             bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
@@ -916,7 +974,9 @@ def flash_backward(
             jax.ShapeDtypeStruct((hkv, n_pad, d), jnp.float32),
             jax.ShapeDtypeStruct((hkv, n_pad, dv), jnp.float32),
         ],
-        compiler_params=_compiler_params(("parallel", "arbitrary", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=110 * 2**20),
         cost_estimate=pl.CostEstimate(
             flops=8 * h * (band_i * block_q) * n_pad * d,
             bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize

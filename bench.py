@@ -583,7 +583,9 @@ def main(argv=None) -> int:
             result["detail"]["headline_contract_failed"] = True
     art_131k = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "artifacts", "headline_verify_131072.json")
-    if os.path.exists(art_131k):
+    # at --seq 131072 the cached record already IS headline_contract —
+    # don't emit the same file twice
+    if args.seq != 131072 and os.path.exists(art_131k):
         with open(art_131k) as f:
             rec = json.load(f)
         rec["source"] = "cached artifacts/headline_verify_131072.json"

@@ -156,9 +156,15 @@ def test_fused_plan_rejects_oversized_tiles():
     assert not flash_bwd.fused_backward_applicable(
         32768, 128, window=None, sinks=None, segmented=False,
         block_sizes=big)
-    # and the 131k headline shape exceeds the dQ residency budget
-    assert not flash_bwd.fused_backward_applicable(
+    # the 131k headline shape exceeds the WHOLE-m dQ residency budget
+    # but the Q-chunked fused path serves it (default tiles only)
+    assert flash_bwd._fused_plan(131072, 131072, 128, 128, None,
+                                 jnp.bfloat16) is None
+    assert flash_bwd.fused_backward_applicable(
         131072, 128, window=None, sinks=None, segmented=False)
+    assert not flash_bwd.fused_backward_applicable(
+        131072, 128, window=None, sinks=None, segmented=False,
+        block_sizes=big)
 
 
 def test_fused_dynamic_offsets_match_slice_of_full(rng):
@@ -185,3 +191,45 @@ def test_fused_dynamic_offsets_match_slice_of_full(rng):
     dq_hi = jax.grad(shard)(q_hi)
     np.testing.assert_allclose(np.asarray(dq_hi),
                                np.asarray(dq_full[:, lo:]), atol=2e-4)
+
+
+def test_chunked_fused_long_sequence_matches_oracle(rng, monkeypatch):
+    """Sequences past the fused kernel's resident-dQ budget run the
+    fused kernel per Q-row chunk with dK/dV summed (the CP
+    decomposition applied locally).  Exercised at test scale by
+    shrinking the VMEM budget and chunk candidates so m=320 chunks at
+    128 rows (boundaries deliberately not dividing m); gradients must
+    match the XLA oracle, and the fused kernel must actually have run
+    once per chunk."""
+    from attention_tpu.ops import flash_bwd
+
+    monkeypatch.setattr(flash_bwd, "_FUSED_VMEM_BUDGET",
+                        int(1.5 * 2**20))
+    monkeypatch.setattr(flash_bwd, "_FUSED_CHUNK_CANDIDATES", (128,))
+    calls = []
+    real_fused = flash_bwd._fused_backward
+
+    def counting_fused(*a, **kw):
+        calls.append(kw.get("m_pad"))
+        return real_fused(*a, **kw)
+
+    monkeypatch.setattr(flash_bwd, "_fused_backward", counting_fused)
+
+    h, m, d = 2, 320, 16
+    q = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, m, d)), jnp.float32)
+
+    def loss(impl):
+        def f(args):
+            o = flash_attention_diff(*args, causal=True, bwd_impl=impl)
+            return jnp.sum(o * jnp.cos(o))
+
+        return f
+
+    g_c = jax.grad(loss("pallas"))((q, k, v))
+    g_x = jax.grad(loss("xla"))((q, k, v))
+    assert len(calls) == 3  # ceil(320 / 128) chunks, each fused
+    for a, b in zip(g_c, g_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4)
