@@ -439,6 +439,25 @@ def _fused_plan(m, n, d, dv, block_sizes, dtype):
     return bs
 
 
+def _fused_chunk_choice(m, n, d, dv, block_sizes, dtype, *, window,
+                        sinks, segmented):
+    """The Q-row chunk size the chunked-fused path would use, or None
+    when that path can't serve the call (feature flags, explicit tiles,
+    whole-m already fits, or no candidate fits VMEM).  The SINGLE
+    eligibility definition shared by `flash_backward`'s dispatch and
+    `fused_backward_applicable` — bench.py keys FLOP accounting off the
+    latter, so the two must never drift."""
+    if (window is not None or sinks is not None or segmented
+            or block_sizes is not None or not _vmem_limit_supported()
+            or _fused_plan(m, n, d, dv, None, dtype) is not None):
+        return None
+    return next(
+        (c for c in _FUSED_CHUNK_CANDIDATES
+         if c < m and _fused_plan(c, n, d, dv, None, dtype)),
+        None,
+    )
+
+
 def fused_backward_applicable(m: int, d: int, *, window, sinks,
                               segmented: bool, n: int | None = None,
                               dv: int | None = None,
@@ -457,11 +476,9 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
     dv_eff = dv if dv is not None else d
     if _fused_plan(m, n_eff, d, dv_eff, block_sizes, dtype) is not None:
         return True
-    # the chunked path engages only with library-default tiles
-    return block_sizes is None and any(
-        c < m and _fused_plan(c, n_eff, d, dv_eff, None, dtype)
-        for c in _FUSED_CHUNK_CANDIDATES
-    )
+    return _fused_chunk_choice(
+        m, n_eff, d, dv_eff, block_sizes, dtype,
+        window=window, sinks=sinks, segmented=segmented) is not None
 
 
 def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
@@ -472,8 +489,25 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
     group = h // hkv
     num_i = m_pad // block_q
     num_j = n_pad // block_k
+
+    def i_c(jj, ii, off):
+        # Clamp q-side block indices for causally skipped steps (early
+        # q blocks wholly above kv block jj's diagonal) to the first
+        # contributing block: Pallas elides the HBM->VMEM DMA when
+        # consecutive grid steps map to the same block, so the skipped
+        # half of the causal grid stops fetching q/dO/stat blocks it
+        # never reads.  The clamp equals ii for every computed step
+        # (same bound as the kernel's keep guard).
+        if not causal:
+            return ii
+        i0 = jnp.maximum(
+            (jj * block_k + off[1] - off[0]) // block_q, 0
+        )
+        return jnp.minimum(jnp.maximum(ii, i0), num_i - 1)
+
     stat_spec = pl.BlockSpec(
-        (1, block_q, _STAT_LANES), lambda hh, jj, ii, off: (hh, ii, 0)
+        (1, block_q, _STAT_LANES),
+        lambda hh, jj, ii, off: (hh, i_c(jj, ii, off), 0),
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -482,13 +516,13 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
             stat_spec,
             stat_spec,
             pl.BlockSpec((1, block_q, d),
-                         lambda hh, jj, ii, off: (hh, ii, 0)),
+                         lambda hh, jj, ii, off: (hh, i_c(jj, ii, off), 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda hh, jj, ii, off: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_k, dv),
                          lambda hh, jj, ii, off: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_q, dv),
-                         lambda hh, jj, ii, off: (hh, ii, 0)),
+                         lambda hh, jj, ii, off: (hh, i_c(jj, ii, off), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, m_pad, d), lambda hh, jj, ii, off: (hh, 0, 0)),
@@ -704,35 +738,30 @@ def flash_backward(
     # 131k.  Chunk rounding to bf16 before the sum matches the CP
     # path's per-shard precision (each shard's dK/dV are cast before
     # the psum there too).
-    if (window is None and sinks is None and not segmented
-            and block_sizes is None and _vmem_limit_supported()
-            and _fused_plan(m, n, d, dv, None, q.dtype) is None):
-        chunk = next(
-            (c for c in _FUSED_CHUNK_CANDIDATES
-             if c < m and _fused_plan(c, n, d, dv, None, q.dtype)),
-            None,
-        )
-        if chunk is not None:
-            base_off = 0 if q_offset is None else q_offset
-            dq_parts = []
-            dk32 = dv32 = None
-            for s0 in range(0, m, chunk):
-                e0 = min(m, s0 + chunk)
-                off = (base_off + s0
-                       if causal or q_offset is not None else None)
-                dq_c, dk_c, dv_c = flash_backward(
-                    q[:, s0:e0], k, v, out[:, s0:e0], lse[:, s0:e0],
-                    dout[:, s0:e0], scale=scale, causal=causal,
-                    softcap=softcap, interpret=interpret, q_offset=off,
-                    kv_offset=kv_offset, kv_valid=kv_valid,
-                )
-                dq_parts.append(dq_c)
-                dk_c = dk_c.astype(jnp.float32)
-                dv_c = dv_c.astype(jnp.float32)
-                dk32 = dk_c if dk32 is None else dk32 + dk_c
-                dv32 = dv_c if dv32 is None else dv32 + dv_c
-            return (jnp.concatenate(dq_parts, axis=1),
-                    dk32.astype(k.dtype), dv32.astype(v.dtype))
+    chunk = _fused_chunk_choice(m, n, d, dv, block_sizes, q.dtype,
+                                window=window, sinks=sinks,
+                                segmented=segmented)
+    if chunk is not None:
+        base_off = 0 if q_offset is None else q_offset
+        dq_parts = []
+        dk32 = dv32 = None
+        for s0 in range(0, m, chunk):
+            e0 = min(m, s0 + chunk)
+            off = (base_off + s0
+                   if causal or q_offset is not None else None)
+            dq_c, dk_c, dv_c = flash_backward(
+                q[:, s0:e0], k, v, out[:, s0:e0], lse[:, s0:e0],
+                dout[:, s0:e0], scale=scale, causal=causal,
+                softcap=softcap, interpret=interpret, q_offset=off,
+                kv_offset=kv_offset, kv_valid=kv_valid,
+            )
+            dq_parts.append(dq_c)
+            dk_c = dk_c.astype(jnp.float32)
+            dv_c = dv_c.astype(jnp.float32)
+            dk32 = dk_c if dk32 is None else dk32 + dk_c
+            dv32 = dv_c if dv32 is None else dv32 + dv_c
+        return (jnp.concatenate(dq_parts, axis=1),
+                dk32.astype(k.dtype), dv32.astype(v.dtype))
 
     use_fused = fused_backward_applicable(
         m, d, window=window, sinks=sinks, segmented=segmented,

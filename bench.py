@@ -709,7 +709,12 @@ def main(argv=None) -> int:
                 "cache_read_gb_per_s": round(gbps, 1),
                 "frac_of_streaming_ceiling": round(gbps / ceiling_gbps, 3),
             }
-            if gbps > ceiling_gbps:
+            # the ceiling PROBE is itself a measurement (~±1%); frac a
+            # hair over 1.0 means decode and probe agree at the
+            # roofline.  Flag only readings past the probe's
+            # uncertainty — those are timing artifacts (the round-3
+            # 979 GB/s case would read frac ~1.3 here).
+            if gbps > ceiling_gbps * 1.05:
                 row["implausible_timing"] = True
             return row
 
