@@ -303,7 +303,7 @@ def _fused_bwd_kernel(
     offsets_ref, lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref,
     dq_ref, dkp_ref, dvp_ref, dk_scr, dv_scr, *,
     causal, block_q, block_k, scale, compute_dtype, softcap2,
-    dynamic_valid,
+    dynamic_valid, window, n_i_total,
 ):
     """Single-pass fused backward: S, dO·Vᵀ and dS are computed ONCE per
     (q, kv) tile and all three gradients come out of the same sweep —
@@ -330,8 +330,14 @@ def _fused_bwd_kernel(
     kv_off = offsets_ref[1]
     jb = pl.program_id(1)
     ib = pl.program_id(2)
-    q_base = ib * block_q
     k_base = jb * block_k
+    if window is None:
+        i = ib
+    else:
+        # banded q sweep (mirrors the two-kernel dK/dV kernel): only q
+        # blocks within [diagonal, diagonal + window) touch kv block jb
+        i = jnp.maximum((k_base + kv_off - q_off) // block_q, 0) + ib
+    q_base = i * block_q
 
     @pl.when(jnp.logical_and(jb == 0, ib == 0))
     def _zero_dq():
@@ -349,7 +355,7 @@ def _fused_bwd_kernel(
             causal=causal, q_base=q_base, k_base=k_base,
             q_off=q_off, kv_off=kv_off,
             valid=offsets_ref[2] if dynamic_valid else None,
-            q_seg_ref=None, kv_seg_ref=None, window=None,
+            q_seg_ref=None, kv_seg_ref=None, window=window,
             softcap2=softcap2,
         )
         dv_scr[...] += jax.lax.dot_general(
@@ -376,6 +382,15 @@ def _fused_bwd_kernel(
             keep, k_base + kv_off <= q_base + block_q - 1 + q_off
         )
         guarded = True
+        if window is not None:
+            # the banded sweep can overrun the real q blocks, and can
+            # include q tiles wholly past the window end
+            keep = jnp.logical_and(keep, i < n_i_total)
+            keep = jnp.logical_and(
+                keep,
+                q_base + q_off - (window - 1)
+                <= k_base + block_k - 1 + kv_off,
+            )
     if dynamic_valid:
         keep = jnp.logical_and(keep, k_base < offsets_ref[2])
         guarded = True
@@ -419,11 +434,11 @@ def _vmem_limit_supported() -> bool:
         return False
 
 
-def _fused_plan(m, n, d, dv, block_sizes, dtype):
+def _fused_plan(m, n, d, dv, block_sizes, dtype, window=None):
     """The (BlockSizes, vmem_estimate) the fused kernel would run with,
     or None when its working set (including the caller's explicit tiles
     and the REAL block-multiple padding) exceeds the VMEM budget."""
-    bs = block_sizes or default_fused_bwd_block_sizes(d, dtype)
+    bs = block_sizes or default_fused_bwd_block_sizes(d, dtype, window)
     bq = min(bs.block_q, _ceil_to(m, 128))
     bk = min(bs.block_k, _ceil_to(n, 128))
     m_pad = _ceil_to(m, bq)
@@ -447,13 +462,13 @@ def _fused_chunk_choice(m, n, d, dv, block_sizes, dtype, *, window,
     eligibility definition shared by `flash_backward`'s dispatch and
     `fused_backward_applicable` — bench.py keys FLOP accounting off the
     latter, so the two must never drift."""
-    if (window is not None or sinks is not None or segmented
-            or block_sizes is not None or not _vmem_limit_supported()
-            or _fused_plan(m, n, d, dv, None, dtype) is not None):
+    if (segmented or block_sizes is not None
+            or not _vmem_limit_supported()
+            or _fused_plan(m, n, d, dv, None, dtype, window) is not None):
         return None
     return next(
         (c for c in _FUSED_CHUNK_CANDIDATES
-         if c < m and _fused_plan(c, n, d, dv, None, dtype)),
+         if c < m and _fused_plan(c, n, d, dv, None, dtype, window)),
         None,
     )
 
@@ -468,13 +483,14 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
     only, any chunk candidate fits).  bench.py keys its executed-FLOPs
     accounting off this: fused executes 10·mnd backward FLOPs, the
     two-kernel path 14·mnd."""
-    if window is not None or sinks is not None or segmented:
+    if segmented:
         return False
     if not _vmem_limit_supported():
         return False
     n_eff = n if n is not None else m
     dv_eff = dv if dv is not None else d
-    if _fused_plan(m, n_eff, d, dv_eff, block_sizes, dtype) is not None:
+    if _fused_plan(m, n_eff, d, dv_eff, block_sizes, dtype,
+                   window) is not None:
         return True
     return _fused_chunk_choice(
         m, n_eff, d, dv_eff, block_sizes, dtype,
@@ -483,27 +499,41 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
 
 def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
                     h, hkv, m_pad, n_pad, d, dv, causal, scale,
-                    block_q, block_k, softcap, dynamic_valid, interpret):
+                    block_q, block_k, softcap, dynamic_valid, interpret,
+                    window=None):
     """Drive `_fused_bwd_kernel`; returns (dq, dk, dv) with dk/dv already
     group-summed (fp32)."""
     group = h // hkv
     num_i = m_pad // block_q
     num_j = n_pad // block_k
+    if window is None:
+        band_i = num_i
+    else:
+        # banded: q blocks within [diagonal, diagonal + window) per kv
+        # block (same bound as the two-kernel dK/dV kernel)
+        band_i = min(num_i, (block_k - 1 + window - 1) // block_q + 2)
 
     def i_c(jj, ii, off):
-        # Clamp q-side block indices for causally skipped steps (early
-        # q blocks wholly above kv block jj's diagonal) to the first
-        # contributing block: Pallas elides the HBM->VMEM DMA when
-        # consecutive grid steps map to the same block, so the skipped
-        # half of the causal grid stops fetching q/dO/stat blocks it
-        # never reads.  The clamp equals ii for every computed step
-        # (same bound as the kernel's keep guard).
-        if not causal:
-            return ii
+        # Map the grid's ii to the absolute q block and clamp skipped
+        # steps to a block the sweep does compute: Pallas elides the
+        # HBM->VMEM DMA when consecutive grid steps map to the same
+        # block, so causally skipped (and band-overrun) steps stop
+        # fetching q/dO/stat blocks they never read.  The clamp equals
+        # the true index for every computed step (same bounds as the
+        # kernel's keep guard).
         i0 = jnp.maximum(
             (jj * block_k + off[1] - off[0]) // block_q, 0
         )
-        return jnp.minimum(jnp.maximum(ii, i0), num_i - 1)
+        if window is None:
+            ii_abs = jnp.maximum(ii, i0) if causal else ii
+        else:
+            win_last = jnp.maximum(
+                (jj * block_k + block_k - 1 + window - 1
+                 + off[1] - off[0]) // block_q,
+                0,
+            )
+            ii_abs = jnp.minimum(i0 + ii, win_last)
+        return jnp.minimum(ii_abs, num_i - 1)
 
     stat_spec = pl.BlockSpec(
         (1, block_q, _STAT_LANES),
@@ -511,7 +541,7 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(h, num_j, num_i),
+        grid=(h, num_j, band_i),
         in_specs=[
             stat_spec,
             stat_spec,
@@ -546,6 +576,8 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
             compute_dtype=qs.dtype,
             softcap2=None if softcap is None else softcap * _LOG2E,
             dynamic_valid=dynamic_valid,
+            window=window,
+            n_i_total=num_i,
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -557,11 +589,12 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
             ("parallel", "arbitrary", "arbitrary"),
             vmem_limit_bytes=110 * 2**20),
         cost_estimate=pl.CostEstimate(
-            flops=10 * h * m_pad * n_pad * d,
+            # executed tiles = num_j x band_i (banded under window)
+            flops=10 * h * n_pad * (band_i * block_q) * d,
             bytes_accessed=(qs.size + do.size) * qs.dtype.itemsize
             + h * (k.size + v.size) // hkv * k.dtype.itemsize
             + (h * m_pad * d + h * n_pad * (d + dv)) * 4,
-            transcendentals=h * m_pad * n_pad,
+            transcendentals=h * n_pad * (band_i * block_q),
         ),
         interpret=interpret,
     )(offsets, lse_rep, delta_rep, qs, k, v, do)
@@ -649,7 +682,8 @@ def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
     return BlockSizes(512, 1024)
 
 
-def default_fused_bwd_block_sizes(d: int, dtype) -> BlockSizes:
+def default_fused_bwd_block_sizes(d: int, dtype,
+                                  window=None) -> BlockSizes:
     """Tile defaults for the fused single-pass backward kernel (swept
     separately from the two-kernel path: the fused kernel's VMEM also
     holds the per-head (m_pad, d) fp32 dQ block, so its tile budget is
@@ -657,7 +691,13 @@ def default_fused_bwd_block_sizes(d: int, dtype) -> BlockSizes:
     **512x4096** wins every shape tried — 32k single-head 10.32 ms (vs
     10.66 for 1024x1024, 10.49 for 512x2048), 32k causal 6.17, GQA
     8q/2kv 32k causal 51.2 (vs 55.9), fp32 4h/8k 3.10 (vs 3.19 for the
-    old 512x1024); 512x8192 and 1024x4096 fail to compile (VMEM)."""
+    old 512x1024); 512x8192 and 1024x4096 fail to compile (VMEM).
+    Windowed shapes take a compact square: executed band columns per q
+    row scale with (window + block_q + block_k), so small tiles waste
+    the least band (the same argument as the two-kernel windowed
+    default)."""
+    if window is not None:
+        return BlockSizes(512, 512)
     return BlockSizes(512, 4096)
 
 
@@ -752,7 +792,8 @@ def flash_backward(
             dq_c, dk_c, dv_c = flash_backward(
                 q[:, s0:e0], k, v, out[:, s0:e0], lse[:, s0:e0],
                 dout[:, s0:e0], scale=scale, causal=causal,
-                softcap=softcap, interpret=interpret, q_offset=off,
+                window=window, softcap=softcap, sinks=sinks,
+                interpret=interpret, q_offset=off,
                 kv_offset=kv_offset, kv_valid=kv_valid,
             )
             dq_parts.append(dq_c)
@@ -767,7 +808,7 @@ def flash_backward(
         m, d, window=window, sinks=sinks, segmented=segmented,
         n=n, dv=dv, block_sizes=block_sizes, dtype=q.dtype)
     if use_fused:
-        bs = _fused_plan(m, n, d, dv, block_sizes, q.dtype)
+        bs = _fused_plan(m, n, d, dv, block_sizes, q.dtype, window)
     elif block_sizes is not None:
         bs = block_sizes
     else:
@@ -833,9 +874,23 @@ def flash_backward(
             h=h, hkv=hkv, m_pad=m_pad, n_pad=n_pad, d=d, dv=dv,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
             softcap=softcap, dynamic_valid=dynamic_valid,
-            interpret=interpret)
-        return (dq_f[:, :m].astype(q.dtype), dk_f[:, :n].astype(k.dtype),
-                dv_f[:, :n].astype(v.dtype))
+            interpret=interpret, window=window)
+        dq_f = dq_f[:, :m]
+        dk_f, dv_f = dk_f[:, :n], dv_f[:, :n]
+        if sinks is not None:
+            # out-of-window sink pairs, same sliver as the two-kernel
+            # composition (the banded fused kernel covers the window
+            # band only)
+            dq_s, dk_s, dv_s, se = _sink_patch(
+                q, k[:, :n], v[:, :n], out, lse, dout,
+                scale=scale, window=window, sinks=sinks, softcap=softcap,
+                q_offset=q_offset, kv_valid=kv_valid,
+            )
+            dq_f = dq_f + dq_s
+            dk_f = dk_f.at[:, :se].add(dk_s)
+            dv_f = dv_f.at[:, :se].add(dv_s)
+        return (dq_f.astype(q.dtype), dk_f.astype(k.dtype),
+                dv_f.astype(v.dtype))
 
     def j_abs(ii, jj, off):
         # clamp band-tail steps to the last block the row actually

@@ -654,9 +654,10 @@ def main(argv=None) -> int:
         #     dV, dQ, dK once each) = 3.5x fwd — the "useful" rate.
         #   * executed: the fused single-pass backward (flash_bwd.py,
         #     round 4) computes S and dO·V^T ONCE, so it executes exactly
-        #     the algorithmic 14mnd; the two-kernel fallback (large m,
-        #     window/sinks/segments) re-derives both in each kernel and
-        #     executes 18mnd = 4.5x fwd.
+        #     the algorithmic 14mnd (large m chunks Q through the same
+        #     kernel; window/sinks band it); only packed segments and
+        #     oversized explicit tiles fall back to the two-kernel path,
+        #     which re-derives both in each kernel: 18mnd = 4.5x fwd.
         from attention_tpu.ops.flash_bwd import fused_backward_applicable
 
         # mirror _bench_flash_s's effective-tile resolution: explicit

@@ -105,16 +105,18 @@ def test_grad_wrt_loss_scale_linearity(rng):
 
 def test_fused_and_two_kernel_paths_agree(rng):
     """The fused single-pass kernel (round 4) and the two-kernel path
-    must produce identical gradients; `window=` forces the two-kernel
-    fallback while the plain causal call dispatches fused, so compare
-    both against the XLA oracle on the same inputs and the fused/two-
-    kernel pair directly on a plain causal case."""
+    must produce identical gradients.  Plain causal AND windowed calls
+    both dispatch fused now; packed segments still force the two-kernel
+    fallback — compare both dispatches against the XLA oracle on the
+    same inputs."""
     from attention_tpu.ops import flash_bwd
 
     assert flash_bwd.fused_backward_applicable(
         64, 16, window=None, sinks=None, segmented=False)
-    assert not flash_bwd.fused_backward_applicable(
+    assert flash_bwd.fused_backward_applicable(
         64, 16, window=32, sinks=None, segmented=False)
+    assert not flash_bwd.fused_backward_applicable(
+        64, 16, window=None, sinks=None, segmented=True)
 
     q = jnp.asarray(rng.standard_normal((2, 64, 16)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((2, 64, 16)), jnp.float32)
@@ -125,7 +127,7 @@ def test_fused_and_two_kernel_paths_agree(rng):
     for a, b in zip(g_f, g_x):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
-    # two-kernel dispatch (window forces the fallback) vs the XLA oracle
+    # fused banded dispatch (window) vs the XLA oracle
     def loss_w(impl):
         def f(q, k, v):
             out = flash_attention_diff(
@@ -136,8 +138,26 @@ def test_fused_and_two_kernel_paths_agree(rng):
 
         return f
 
-    g_2k = jax.grad(loss_w("pallas"), argnums=(0, 1, 2))(q, k, v)
-    g_2x = jax.grad(loss_w("xla"), argnums=(0, 1, 2))(q, k, v)
+    g_w = jax.grad(loss_w("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_wx = jax.grad(loss_w("xla"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_w, g_wx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+    # two-kernel dispatch (segments force the fallback) vs the oracle
+    seg = jnp.asarray(np.repeat([0, 1], [30, 34]).astype(np.int32))
+
+    def loss_s(impl):
+        def f(q, k, v):
+            out = flash_attention_diff(
+                q, k, v, causal=True, block_sizes=BS, bwd_chunk=16,
+                bwd_impl=impl, q_segment_ids=seg, kv_segment_ids=seg,
+            )
+            return jnp.sum(out * jnp.sin(out))
+
+        return f
+
+    g_2k = jax.grad(loss_s("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_2x = jax.grad(loss_s("xla"), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_2k, g_2x):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
