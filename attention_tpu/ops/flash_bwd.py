@@ -301,9 +301,9 @@ def _dkv_kernel(
 
 def _fused_bwd_kernel(
     offsets_ref, lse_ref, delta_ref, qs_ref, k_ref, v_ref, do_ref,
-    dq_ref, dkp_ref, dvp_ref, dk_scr, dv_scr, *,
+    *rest,
     causal, block_q, block_k, scale, compute_dtype, softcap2,
-    dynamic_valid, window, n_i_total,
+    dynamic_valid, window, n_i_total, segmented,
 ):
     """Single-pass fused backward: S, dO·Vᵀ and dS are computed ONCE per
     (q, kv) tile and all three gradients come out of the same sweep —
@@ -326,6 +326,11 @@ def _fused_bwd_kernel(
         VMEM next to the tiles, so `flash_backward` only dispatches here
         for m_pad ≤ ~32k at d=128 (the benchmark headline shape).
     """
+    if segmented:
+        q_seg_ref, kv_seg_ref, *rest = rest
+    else:
+        q_seg_ref = kv_seg_ref = None
+    dq_ref, dkp_ref, dvp_ref, dk_scr, dv_scr = rest
     q_off = offsets_ref[0]
     kv_off = offsets_ref[1]
     jb = pl.program_id(1)
@@ -355,7 +360,7 @@ def _fused_bwd_kernel(
             causal=causal, q_base=q_base, k_base=k_base,
             q_off=q_off, kv_off=kv_off,
             valid=offsets_ref[2] if dynamic_valid else None,
-            q_seg_ref=None, kv_seg_ref=None, window=window,
+            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref, window=window,
             softcap2=softcap2,
         )
         dv_scr[...] += jax.lax.dot_general(
@@ -483,15 +488,13 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
     only, any chunk candidate fits).  bench.py keys its executed-FLOPs
     accounting off this: fused executes 10·mnd backward FLOPs, the
     two-kernel path 14·mnd."""
-    if segmented:
-        return False
     if not _vmem_limit_supported():
         return False
     n_eff = n if n is not None else m
     dv_eff = dv if dv is not None else d
     if _fused_plan(m, n_eff, d, dv_eff, block_sizes, dtype,
                    window) is not None:
-        return True
+        return True  # segments ride whole-fused; chunking excludes them
     return _fused_chunk_choice(
         m, n_eff, d, dv_eff, block_sizes, dtype,
         window=window, sinks=sinks, segmented=segmented) is not None
@@ -500,7 +503,7 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
 def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
                     h, hkv, m_pad, n_pad, d, dv, causal, scale,
                     block_q, block_k, softcap, dynamic_valid, interpret,
-                    window=None):
+                    window=None, seg_inputs=()):
     """Drive `_fused_bwd_kernel`; returns (dq, dk, dv) with dk/dv already
     group-summed (fp32)."""
     group = h // hkv
@@ -553,6 +556,12 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
                          lambda hh, jj, ii, off: (hh // group, jj, 0)),
             pl.BlockSpec((1, block_q, dv),
                          lambda hh, jj, ii, off: (hh, i_c(jj, ii, off), 0)),
+            *([
+                pl.BlockSpec((block_q, _STAT_LANES),
+                             lambda hh, jj, ii, off: (i_c(jj, ii, off), 0)),
+                pl.BlockSpec((8, block_k),
+                             lambda hh, jj, ii, off: (0, jj)),
+            ] if seg_inputs else []),
         ],
         out_specs=[
             pl.BlockSpec((1, m_pad, d), lambda hh, jj, ii, off: (hh, 0, 0)),
@@ -578,6 +587,7 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
             dynamic_valid=dynamic_valid,
             window=window,
             n_i_total=num_i,
+            segmented=bool(seg_inputs),
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -597,7 +607,7 @@ def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
             transcendentals=h * n_pad * (band_i * block_q),
         ),
         interpret=interpret,
-    )(offsets, lse_rep, delta_rep, qs, k, v, do)
+    )(offsets, lse_rep, delta_rep, qs, k, v, do, *seg_inputs)
     if group > 1:
         dkp = dkp.reshape(hkv, group, n_pad, d).sum(axis=1)
         dvp = dvp.reshape(hkv, group, n_pad, dv).sum(axis=1)
@@ -869,12 +879,18 @@ def flash_backward(
     if use_fused:
         # single-pass fused kernel: 10·mnd executed backward FLOPs vs the
         # two-kernel path's 14·mnd (S and dO·Vᵀ computed once, not twice)
+        fused_seg = ()
+        if segmented:
+            from attention_tpu.ops.flash import segment_masks
+
+            fused_seg = segment_masks(q_segment_ids, kv_segment_ids,
+                                      m, n, m_pad, n_pad)
         dq_f, dk_f, dv_f = _fused_backward(
             qs, k, v, lse_rep, delta_rep, do, offsets,
             h=h, hkv=hkv, m_pad=m_pad, n_pad=n_pad, d=d, dv=dv,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
             softcap=softcap, dynamic_valid=dynamic_valid,
-            interpret=interpret, window=window)
+            interpret=interpret, window=window, seg_inputs=fused_seg)
         dq_f = dq_f[:, :m]
         dk_f, dv_f = dk_f[:, :n], dv_f[:, :n]
         if sinks is not None:

@@ -655,9 +655,10 @@ def main(argv=None) -> int:
         #   * executed: the fused single-pass backward (flash_bwd.py,
         #     round 4) computes S and dO·V^T ONCE, so it executes exactly
         #     the algorithmic 14mnd (large m chunks Q through the same
-        #     kernel; window/sinks band it); only packed segments and
-        #     oversized explicit tiles fall back to the two-kernel path,
-        #     which re-derives both in each kernel: 18mnd = 4.5x fwd.
+        #     kernel; window/sinks band it; segments mask it); only
+        #     oversized explicit tiles, chunk-scale segmented calls, and
+        #     pallas without vmem_limit_bytes fall back to the two-kernel
+        #     path, which re-derives both in each kernel: 18mnd = 4.5x.
         from attention_tpu.ops.flash_bwd import fused_backward_applicable
 
         # mirror _bench_flash_s's effective-tile resolution: explicit

@@ -103,19 +103,18 @@ def test_grad_wrt_loss_scale_linearity(rng):
 
 # ---------------- fused single-pass kernel dispatch ----------------
 
-def test_fused_and_two_kernel_paths_agree(rng):
+def test_fused_and_two_kernel_paths_agree(rng, monkeypatch):
     """The fused single-pass kernel (round 4) and the two-kernel path
-    must produce identical gradients.  Plain causal AND windowed calls
-    both dispatch fused now; packed segments still force the two-kernel
-    fallback — compare both dispatches against the XLA oracle on the
-    same inputs."""
+    must produce identical gradients.  Plain causal, windowed AND
+    segmented calls all dispatch fused now; the two-kernel path is
+    forced here by shrinking the fused VMEM budget to nothing."""
     from attention_tpu.ops import flash_bwd
 
     assert flash_bwd.fused_backward_applicable(
         64, 16, window=None, sinks=None, segmented=False)
     assert flash_bwd.fused_backward_applicable(
         64, 16, window=32, sinks=None, segmented=False)
-    assert not flash_bwd.fused_backward_applicable(
+    assert flash_bwd.fused_backward_applicable(
         64, 16, window=None, sinks=None, segmented=True)
 
     q = jnp.asarray(rng.standard_normal((2, 64, 16)), jnp.float32)
@@ -143,7 +142,7 @@ def test_fused_and_two_kernel_paths_agree(rng):
     for a, b in zip(g_w, g_wx):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
-    # two-kernel dispatch (segments force the fallback) vs the oracle
+    # fused segmented dispatch vs the oracle
     seg = jnp.asarray(np.repeat([0, 1], [30, 34]).astype(np.int32))
 
     def loss_s(impl):
@@ -156,8 +155,16 @@ def test_fused_and_two_kernel_paths_agree(rng):
 
         return f
 
-    g_2k = jax.grad(loss_s("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_sf = jax.grad(loss_s("pallas"), argnums=(0, 1, 2))(q, k, v)
     g_2x = jax.grad(loss_s("xla"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_sf, g_2x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+    # two-kernel dispatch (forced: no VMEM budget for fused) vs oracle
+    monkeypatch.setattr(flash_bwd, "_FUSED_VMEM_BUDGET", 0)
+    assert not flash_bwd.fused_backward_applicable(
+        64, 16, window=None, sinks=None, segmented=False)
+    g_2k = jax.grad(loss_s("pallas"), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_2k, g_2x):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
