@@ -127,7 +127,8 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
                    block_k: int | None, *, heads: int | None = None,
                    kv_heads: int | None = None, window: int | None = None,
                    n_short: int = 4, n_long: int = 20,
-                   max_mode: str = "bound", backward: bool = False):
+                   max_mode: str = "bound", backward: bool = False,
+                   causal: bool | None = None):
     """Per-call seconds of the fused flash kernel at (seq, dim), bf16.
 
     ``heads``/``kv_heads`` switch to multi-head (h, seq, dim) inputs
@@ -164,13 +165,14 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
         bs = None  # let the library resolve (same as eff)
     else:
         bs = BlockSizes(block_q or eff.block_q, block_k or eff.block_k)
+    causal = (window is not None) if causal is None else causal
     if backward:
         from attention_tpu.ops.flash_vjp import flash_attention_diff
 
         def grad_step(x, kk_, vv_):
             def loss(args):
                 o = flash_attention_diff(
-                    *args, block_sizes=bs, causal=window is not None,
+                    *args, block_sizes=bs, causal=causal,
                     window=window, max_mode=max_mode,
                 )
                 return jnp.sum(o.astype(jnp.float32))
@@ -187,7 +189,7 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
                               n_short=n_short, n_long=n_long,
                               operands=(k, v))
     step = lambda x, kk, vv: flash_attention(  # noqa: E731
-        x, kk, vv, block_sizes=bs, causal=window is not None, window=window,
+        x, kk, vv, block_sizes=bs, causal=causal, window=window,
         max_mode=max_mode,
     )
     # benchmark_auto: deterministic device-trace clock, slope fallback.
@@ -690,6 +692,34 @@ def main(argv=None) -> int:
         }
         if not bwd_ok:
             ladder["fwd_bwd_32k"]["implausible_timing"] = True
+        # causal and windowed backward rows: the fused kernel's banded /
+        # diagonal-skipping paths (plausibility screened on algorithmic
+        # FLOPs, which lower-bound executed; util is not reported — the
+        # causal band is tile-quantized and the window band estimate
+        # belongs to the forward row)
+        bwd_ca_s, bwd_ca_ok = _measure_plausible(
+            lambda: _bench_flash_s(args.seq, args.dim, args.repeats,
+                                   args.block_q, args.block_k,
+                                   backward=True, causal=True,
+                                   max_mode=args.max_mode,
+                                   n_short=2, n_long=8),
+            int(1.75 * flops))
+        ladder["fwd_bwd_32k_causal"] = {"ms": round(bwd_ca_s * 1e3, 3)}
+        if not bwd_ca_ok:
+            ladder["fwd_bwd_32k_causal"]["implausible_timing"] = True
+        # truly algorithmic band (window columns only, no tile slack) so
+        # the screen's FLOPs genuinely lower-bound any tiling's executed
+        w_bwd_fl = int(3.5 * 2 * args.seq * 1024 * (args.dim * 2))
+        bwd_w_s, bwd_w_ok = _measure_plausible(
+            lambda: _bench_flash_s(args.seq, args.dim, args.repeats,
+                                   args.block_q, args.block_k,
+                                   backward=True, window=1024,
+                                   max_mode=args.max_mode,
+                                   n_short=2, n_long=12),
+            w_bwd_fl)
+        ladder["fwd_bwd_swa_w1024_32k"] = {"ms": round(bwd_w_s * 1e3, 3)}
+        if not bwd_w_ok:
+            ladder["fwd_bwd_swa_w1024_32k"]["implausible_timing"] = True
         # fixed config (name encodes it) — independent of --dim/--seq
         dec_b, dec_h, dec_hkv, dec_len, dec_d = 8, 32, 4, 32768, 128
         dec_s = _bench_decode_s(dec_b, dec_h, dec_hkv, dec_len, dec_d,
