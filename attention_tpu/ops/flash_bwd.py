@@ -705,7 +705,10 @@ def default_fused_bwd_block_sizes(d: int, dtype,
     Windowed shapes take a compact square: executed band columns per q
     row scale with (window + block_q + block_k), so small tiles waste
     the least band (the same argument as the two-kernel windowed
-    default)."""
+    default).  Swept at seq=32k: 512x512 wins w=1024 (0.977 ms vs
+    1.068 for 512x1024) and w=256 (0.707, tied with 256x256's 0.705),
+    and sits 2% off 1024x1024 at w=4096 (2.028 vs 1.987) — one default
+    within 2% of best across the window range beats a size ladder."""
     if window is not None:
         return BlockSizes(512, 512)
     return BlockSizes(512, 4096)
