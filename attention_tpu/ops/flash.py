@@ -77,43 +77,59 @@ class BlockSizes(NamedTuple):
     @classmethod
     def for_shape(cls, heads: int, m: int, d: int,
                   window: int | None = None,
-                  returns_stats: bool = False) -> "BlockSizes":
+                  returns_stats: bool = False,
+                  causal: bool = False) -> "BlockSizes":
         """Measured per-shape defaults (callers may always override).
 
-        With the deterministic device-time clock
-        (`utils.timing.benchmark_traced` — reproduces to the decimal,
-        unlike the contention-swung wall clock), one tile wins every
-        unwindowed d<=128 shape with m >= 8192: a tall **2048x1024**.
-        Device-lane utilization vs the 256x1024 general default:
-        single-head 8k 0.785 vs 0.745, 16k 0.801 vs 0.763, 32k 0.809
-        vs 0.773, 131k 0.816 vs 0.774, GQA 32q/4kv@16k 0.787 vs 0.721.
-        Windowed long sequences prefer a compact **512x512** tile — the
+        Round 4: raising the kernel's scoped-VMEM budget (it sat at
+        Mosaic's ~16 MB default, which rejected every tile bigger than
+        the then-measured optima — the sweep space was cut off exactly
+        at the boundary the defaults sat on) unlocks a universal
+        **4096x2048** for every unwindowed d<=128 shape with m >= 8192,
+        stats outputs included.  Device clock: single-head 8k 185.0 us
+        (0.943 vs 0.925 for the old 2048x1024), 32k 2.867 ms (0.973 vs
+        0.951), 131k 45.39 ms (0.984 vs 0.959), GQA 32q/4kv@16k
+        23.55 ms (0.948 vs 0.918 for the old 1024x2048), partials 32k
+        2.967 ms (0.941 vs 0.888 for the old capped 1024x1024 — the
+        cap existed only because of the old VMEM budget).
+        Windowed long sequences keep the compact **512x512** tile — the
         band covers ceil((window-1+block_q)/block_k)+1 KV blocks, so
         smaller square tiles waste less of the band on masked columns:
         at seq=32k (device clock) w=1024 runs 227 us vs 329 for the
         general default, w=4096 575 vs 718, w=256 166 vs 153 for
         256x512 (within a whisker of the best).
-
-        ``returns_stats`` (the `flash_attention_partials` path) caps the
-        Q tile at 1024: the extra lane-replicated (block_q, 128) fp32
-        stat outputs push a 2048-row tile ~0.5 MB past the 16 MB scoped
-        VMEM limit (compile-time OOM, found at 16q/4kv seq=8k), and
-        1024x1024 is also the measured fastest stats tile (2.42 ms vs
-        2.73 for the general default at that shape).
         """
         if d <= 128 and m >= 8192:
             if window is not None:
                 return cls(512, 512)
-            if returns_stats:
-                return cls(1024, 1024)
-            if heads >= 8:
-                # many-head interleaved sweep (scripts/gqa_sweep.py,
-                # RESULTS.md round 2): 1024x2048 measured best at
-                # 32q/4kv seq=16k (27.6-28.0 ms vs 27.9-28.0 for
-                # 2048x1024 and 29.1-31.4 for the old 256x1024)
-                return cls(1024, 2048)
-            return cls(2048, 1024)
+            if not _vmem_limit_supported():
+                # without the raised budget the big tiles cannot
+                # compile: keep the round-3 defaults that fit ~16 MB
+                return cls(1024, 1024) if returns_stats else cls(2048, 1024)
+            # padding-aware: _flash_call pads m to a block_q multiple,
+            # so a 4096-row tile on e.g. m=10240 would compute +20%
+            # garbage rows; step down when 4096 does not divide
+            bq = 4096 if m % 4096 == 0 else (2048 if m % 2048 == 0
+                                             else 2048)
+            if causal:
+                # the diagonal wastes more of a taller tile: 2048x2048
+                # measured 1.580 ms at causal 32k vs 1.643 for the
+                # non-causal optimum (and 1.618 for the old 2048x1024)
+                bq = min(bq, 2048)
+            return cls(bq, 2048 if m % 2048 == 0 else 1024)
         return cls()
+
+
+def _vmem_limit_supported() -> bool:
+    """Whether this pallas accepts ``vmem_limit_bytes`` — the big-tile
+    forward default and the fused backward both NEED the raised budget;
+    without support the defaults must stay inside Mosaic's ~16 MB."""
+    try:
+        pltpu.CompilerParams(dimension_semantics=("parallel",),
+                             vmem_limit_bytes=2**20)
+        return True
+    except TypeError:
+        return False
 
 
 def _ceil_to(x: int, mult: int) -> int:
@@ -636,7 +652,17 @@ def _flash_call(
         ],
     )
 
-    compiler_params = _compiler_params(("parallel", "parallel", "arbitrary"))
+    # Raised scoped-VMEM budget for big tiles only (like the backward
+    # kernels): the default ~16 MB budget rejects every tile bigger
+    # than the round-3 defaults, cutting the sweep space off exactly at
+    # the boundary those defaults sat on — the round-4 universal
+    # 4096x2048 needs the raise.  Small tiles keep the default budget:
+    # the raise measurably perturbed the windowed 512x512 kernel's
+    # schedule (0.208 -> 0.251 ms at w=1024).
+    big_tile = block_q * block_k > 2 * 2**20
+    compiler_params = _compiler_params(
+        ("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=110 * 2**20 if big_tile else None)
 
     # windowed grids only visit the band's KV columns
     n_eff = band_blocks * block_k
@@ -808,7 +834,8 @@ def flash_attention(
         causal=causal,
         normalize=True,
         block_sizes=block_sizes or BlockSizes.for_shape(
-            qh.shape[0], qh.shape[1], qh.shape[2], window),
+            qh.shape[0], qh.shape[1], qh.shape[2], window,
+            causal=causal),
         return_stats=False,
         interpret=interpret,
         out_dtype=v.dtype,
@@ -874,7 +901,7 @@ def flash_attention_partials(
         normalize=False,
         block_sizes=block_sizes or BlockSizes.for_shape(
             qh.shape[0], qh.shape[1], qh.shape[2], window,
-            returns_stats=True),
+            returns_stats=True, causal=causal),
         return_stats=True,
         interpret=interpret,
         out_dtype=jnp.float32,

@@ -59,6 +59,7 @@ from attention_tpu.ops.flash import (
     BlockSizes,
     _ceil_to,
     _compiler_params,
+    _vmem_limit_supported,
 )
 
 
@@ -424,19 +425,6 @@ _FUSED_VMEM_BUDGET = 88 * 2**20
 # `flash_backward`.  Module-level so tests can shrink it to exercise
 # the chunked path at test scale.
 _FUSED_CHUNK_CANDIDATES = (65536, 32768, 16384, 8192)
-
-
-def _vmem_limit_supported() -> bool:
-    """The fused kernel NEEDS the raised scoped-VMEM budget; if this
-    pallas version's CompilerParams rejects `vmem_limit_bytes`, the
-    dispatch must stay on the two-kernel path rather than ship a kernel
-    that cannot compile."""
-    try:
-        pltpu.CompilerParams(dimension_semantics=("parallel",),
-                             vmem_limit_bytes=2**20)
-        return True
-    except TypeError:
-        return False
 
 
 def _fused_plan(m, n, d, dv, block_sizes, dtype, window=None):

@@ -110,14 +110,19 @@ def test_bench_cli_smoke():
 
 
 def test_blocksizes_for_shape_rules():
-    """The measured tile lookup: 2048x1024 for unwindowed long d<=128
-    few-head shapes, 1024x2048 for many-head (>=8, per the gqa_sweep),
-    512x512 for windowed ones, general default elsewhere; explicit
-    block_sizes= always wins (callers pass it through)."""
+    """The measured tile lookup (round 4: one universal big tile under
+    the raised VMEM budget): 4096x2048 for unwindowed long d<=128
+    shapes regardless of heads, stepping down to keep padding bounded
+    when the tile does not divide m; 2048x2048 for causal; 512x512 for
+    windowed; general default elsewhere; explicit block_sizes= always
+    wins (callers pass it through)."""
     from attention_tpu.ops.flash import BlockSizes
 
-    assert BlockSizes.for_shape(1, 8192, 128) == BlockSizes(2048, 1024)
-    assert BlockSizes.for_shape(32, 16384, 128) == BlockSizes(1024, 2048)
+    assert BlockSizes.for_shape(1, 8192, 128) == BlockSizes(4096, 2048)
+    assert BlockSizes.for_shape(32, 16384, 128) == BlockSizes(4096, 2048)
+    assert BlockSizes.for_shape(1, 10240, 128) == BlockSizes(2048, 2048)
+    assert BlockSizes.for_shape(1, 32768, 128, causal=True) == \
+        BlockSizes(2048, 2048)
     assert BlockSizes.for_shape(1, 32768, 128, window=1024) == \
         BlockSizes(512, 512)
     assert BlockSizes.for_shape(1, 4096, 128) == BlockSizes()
@@ -144,17 +149,24 @@ def test_device_module_seconds_missing_dir(tmp_path):
 
 
 def test_blocksizes_stats_and_backward_defaults():
-    """Pin the VMEM-safety rules: the stats-returning forward caps its
-    tile at 1024 (2048 OOMs scoped VMEM), and the backward default is
-    dtype- and window-aware."""
+    """Pin the tile-default rules: stats tiles share the universal big
+    tile now that the VMEM budget is raised (the old 1024 cap was a
+    budget artifact), and the backward defaults are window-aware."""
     import jax.numpy as jnp
 
     from attention_tpu.ops.flash import BlockSizes
-    from attention_tpu.ops.flash_bwd import default_bwd_block_sizes
+    from attention_tpu.ops.flash_bwd import (
+        default_bwd_block_sizes,
+        default_fused_bwd_block_sizes,
+    )
 
     assert BlockSizes.for_shape(16, 8192, 128, returns_stats=True) == \
-        BlockSizes(1024, 1024)
-    assert BlockSizes.for_shape(16, 8192, 128) == BlockSizes(1024, 2048)
+        BlockSizes(4096, 2048)
+    assert BlockSizes.for_shape(16, 8192, 128) == BlockSizes(4096, 2048)
+    assert default_fused_bwd_block_sizes(128, jnp.bfloat16) == \
+        BlockSizes(512, 4096)
+    assert default_fused_bwd_block_sizes(128, jnp.bfloat16, 1024) == \
+        BlockSizes(512, 512)
     assert default_bwd_block_sizes(128, jnp.bfloat16, None) == \
         BlockSizes(1024, 1024)
     assert default_bwd_block_sizes(128, jnp.float32, None) == \
