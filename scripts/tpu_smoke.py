@@ -595,6 +595,22 @@ def _():
     return jnp.zeros(()), jnp.zeros(()), 1.0
 
 
+@case("compile/causal 32k big tile: bound == online")
+def _():
+    # value check at the REAL causal default tile (2048x2048): the
+    # bound-max and online-max kernels are independent code paths whose
+    # exact math agrees; bf16 rounding under different accumulation
+    # orders lands at ~8e-3 at this scale (measured), so 1e-2 catches a
+    # real divergence while the default 2e-2 contract would mask one
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(kq, (32768, 128), jnp.bfloat16)
+    k = jax.random.normal(kk, (32768, 128), jnp.bfloat16)
+    v = jax.random.normal(kv, (32768, 128), jnp.bfloat16)
+    a = flash_attention(q, k, v, causal=True, max_mode="bound")
+    b = flash_attention(q, k, v, causal=True, max_mode="online")
+    return a.astype(jnp.float32), np.asarray(b, np.float32), 1e-2
+
+
 @case("compile/bf16 vjp + big fwd tile @32q4kv 16k")
 def _():
     q = _arr(32, 16384, 128).astype(jnp.bfloat16)
