@@ -41,6 +41,19 @@ _STAT_LANES = 128  # stats are carried lane-replicated: min f32 tile is (8, 128)
 _LOG2E = 1.4426950408889634  # log2(e)
 _LN2 = 0.6931471805599453  # 1/log2(e)
 
+# Bound mode's runtime safety threshold, in log2 units.  The bound kernel
+# computes p = exp2(s - b) with b >= the true row max; every probability
+# is scaled by 2^-(overshoot).  fp32 normals reach 2^-126, so overshoot
+# past ~126 silently underflows ALL of a row's probabilities -> l = 0 ->
+# the div-guard returns zeros.  96 keeps the per-row max probability a
+# normal float with 30 log2 units of margin, and entries within 2^-26 of
+# it exactly representable (bf16 inputs carry ~2^-8 anyway).  Calls whose
+# estimated overshoot exceeds this self-demote to the online kernel
+# (`_bound_overshoot_estimate`) — the analog of the reference *buying*
+# its fp32 headroom deliberately (attention-mpi.c:224-225) rather than
+# assuming it.
+SAFE_OVERSHOOT_LOG2 = 96.0
+
 
 def _compiler_params(semantics, vmem_limit_bytes=None):
     """CompilerParams with dimension semantics, tolerant of API spelling
@@ -102,15 +115,16 @@ class BlockSizes(NamedTuple):
         if d <= 128 and m >= 8192:
             if window is not None:
                 return cls(512, 512)
-            if not _vmem_limit_supported():
-                # without the raised budget the big tiles cannot
-                # compile: keep the round-3 defaults that fit ~16 MB
+            if not (_vmem_limit_supported() and _big_tile_device()):
+                # without the raised budget (old pallas) or enough
+                # physical VMEM (v2/v3 cores ~16 MB accept the kwarg
+                # but cannot honor it) the big tiles cannot compile:
+                # keep the round-3 defaults that fit ~16 MB
                 return cls(1024, 1024) if returns_stats else cls(2048, 1024)
             # padding-aware: _flash_call pads m to a block_q multiple,
             # so a 4096-row tile on e.g. m=10240 would compute +20%
-            # garbage rows; step down when 4096 does not divide
-            bq = 4096 if m % 4096 == 0 else (2048 if m % 2048 == 0
-                                             else 2048)
+            # garbage rows; 2048 bounds the padding at 2047 rows
+            bq = 4096 if m % 4096 == 0 else 2048
             if causal:
                 # the diagonal wastes more of a taller tile: 2048x2048
                 # measured 1.580 ms at causal 32k vs 1.643 for the
@@ -130,6 +144,23 @@ def _vmem_limit_supported() -> bool:
         return True
     except TypeError:
         return False
+
+
+@functools.cache
+def _big_tile_device() -> bool:
+    """Whether the default device's physical VMEM can hold the big-tile
+    defaults (~110 MB scoped budget).  `_vmem_limit_supported` only
+    proves the API accepts the kwarg; a v2/v3 core (~16 MB VMEM) would
+    accept it and then fail to compile, so gate on the generation too.
+    Non-TPU backends (pallas interpret mode) have no VMEM to exhaust."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # noqa: BLE001 - no backend at all
+        return False
+    if dev.platform != "tpu":
+        return True
+    kind = getattr(dev, "device_kind", "").lower()
+    return any(gen in kind for gen in ("v4", "v5", "v6", "v7"))
 
 
 def _ceil_to(x: int, mult: int) -> int:
@@ -256,20 +287,31 @@ def _flash_kernel(
             compute_tile, kv_idx * block_k < offsets_ref[2]
         )
 
+    tile_kwargs = dict(
+        valid=offsets_ref[2] if dynamic_valid else None,
+        q_offset=offsets_ref[0],
+        kv_offset=offsets_ref[1],
+        kv_idx=kv_idx, q_idx=q_idx,
+        n_true=n_true, block_k=block_k,
+        block_q=block_q,
+        q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
+        softcap2=softcap2,
+        bound_mode=bound_mode,
+    )
+    # Round-5 measured NEGATIVE result: splitting the body into an
+    # interior fast path (mask chain statically compiled out for tiles
+    # fully inside the causal triangle / window band) vs a diagonal
+    # path — two @pl.when bodies on complementary predicates — ran
+    # SLOWER on the real chip (causal 32k 1.72 ms vs 1.65 single-body
+    # same-session; windowed w=1024 0.36 vs 0.21): Mosaic schedules
+    # the dual-body step worse than it pays for the skipped VPU mask
+    # chain.  Single masked body kept (the reference's aligned-vs-tail
+    # split, attention-mpi.c:107-119, does not transplant here).
     @pl.when(compute_tile)
     def _compute():
-        _flash_tile(
-            q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
-            valid=offsets_ref[2] if dynamic_valid else None,
-            q_offset=offsets_ref[0],
-            kv_offset=offsets_ref[1],
-            kv_idx=kv_idx, q_idx=q_idx,
-            n_true=n_true, block_k=block_k, causal=causal,
-            block_q=block_q,
-            q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
-            window=window, softcap2=softcap2, sinks=sinks,
-            bound_mode=bound_mode,
-        )
+        _flash_tile(q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+                    causal=causal, window=window, sinks=sinks,
+                    **tile_kwargs)
 
     @pl.when(jb == pl.num_programs(2) - 1)
     def _finalize():
@@ -312,7 +354,7 @@ def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
     block_q, q_seg_ref=None, kv_seg_ref=None, window=None, softcap2=None,
-    sinks=None, kv_min=None, bound_mode=False,
+    sinks=None, kv_min=None, bound_mode=False, pos_mod=None,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
@@ -320,7 +362,11 @@ def _flash_tile(
     valid (static masking only).  ``q_seg_ref``/``kv_seg_ref`` are
     segment-id blocks (lane-replicated (block_q, 128) / sublane-
     replicated (8, block_k) — see `segment_masks`); scores cross segment
-    boundaries are masked."""
+    boundaries are masked.  ``pos_mod`` (static): the tile's rows pack
+    several independent row streams (GQA group heads, or a speculative
+    verify chunk replicated per head) — the row's SEQUENCE position is
+    ``q_offset + row % pos_mod`` instead of ``q_offset + row``, so
+    causal/window masks repeat every ``pos_mod`` rows."""
     dynamic_valid = valid is not None
     segmented = q_seg_ref is not None
     banded = kv_min is not None  # decode-side window: cols in
@@ -351,9 +397,12 @@ def _flash_tile(
         )
         mask = col < (valid if dynamic_valid else n_true)
         if causal:
-            row = q_idx * block_q + jax.lax.broadcasted_iota(
+            row = jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, dimension=0
             )
+            if pos_mod is not None:
+                row = jax.lax.rem(row, pos_mod)
+            row = q_idx * block_q + row
             mask = jnp.logical_and(
                 mask, col + kv_offset <= row + q_offset
             )
@@ -435,6 +484,98 @@ def _online_softmax_update(s, m_scr, l_scr, *, masked):
     m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
     l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
     return p, corr
+
+
+def _bound_overshoot_estimate(q, k, knmax, offsets, *, m, n, group,
+                              causal, window, sinks, softcap2,
+                              q_segment_ids, kv_segment_ids,
+                              static_diag=False):
+    """Upper bound on bound-mode's per-row overshoot (log2 units).
+
+    Bound mode subtracts the Cauchy-Schwarz row bound ``b`` instead of
+    the true row max ``max_s``; correctness only needs the overshoot
+    ``b - max_s`` to stay inside fp32 exp2 range (SAFE_OVERSHOOT_LOG2).
+    ``max_s`` is unknown without running QK^T, but any single column
+    certified attended for the row gives ``s_ref <= max_s``, hence
+    ``b - s_ref >= b - max_s`` — a cheap O(m*d) overestimate computed
+    from one gathered K row per query row.  Reference columns:
+
+      * non-causal: column 0 (attended whenever any column is valid);
+      * causal: the diagonal clipped into the valid prefix (column 0 is
+        also always attended once the diagonal is local, but the
+        diagonal score is far tighter for real models);
+      * windowed: the clipped diagonal when it lies in the band, else
+        sink column 0 when sinks exist;
+      * rows that attend NO columns are excluded — for them bound-mode
+        underflow produces exactly the correct zeros.
+
+    Segmented calls certify the reference column only when it shares
+    the row's segment; otherwise the row reports +inf (conservative
+    demotion).  ``q`` arrives pre-scaled into the log2 domain, so the
+    returned value is directly comparable to SAFE_OVERSHOOT_LOG2.
+
+    ``static_diag``: the caller statically knows row i's reference IS
+    kv row i (plain causal self-attention: no offsets, no kv_valid,
+    m == n) — the diagonal reference becomes a fused elementwise
+    q*k pass with NO gather and no exclusions (the diagonal is always
+    attended and always inside any window).  This keeps the guard at
+    ~1% of a causal 32k forward; the general gather path is reserved
+    for sharded/offset callers.
+    """
+    h = q.shape[0]
+    hkv = k.shape[0]
+    q32 = q[:, :m].astype(jnp.float32)  # (h, m, d), pre-scaled
+    qn = jnp.sqrt(jnp.sum(q32 * q32, axis=-1))  # (h, m)
+    b = qn * knmax[:, None]
+    if softcap2 is not None:
+        b = jnp.minimum(b, softcap2)
+    rows = jnp.arange(m, dtype=jnp.int32)
+    valid = offsets[2]
+    c_ref = None
+    if causal and static_diag:
+        kr = k[:, :n]  # row-aligned diagonal reference, pure elementwise
+        excluded = jnp.zeros((m,), bool)
+    elif causal:
+        diag = rows + offsets[0] - offsets[1]  # this row's own kv column
+        excluded = diag < 0  # whole local shard is in the row's future
+        c_ref = jnp.clip(jnp.minimum(diag, valid - 1), 0, n - 1)
+        if window is not None:
+            in_win = c_ref >= diag - (window - 1)
+            if sinks is not None:
+                # out-of-band rows still attend sink column 0
+                c_ref = jnp.where(in_win, c_ref, 0)
+            else:
+                # clipped diagonal below the band start <=> the band
+                # misses the valid prefix entirely: nothing attended
+                excluded = jnp.logical_or(excluded,
+                                          jnp.logical_not(in_win))
+        # gather in the STORAGE dtype; the cast fuses into the reduce
+        # (an fp32 gather materializes 2x the bytes for nothing)
+        kr = jnp.take(k[:, :n], c_ref, axis=1)  # (hkv, m, d)
+    else:
+        # column 0 for every row: (hkv, 1, d) broadcast, no gather
+        kr = k[:, :1, :]
+        excluded = jnp.zeros((m,), bool)
+    excluded = jnp.logical_or(excluded, valid <= 0)
+    s_ref = jnp.sum(
+        q32.reshape(hkv, group, m, q32.shape[-1])
+        * kr.astype(jnp.float32)[:, None], axis=-1
+    ).reshape(h, m)
+    if softcap2 is not None:
+        # monotone, so cap(s_ref) <= cap(max_s): still a lower bound
+        s_ref = softcap2 * jnp.tanh(s_ref / softcap2)
+    over = b - s_ref
+    if q_segment_ids is not None:
+        kv_ids = jnp.asarray(kv_segment_ids, jnp.int32)
+        if causal and static_diag:
+            ref_ids = kv_ids  # row-aligned diagonal reference
+        elif c_ref is None:
+            ref_ids = kv_ids[0]
+        else:
+            ref_ids = jnp.take(kv_ids, c_ref)
+        match = ref_ids == jnp.asarray(q_segment_ids, jnp.int32)
+        over = jnp.where(match[None, :], over, jnp.inf)
+    return jnp.max(jnp.where(excluded[None, :], 0.0, over))
 
 
 def _flash_call(
@@ -529,8 +670,16 @@ def _flash_call(
     grid = (h, m_pad // block_q, sink_blocks + band_blocks)
 
     bound_mode = max_mode == "bound"
-    kernel = functools.partial(
-        _flash_kernel,
+    if bound_mode and window is not None:
+        # Measured (round 5, device clock): on banded grids the bound
+        # kernel's VPU saving is within noise of the online kernel
+        # (w=1024@32k: 0.227 ms online vs 0.21 bound) while the
+        # runtime overshoot guard is a FLAT cost that dwarfs the tiny
+        # band kernel (+70% at w=1024).  Same outputs either way —
+        # windowed calls statically resolve to the online recurrence.
+        bound_mode = False
+    softcap2 = None if softcap is None else softcap * _LOG2E
+    kernel_kwargs = dict(
         n_true=n,
         block_k=block_k,
         causal=causal,
@@ -541,10 +690,9 @@ def _flash_call(
         segmented=segmented,
         window=window,
         n_true_blocks=num_kv_blocks,
-        softcap2=None if softcap is None else softcap * _LOG2E,
+        softcap2=softcap2,
         sinks=sinks,
         sink_blocks=sink_blocks,
-        bound_mode=bound_mode,
     )
 
     offsets = jnp.stack(
@@ -602,15 +750,31 @@ def _flash_call(
         # (exact kernel operands: the pre-scaled, re-rounded Q — its
         # norm is computed in-kernel from the resident block — and the
         # padded K).  Softmax output and lse are invariant to the
-        # choice of max as long as it is >= the true row max, so any
-        # overshoot costs only fp32 headroom (contract: overshoot must
-        # stay < ~120 log2 units; Cauchy-Schwarz on attention shapes is
-        # orders of magnitude inside that).
+        # choice of max as long as it is >= the true row max, so
+        # overshoot costs only fp32 headroom — and that headroom is
+        # ENFORCED at runtime: `_bound_overshoot_estimate` bounds the
+        # worst-row overshoot from the same operands, and calls that
+        # might leave the fp32 exp2 range (adversarial norms, LLM
+        # outlier K channels) self-demote to the online kernel below.
         k32 = k.astype(jnp.float32)
         knmax = jnp.repeat(
             jnp.max(jnp.sqrt(jnp.sum(k32 * k32, axis=-1)), axis=-1),
             group,
         )  # (h,) f32, indexed by the head grid dim in `_init`
+        bound_safe = (
+            _bound_overshoot_estimate(
+                q, k, knmax, offsets, m=m, n=n, group=group,
+                causal=causal, window=window, sinks=sinks,
+                softcap2=softcap2, q_segment_ids=q_segment_ids,
+                kv_segment_ids=kv_segment_ids,
+                # gather-free diagonal reference for plain causal
+                # self-attention (the training/bench shape)
+                static_diag=(causal and q_offset is None
+                             and kv_offset is None and kv_valid is None
+                             and m == n),
+            )
+            <= SAFE_OVERSHOOT_LOG2
+        )
     else:
         knmax = jnp.zeros((1,), jnp.float32)  # unused placeholder
     seg_inputs = ()
@@ -637,8 +801,6 @@ def _flash_call(
         )
         out_shapes += [stat_shape, stat_shape]
         out_specs += [stat_spec, stat_spec]
-    else:
-        kernel = functools.partial(_no_stat_kernel, kernel)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -667,22 +829,40 @@ def _flash_call(
     # windowed grids only visit the band's KV columns
     n_eff = band_blocks * block_k
     flops = 2 * h * m_pad * n_eff * (d + dv)
-    outs = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=out_shapes,
-        compiler_params=compiler_params,
-        cost_estimate=pl.CostEstimate(
-            flops=flops,
-            bytes_accessed=int(
-                (q.size + (k.size + v.size) * n_eff // n_pad)
-                * q.dtype.itemsize
-            )
-            + h * m_pad * dv * 4,
-            transcendentals=h * m_pad * n_eff,
-        ),
-        interpret=interpret,
-    )(offsets, knmax, q, k, v, *seg_inputs)
+
+    def _run(bound: bool):
+        kern = functools.partial(_flash_kernel, bound_mode=bound,
+                                 **kernel_kwargs)
+        if not return_stats:
+            kern = functools.partial(_no_stat_kernel, kern)
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=out_shapes,
+            compiler_params=compiler_params,
+            cost_estimate=pl.CostEstimate(
+                flops=flops,
+                bytes_accessed=int(
+                    (q.size + (k.size + v.size) * n_eff // n_pad)
+                    * q.dtype.itemsize
+                )
+                + h * m_pad * dv * 4,
+                transcendentals=h * m_pad * n_eff,
+            ),
+            interpret=interpret,
+        )(offsets, knmax, q, k, v, *seg_inputs)
+
+    if bound_mode:
+        # Self-demotion (runtime, data-dependent): the bound kernel is
+        # provably exact only while the overshoot stays inside fp32
+        # exp2 range; past SAFE_OVERSHOOT_LOG2 the online kernel runs
+        # instead.  Both branches compile once; the predicate is a
+        # scalar and the guard's own cost is O(m*d) — ~1% of a 32k
+        # forward, 0 of the grid's FLOPs.
+        outs = jax.lax.cond(bound_safe,
+                            lambda: _run(True), lambda: _run(False))
+    else:
+        outs = _run(False)
 
     out = outs[0][:, :m]
     if return_stats:
@@ -815,6 +995,10 @@ def flash_attention(
     ``max_mode="bound"`` (VFA, PAPERS.md) replaces the in-kernel online
     max with a precomputed Cauchy-Schwarz row bound — same output and
     stats (softmax is max-choice invariant), shorter per-tile VPU chain.
+    Bound mode is runtime-guarded: when the estimated worst-row
+    overshoot could leave fp32 exp2 range (adversarial norms, outlier K
+    channels), the call self-demotes to the online kernel
+    (`_bound_overshoot_estimate`), so the result is exact either way.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)

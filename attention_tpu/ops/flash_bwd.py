@@ -57,6 +57,7 @@ from attention_tpu.ops.flash import (
     _STAT_LANES,
     NEG_INF,
     BlockSizes,
+    _big_tile_device,
     _ceil_to,
     _compiler_params,
     _vmem_limit_supported,
@@ -448,15 +449,17 @@ def _fused_plan(m, n, d, dv, block_sizes, dtype, window=None):
 
 
 def _fused_chunk_choice(m, n, d, dv, block_sizes, dtype, *, window,
-                        sinks, segmented):
+                        segmented):
     """The Q-row chunk size the chunked-fused path would use, or None
     when that path can't serve the call (feature flags, explicit tiles,
     whole-m already fits, or no candidate fits VMEM).  The SINGLE
     eligibility definition shared by `flash_backward`'s dispatch and
     `fused_backward_applicable` — bench.py keys FLOP accounting off the
-    latter, so the two must never drift."""
+    latter, so the two must never drift.  Sinks deliberately do NOT
+    gate chunking: each chunk patches its sink sliver via per-chunk
+    q_offset (`_sink_patch`), so they are chunk-compatible by design."""
     if (segmented or block_sizes is not None
-            or not _vmem_limit_supported()
+            or not _vmem_limit_supported() or not _big_tile_device()
             or _fused_plan(m, n, d, dv, None, dtype, window) is not None):
         return None
     return next(
@@ -475,8 +478,10 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
     — whole (the resident-dQ plan fits) or Q-chunked (default tiles
     only, any chunk candidate fits).  bench.py keys its executed-FLOPs
     accounting off this: fused executes 10·mnd backward FLOPs, the
-    two-kernel path 14·mnd."""
-    if not _vmem_limit_supported():
+    two-kernel path 14·mnd.  ``sinks`` stays in the signature so
+    callers describe the full call, but never gates eligibility —
+    sinks are chunk-compatible by design (`_fused_chunk_choice`)."""
+    if not _vmem_limit_supported() or not _big_tile_device():
         return False
     n_eff = n if n is not None else m
     dv_eff = dv if dv is not None else d
@@ -485,7 +490,7 @@ def fused_backward_applicable(m: int, d: int, *, window, sinks,
         return True  # segments ride whole-fused; chunking excludes them
     return _fused_chunk_choice(
         m, n_eff, d, dv_eff, block_sizes, dtype,
-        window=window, sinks=sinks, segmented=segmented) is not None
+        window=window, segmented=segmented) is not None
 
 
 def _fused_backward(qs, k, v, lse_rep, delta_rep, do, offsets, *,
@@ -780,8 +785,7 @@ def flash_backward(
     # path's per-shard precision (each shard's dK/dV are cast before
     # the psum there too).
     chunk = _fused_chunk_choice(m, n, d, dv, block_sizes, q.dtype,
-                                window=window, sinks=sinks,
-                                segmented=segmented)
+                                window=window, segmented=segmented)
     if chunk is not None:
         base_off = 0 if q_offset is None else q_offset
         dq_parts = []

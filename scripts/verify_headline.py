@@ -26,13 +26,20 @@ def main() -> int:
     p.add_argument("--seq", type=int, default=131072)
     p.add_argument("--dim", type=int, default=128)
     p.add_argument("--seed", type=int, default=7)
+    p.add_argument(
+        "--max-mode", choices=("online", "bound"), default="bound",
+        help="kernel mode to verify — must match the mode the headline "
+        "times (bench.py default: bound); the record carries it and "
+        "bench.py refuses to reuse a cached record for a different mode",
+    )
     args = p.parse_args()
 
     import jax
 
     from bench import _headline_contract
 
-    rec = _headline_contract(args.seq, args.dim, seed=args.seed)
+    rec = _headline_contract(args.seq, args.dim, seed=args.seed,
+                             max_mode=args.max_mode)
     rec["platform"] = str(jax.devices()[0])
     rec["date"] = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d")
