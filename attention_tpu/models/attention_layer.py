@@ -408,7 +408,9 @@ class GQASelfAttention(nn.Module):
     def _decode_call(self, q1, kr, vc, lens, **kw):
         """The fused decode kernel — head-sharded over ``tp_axis`` when
         serving tensor-parallel, local otherwise.  Shared by the dense,
-        rolling, and ragged cache paths."""
+        rolling, and ragged cache paths.  A 4-D ``q1`` (B, H, S, d)
+        runs the speculative-verify chunk kernel instead (``lens`` is
+        then the post-append length)."""
         if self.tp_axis is not None:
             from attention_tpu.parallel.serving import head_sharded_decode
 
@@ -416,6 +418,10 @@ class GQASelfAttention(nn.Module):
                 q1, kr, vc, lens, mesh=self.mesh,
                 axis_name=self.tp_axis, **kw,
             )
+        if q1.ndim == 4:
+            from attention_tpu.ops.decode import flash_decode_chunk
+
+            return flash_decode_chunk(q1, kr, vc, lens, **kw)
         return flash_decode(q1, kr, vc, lens, **kw)
 
     def _batch_flash_call(self, q, k, v, **kw):
@@ -588,55 +594,81 @@ class GQASelfAttention(nn.Module):
         return out, RollingKVCache(kc, vc, cache.length + s_new)
 
     def _ragged_attention(self, q, k, v, cache: RaggedKVCache):
-        """One decode step per sequence at per-sequence positions."""
+        """S == 1: one decode step per sequence at per-sequence
+        positions.  S > 1: a speculative-verify chunk append — S rows
+        written at each sequence's length, scored causally in one cache
+        stream (`ops.decode.flash_decode_chunk`)."""
         if self.impl != "flash":
             raise ValueError(
                 f"impl {self.impl!r} has no ragged-cache path "
                 "(supported: ['flash'])"
             )
-        if q.shape[2] != 1:
-            raise ValueError(
-                "RaggedKVCache supports single-token decode steps; "
-                "prefill padded prompts on a KVCache, then "
-                "RaggedKVCache.from_prefill"
-            )
+        s_new = q.shape[2]
         write = jax.vmap(
-            lambda buf, row, i: jax.lax.dynamic_update_slice(
-                buf, row, (jnp.int32(0), i, jnp.int32(0))
+            lambda buf, rows, i: jax.lax.dynamic_update_slice(
+                buf, rows, (jnp.int32(0), i, jnp.int32(0))
             )
         )
         kc = write(cache.k, k.astype(cache.k.dtype), cache.lengths)
         vc = write(cache.v, v.astype(cache.v.dtype), cache.lengths)
-        new_lengths = cache.lengths + 1
+        new_lengths = cache.lengths + s_new
         # Sliding-window serving on the ragged cache: each query sits at
         # its own len-1, so the decode kernel's per-sequence [len-w, len)
         # band (+ pinned sinks) applies directly; with RoPE the sink
-        # re-rotation delta is per-sequence.
+        # re-rotation delta is per-sequence.  Chunk appends keep
+        # absolute rotations (the dense path's rule for s_new > 1).
         kr = kc
-        if self.rope and self.attn_sinks and self.window is not None:
+        if (self.rope and self.attn_sinks and self.window is not None
+                and s_new == 1):
             kr = _sink_read_keys(kc, new_lengths, self.window,
                                  self.attn_sinks, self.rope_theta)
-        out = self._decode_call(
-            q[:, :, 0, :], kr, vc, new_lengths, softcap=self.softcap,
-            window=self.window, sinks=self.attn_sinks or None,
-        )[:, :, None, :]
+        if s_new == 1:
+            out = self._decode_call(
+                q[:, :, 0, :], kr, vc, new_lengths, softcap=self.softcap,
+                window=self.window, sinks=self.attn_sinks or None,
+            )[:, :, None, :]
+        else:
+            out = self._decode_call(
+                q, kr, vc, new_lengths, softcap=self.softcap,
+                window=self.window, sinks=self.attn_sinks or None,
+            )
         # per-sequence overflow poison (same loud-overflow contract)
         over = new_lengths > cache.k.shape[2]
         out = jnp.where(over[:, None, None, None], jnp.nan, out)
         return out.astype(q.dtype), RaggedKVCache(kc, vc, new_lengths)
 
     def _paged_attention(self, q, k, v, cache: PagedKV):
-        """One decode step per sequence through the page table."""
+        """S == 1: one decode step per sequence through the page table.
+        S > 1: a speculative-verify chunk append (rows written through
+        the table row-by-row, scored causally in one pool stream)."""
         if self.impl != "flash":
             raise ValueError(
                 f"impl {self.impl!r} has no paged-cache path "
                 "(supported: ['flash'])"
             )
-        if q.shape[2] != 1:
-            raise ValueError(
-                "PagedKV supports single-token decode steps; prefill on "
-                "a dense KVCache, then ops.paged.paged_from_dense"
-            )
+        s_new = q.shape[2]
+        if s_new > 1:
+            from attention_tpu.ops.paged import paged_append_chunk
+
+            cache = paged_append_chunk(cache, k, v)
+            if self.tp_axis is not None:
+                from attention_tpu.parallel.serving import (
+                    head_sharded_decode_paged,
+                )
+
+                out = head_sharded_decode_paged(
+                    q, cache, mesh=self.mesh, axis_name=self.tp_axis,
+                    softcap=self.softcap, window=self.window,
+                    sinks=self.attn_sinks or None,
+                )
+            else:
+                # rope+sinks chunk appends keep absolute rotations (the
+                # dense path's s_new > 1 rule), so no sink read copy
+                out = paged_flash_decode(
+                    q, cache, softcap=self.softcap,
+                    window=self.window, sinks=self.attn_sinks or None,
+                )
+            return out.astype(q.dtype), cache
         cache = paged_append(cache, k, v)
         if self.rope and self.attn_sinks and self.window is not None:
             if self.tp_axis is not None:
@@ -678,18 +710,38 @@ class GQASelfAttention(nn.Module):
 
     def _quantized_decode(self, q, k, v, cache: QuantKVCache):
         """One decode step against an int8 cache: quantize the new KV
-        row in, run the fused quantized kernel.  Decode-only — prefill
-        runs on the bf16 `KVCache`, then `KVCache.quantize()` converts."""
-        if q.shape[2] != 1:
-            raise ValueError(
-                "QuantKVCache supports single-token decode steps; prefill "
-                "on a bf16 KVCache, then .quantize() it"
-            )
+        row in, run the fused quantized kernel.  Prefill runs on the
+        bf16 `KVCache`, then `KVCache.quantize()` converts.  S > 1 is a
+        speculative-verify chunk: rows quantize-append, then score
+        causally in one int8 stream
+        (`ops.quant.flash_decode_quantized_chunk`)."""
         if self.impl != "flash":
             raise ValueError(
                 f"impl {self.impl!r} has no quantized-cache path "
                 "(supported: ['flash'])"
             )
+        s_new = q.shape[2]
+        if s_new > 1:
+            kv = update_quantized_kv(cache.kv, k, v, cache.length)
+            new_len = cache.length + s_new
+            if self.tp_axis is not None:
+                from attention_tpu.parallel.serving import (
+                    head_sharded_decode_quantized,
+                )
+
+                out = head_sharded_decode_quantized(
+                    q, kv, new_len, mesh=self.mesh,
+                    axis_name=self.tp_axis, softcap=self.softcap,
+                    window=self.window, sinks=self.attn_sinks or None)
+            else:
+                from attention_tpu.ops.quant import (
+                    flash_decode_quantized_chunk,
+                )
+
+                out = flash_decode_quantized_chunk(
+                    q, kv, new_len, softcap=self.softcap,
+                    window=self.window, sinks=self.attn_sinks or None)
+            return out.astype(q.dtype), QuantKVCache(kv, new_len)
         kv = update_quantized_kv(cache.kv, k, v, cache.length)
         new_len = cache.length + 1
         kr = kv

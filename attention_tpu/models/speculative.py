@@ -2,11 +2,10 @@
 
 Serving capability beyond the reference: a small draft model proposes
 ``gamma`` tokens autoregressively; the target model scores all of them
-in ONE chunked-prefill forward (the flash kernel's S>1 cached path);
-the longest prefix agreeing with the target's own greedy choices is
-accepted plus one corrected token.  Greedy speculative decoding is
-EXACT: emitted tokens equal target-only greedy decoding, token for
-token — verified by test.
+in ONE chunked forward; the longest prefix agreeing with the target's
+own greedy choices is accepted plus one corrected token.  Greedy
+speculative decoding is EXACT: emitted tokens equal target-only greedy
+decoding, token for token — verified by test.
 
 TPU shape discipline: the whole loop is one ``lax.while_loop`` whose
 carry holds both models' KV caches; every iteration runs exactly
@@ -16,6 +15,15 @@ chunk — all static shapes, acceptance handled with masked writes into
 an over-allocated output buffer.  Cache rollback is free: ``length``
 is part of the cache carry, and stale rows past it are overwritten by
 later writes and masked out of attention reads.
+
+The target's serving cache composes across the whole cache matrix
+(``cache_type``): dense bf16, ragged (per-sequence lengths), int8
+(quantized append, `ops.quant.flash_decode_quantized_chunk`), and
+paged (page-table append; rollback is a length rewind — pages are
+claimed up front by `paged_from_dense`, so rejected rows are simply
+overwritten, never unclaimed).  The draft always drafts on a dense
+cache: it runs single-token decodes only, and its scratch cache's
+representation is orthogonal to the serving cache under test.
 
 Batch = 1 (per-sequence acceptance lengths would rag the uniform
 cache ``length``); batch serving composes by vmapping the whole
@@ -30,13 +38,37 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from attention_tpu.models.attention_layer import RaggedKVCache
 from attention_tpu.models.transformer import TinyDecoder
 
+CACHE_TYPES = ("dense", "ragged", "int8", "paged")
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("target", "draft", "steps", "gamma", "capacity"),
-)
+
+@functools.cache
+def _jitted_apply(model):
+    """One cached jit per model (flax Modules hash by config): repeat
+    generate_speculative calls reuse the prefill trace instead of
+    re-tracing through a fresh jax.jit wrapper every request."""
+    return jax.jit(model.apply)
+
+
+def _set_len(caches, length):
+    """Rewind/advance every cache's length field — the rollback
+    primitive.  Works across the cache matrix: scalar ``length``
+    (dense KVCache, QuantKVCache) and per-sequence ``lengths``
+    (RaggedKVCache, PagedKV)."""
+    from attention_tpu.ops.paged import PagedKV
+
+    out = []
+    for c in caches:
+        if isinstance(c, (RaggedKVCache, PagedKV)):
+            out.append(c._replace(
+                lengths=jnp.full_like(c.lengths, length)))
+        else:
+            out.append(c._replace(length=length))
+    return tuple(out)
+
+
 def generate_speculative(
     target: TinyDecoder,
     target_params,
@@ -47,12 +79,15 @@ def generate_speculative(
     steps: int,
     gamma: int = 4,
     capacity: int | None = None,
+    cache_type: str = "dense",
+    page_size: int = 128,
 ) -> jax.Array:
     """Greedy speculative generation: (1, S) prompt -> (1, steps).
 
-    Exactly equals ``generate(target, ...)``'s greedy output.  ``gamma``
-    is the draft lookahead per verify step; speedup comes from the
-    target scoring gamma+1 positions per forward instead of one.
+    Exactly equals ``generate(target, ...)``'s greedy output for EVERY
+    ``cache_type``.  ``gamma`` is the draft lookahead per verify step;
+    speedup comes from the target scoring gamma+1 positions per forward
+    instead of one.  ``page_size`` applies to ``cache_type="paged"``.
     """
     if prompt.shape[0] != 1:
         raise ValueError(
@@ -65,6 +100,27 @@ def generate_speculative(
         )
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if cache_type not in CACHE_TYPES:
+        raise ValueError(
+            f"cache_type {cache_type!r} not in {CACHE_TYPES}"
+        )
+    if cache_type != "dense" and target.impl != "flash":
+        raise ValueError(
+            f"cache_type {cache_type!r} requires the target's "
+            f"impl='flash' (got {target.impl!r})"
+        )
+    if target.rope and target.attn_sinks and target.window is not None:
+        # chunk verify keeps absolute sink rotations (every cache
+        # type's s_new > 1 rule) while single-token decode re-rotates
+        # sinks to in-cache positions (`_sink_read_keys`) — the verify
+        # logits would diverge from step decoding and silently break
+        # the greedy-exactness contract; reject loudly instead
+        raise ValueError(
+            "speculative decoding does not compose with rope + window "
+            "+ attn_sinks targets: chunked verify keeps absolute sink "
+            "rotations, single-token decode re-rotates them, so "
+            "emitted tokens would diverge from target-greedy"
+        )
     s = prompt.shape[1]
     # target consumes up to gamma+1 rows per iteration past the prompt;
     # worst case every iteration accepts 0 drafts (1 token emitted, but
@@ -78,22 +134,67 @@ def generate_speculative(
             f"capacity {capacity} must be a 128-multiple >= {need}"
         )
 
+    # Prefill both models on DENSE caches (outside the loop jit: the
+    # paged conversion claims pages host-side), then convert the
+    # target's cache to the serving representation under test.
     t_caches = target.init_caches(1, capacity)
     d_caches = draft.init_caches(1, capacity)
-    t_logits, t_caches = target.apply(
+    t_logits, t_caches = _jitted_apply(target)(
         {"params": target_params}, prompt, t_caches
     )
-    d_logits, d_caches = draft.apply(
+    d_logits, d_caches = _jitted_apply(draft)(
         {"params": draft_params}, prompt, d_caches
     )
-    t_next = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (1,)
-    ctx0 = jnp.asarray(s, jnp.int32)
+    if cache_type == "ragged":
+        t_caches = tuple(
+            RaggedKVCache.from_prefill(c, jnp.full((1,), s, jnp.int32))
+            for c in t_caches
+        )
+    elif cache_type == "int8":
+        t_caches = tuple(c.quantize() for c in t_caches)
+    elif cache_type == "paged":
+        from attention_tpu.ops.paged import PagePool, paged_from_dense
 
+        if capacity % page_size:
+            raise ValueError(
+                f"capacity {capacity} not a multiple of page_size "
+                f"{page_size}"
+            )
+        num_pages = capacity // page_size
+        # claim the FULL capacity up front (the paged token loop's
+        # discipline, ops/paged.py): rollback after rejected drafts
+        # then never needs to unclaim — a length rewind suffices.
+        # One pool per layer: layers are independent physical caches.
+        t_caches = tuple(
+            paged_from_dense(
+                c.k, c.v, jnp.full((1,), s, jnp.int32),
+                PagePool(num_pages),
+                num_pages=num_pages, page_size=page_size,
+                total_pages_per_seq=num_pages,
+            )
+            for c in t_caches
+        )
+    t_next = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (1,)
+
+    return _speculative_loop(
+        target, target_params, draft, draft_params,
+        t_next, t_caches, d_caches,
+        ctx0=s, steps=steps, gamma=gamma,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("target", "draft", "ctx0", "steps", "gamma"),
+)
+def _speculative_loop(
+    target, target_params, draft, draft_params,
+    t_next, t_caches, d_caches, *, ctx0: int, steps: int, gamma: int,
+):
+    """The draft/verify `lax.while_loop` (cache-type-agnostic: the
+    attention layer dispatches chunk scoring per cache class)."""
     buf = jnp.zeros((steps + gamma + 1,), jnp.int32)
     buf = buf.at[0].set(t_next[0])  # first token comes from the prefill
-
-    def set_len(caches, length):
-        return tuple(c._replace(length=length) for c in caches)
 
     def cond(carry):
         _, _, _, _, _, count = carry
@@ -102,7 +203,7 @@ def generate_speculative(
     def body(carry):
         t_next, ctx, t_caches, d_caches, buf, count = carry
         # --- draft gamma+1 tokens (last one only fills the cache row) ---
-        d_caches = set_len(d_caches, ctx)
+        d_caches = _set_len(d_caches, ctx)
 
         def d_step(c, _):
             tok, caches = c
@@ -118,7 +219,7 @@ def generate_speculative(
         drafts = drafts[:, 0]  # (gamma+1,); drafts[gamma] is discarded
 
         # --- one target chunk over [t_next, d1..d_gamma] ---
-        t_caches = set_len(t_caches, ctx)
+        t_caches = _set_len(t_caches, ctx)
         chunk = jnp.concatenate([t_next, drafts[:gamma]])[None]  # (1, g+1)
         logits, t_caches = target.apply(
             {"params": target_params}, chunk, t_caches
@@ -145,15 +246,15 @@ def generate_speculative(
         return (
             preds[accepted][None],
             new_ctx,
-            set_len(t_caches, new_ctx),
-            set_len(d_caches, new_ctx),
+            _set_len(t_caches, new_ctx),
+            _set_len(d_caches, new_ctx),
             buf,
             count + accepted + 1,
         )
 
     # the prefill already emitted one token at buf[0]; both caches hold
     # exactly the prompt's S rows (t_next's KV enters next iteration)
-    carry = (t_next, ctx0, t_caches, d_caches, buf,
-             jnp.asarray(1, jnp.int32))
+    carry = (t_next, jnp.asarray(ctx0, jnp.int32), t_caches, d_caches,
+             buf, jnp.asarray(1, jnp.int32))
     *_, buf, _ = lax.while_loop(cond, body, carry)
     return buf[None, :steps]

@@ -57,7 +57,7 @@ def _decode_kernel(
     lens_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr,
     *, hkv: int, block_k: int, block_q: int, n: int,
     softcap2: float | None = None, window: int | None = None,
-    sinks: int | None = None,
+    sinks: int | None = None, chunk: int | None = None,
 ):
     """One (batch*kv-head, kv-block) grid step of cached decode.
 
@@ -65,13 +65,28 @@ def _decode_kernel(
     each sequence (the query sits at position valid-1), with the first
     ``sinks`` rows pinned (StreamingLLM) — the decode-side counterpart
     of the forward kernel's banded mask.
+
+    ``chunk`` (static): speculative-verify mode — the q block packs
+    ``chunk`` consecutive query tokens per group head ((g, s) rows,
+    s-minor), the per-sequence length is the length AFTER the chunk's
+    rows were appended, and row (g, s) sits at position
+    ``valid - chunk + s``: causal within the chunk, window/sinks bands
+    per row.  One cache stream scores the whole chunk — the
+    arithmetic-intensity win speculative decoding exists for.
     """
     bh = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
     valid = lens_ref[bh // hkv]
+    if chunk is not None:
+        # per-row bands ride the causal+window mask in _flash_tile; the
+        # block-level live/clamp below widens the window by chunk-1 so
+        # every row's band is covered
+        w_eff = None if window is None else window + chunk - 1
+    else:
+        w_eff = window
     kv_min = None
-    if window is not None:
+    if chunk is None and window is not None:
         kv_min = jnp.maximum(valid - window, 0)
 
     @pl.when(j == 0)
@@ -80,17 +95,27 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = banded_live(j, valid, block_k, window, sinks)
+    live = banded_live(j, valid, block_k, w_eff, sinks)
 
     @pl.when(live)
     def _tile():
-        _flash_tile(
-            q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
-            valid=valid, q_offset=0, kv_offset=0,
-            kv_idx=j, q_idx=0,
-            n_true=n, block_k=block_k, causal=False, block_q=block_q,
-            softcap2=softcap2, kv_min=kv_min, sinks=sinks,
-        )
+        if chunk is None:
+            _flash_tile(
+                q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+                valid=valid, q_offset=0, kv_offset=0,
+                kv_idx=j, q_idx=0,
+                n_true=n, block_k=block_k, causal=False, block_q=block_q,
+                softcap2=softcap2, kv_min=kv_min, sinks=sinks,
+            )
+        else:
+            _flash_tile(
+                q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
+                valid=valid, q_offset=valid - chunk, kv_offset=0,
+                kv_idx=j, q_idx=0,
+                n_true=n, block_k=block_k, causal=True, block_q=block_q,
+                softcap2=softcap2, window=window, sinks=sinks,
+                pos_mod=chunk,
+            )
 
     @pl.when(j == num_j - 1)
     def _finalize():
@@ -266,3 +291,118 @@ def flash_decode(
     )(lens, qs, kc, vc)
 
     return out[:, :group].reshape(b, h, dv)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_k", "interpret", "softcap", "window",
+                     "sinks"),
+)
+def flash_decode_chunk(
+    q: jax.Array,          # (B, H, S, d) — S new tokens per sequence
+    k_cache: jax.Array,    # (B, Hkv, N, d), chunk rows ALREADY appended
+    v_cache: jax.Array,    # (B, Hkv, N, dv)
+    new_lengths: jax.Array,  # (B,) int32 lengths AFTER the append
+    *,
+    scale: float | None = None,
+    block_k: int = 2048,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """Score S appended tokens per sequence in ONE cache stream
+    -> (B, H, S, dv).
+
+    The speculative-verify primitive on ragged caches: token s of
+    sequence b sits at position ``new_lengths[b] - S + s`` and attends
+    its causal prefix (window/sinks bands per row).  Equivalent to S
+    sequential `flash_decode` calls but reads the cache once — the
+    chunked-prefill arithmetic-intensity trade (the reference's Q-batch
+    pipelining idea, `attention-mpi.c:268-330`, turned inward), with the
+    whole (group, S) row block as one MXU matmul per KV block (the GQA
+    trick of this module extended to chunk rows)."""
+    check_softcap(softcap)
+    check_band(window, sinks)
+    if q.ndim != 4 or k_cache.ndim != 4 or v_cache.ndim != 4:
+        raise ValueError(
+            f"expected q (B,H,S,d), caches (B,Hkv,N,d): got "
+            f"Q{q.shape} K{k_cache.shape} V{v_cache.shape}"
+        )
+    b, h, s_chunk, d = q.shape
+    bk_, hkv, n, dk = k_cache.shape
+    dv = v_cache.shape[-1]
+    if bk_ != b or v_cache.shape[:3] != (b, hkv, n) or dk != d:
+        raise ValueError(
+            f"cache shapes inconsistent: Q{q.shape} K{k_cache.shape} "
+            f"V{v_cache.shape}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(new_lengths, jnp.int32), (b,))
+
+    # rows pack the whole GQA group's chunk: (g, s) with s minor, so the
+    # kernel's pos_mod=s_chunk recovers each row's token index
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    qs = qs.reshape(b, hkv, group * s_chunk, d).reshape(
+        b * hkv, group * s_chunk, d)
+    rows = group * s_chunk
+    rows_pad = _ceil_to(rows, 16)  # min sublane tile (bf16-safe)
+    if rows_pad != rows:
+        qs = jnp.pad(qs, ((0, 0), (0, rows_pad - rows), (0, 0)))
+
+    block_k = _pick_block_k(n, block_k)
+    kc = k_cache.reshape(b * hkv, n, d)
+    vc = v_cache.reshape(b * hkv, n, dv)
+    w_eff = None if window is None else window + s_chunk - 1
+
+    def kv_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, banded_block_clamp(j, valid, block_k, w_eff, sinks), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n // block_k),
+        in_specs=[
+            pl.BlockSpec((1, rows_pad, d), lambda bh, j, lens_ref: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, dv), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, rows_pad, dv), lambda bh, j, lens_ref: (bh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows_pad, dv), jnp.float32),
+            pltpu.VMEM((rows_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((rows_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, hkv=hkv, block_k=block_k, block_q=rows_pad,
+            n=n,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+            window=window, sinks=sinks, chunk=s_chunk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, rows_pad, dv),
+                                       v_cache.dtype),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * h * s_chunk * n * (d + dv),
+            bytes_accessed=(kc.size + vc.size) * kc.dtype.itemsize
+            + qs.size * qs.dtype.itemsize,
+            transcendentals=b * h * s_chunk * n,
+        ),
+        interpret=interpret,
+    )(lens, qs, kc, vc)
+
+    return out[:, :rows].reshape(b, hkv, group, s_chunk, dv).reshape(
+        b, h, s_chunk, dv)

@@ -141,6 +141,7 @@ def _paged_kernel(
     acc_scr, m_scr, l_scr,
     *, hkv: int, page: int, softcap2,
     window: int | None = None, sinks: int | None = None,
+    chunk: int | None = None,
 ):
     """One (batch*kv-head, logical-page) grid step.
 
@@ -156,8 +157,9 @@ def _paged_kernel(
     num_j = pl.num_programs(1)
     valid = lens_ref[bh // hkv]
     kv_min = None
-    if window is not None:
+    if chunk is None and window is not None:
         kv_min = jnp.maximum(valid - window, 0)
+    w_eff = (window + chunk - 1) if (chunk and window) else window
 
     @pl.when(j == 0)
     def _init():
@@ -165,18 +167,30 @@ def _paged_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = banded_live(j, valid, page, window, sinks)
+    live = banded_live(j, valid, page, w_eff, sinks)
 
     @pl.when(live)
     def _tile():
-        _flash_tile(
-            q_ref, k_ref[0], v_ref[0], acc_scr, m_scr, l_scr,
-            valid=valid, q_offset=0, kv_offset=0,
-            kv_idx=j, q_idx=0,
-            n_true=num_j * page, block_k=page, causal=False,
-            block_q=q_ref.shape[1], softcap2=softcap2,
-            kv_min=kv_min, sinks=sinks,
-        )
+        if chunk is None:
+            _flash_tile(
+                q_ref, k_ref[0], v_ref[0], acc_scr, m_scr, l_scr,
+                valid=valid, q_offset=0, kv_offset=0,
+                kv_idx=j, q_idx=0,
+                n_true=num_j * page, block_k=page, causal=False,
+                block_q=q_ref.shape[1], softcap2=softcap2,
+                kv_min=kv_min, sinks=sinks,
+            )
+        else:
+            # speculative-verify chunk: rows (g, s) s-minor, row (g, s)
+            # at position valid - chunk + s (see decode._decode_kernel)
+            _flash_tile(
+                q_ref, k_ref[0], v_ref[0], acc_scr, m_scr, l_scr,
+                valid=valid, q_offset=valid - chunk, kv_offset=0,
+                kv_idx=j, q_idx=0,
+                n_true=num_j * page, block_k=page, causal=True,
+                block_q=q_ref.shape[1], softcap2=softcap2,
+                window=window, sinks=sinks, pos_mod=chunk,
+            )
 
     @pl.when(j == num_j - 1)
     def _finalize():
@@ -211,10 +225,24 @@ def paged_flash_decode(
     ``window``/``sinks``: sliding-window serving with pinned sink rows
     (same per-sequence logical band as :func:`ops.decode.flash_decode`),
     applied before page translation — out-of-window pages are never
-    DMA'd, so a windowed server could even free them."""
+    DMA'd, so a windowed server could even free them.
+
+    A 4-D ``q`` (B, H, S, d) switches to speculative-verify chunk mode
+    (`ops.decode.flash_decode_chunk` semantics): the S rows are ALREADY
+    appended through the page table, ``cache.lengths`` is the
+    post-append length, and token s of sequence b attends its causal
+    prefix at position ``lengths[b] - S + s`` -> (B, H, S, dv)."""
     check_softcap(softcap)
     check_band(window, sinks)
-    b, h, d = q.shape
+    s_chunk = None
+    if q.ndim == 4:
+        s_chunk = q.shape[2]
+        if return_stats:
+            raise ValueError(
+                "return_stats (the paged_sink_decode merge hook) is a "
+                "decode-step feature; chunk mode has no sink-merge path"
+            )
+    b, h, d = q.shape[0], q.shape[1], q.shape[-1]
     p_, hkv, page, dk = cache.k_pool.shape
     dv = cache.v_pool.shape[-1]
     bt, max_pages = cache.page_table.shape
@@ -237,10 +265,13 @@ def paged_flash_decode(
     lens_raw = jnp.broadcast_to(jnp.asarray(cache.lengths, jnp.int32), (b,))
     lens = jnp.maximum(lens_raw, 0)  # poisoned rows read nothing
     qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
-    qs = qs.reshape(b * hkv, group, d)
-    group_pad = _ceil_to(group, 16)
-    if group_pad != group:
-        qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
+    rows = group if s_chunk is None else group * s_chunk
+    qs = qs.reshape(b * hkv, rows, d)
+    group_pad = _ceil_to(rows, 16)
+    if group_pad != rows:
+        qs = jnp.pad(qs, ((0, 0), (0, group_pad - rows), (0, 0)))
+    w_eff = window if s_chunk is None else (
+        None if window is None else window + s_chunk - 1)
 
     def kv_index(bh, j, lens_ref, tbl_ref):
         # LOGICAL-page clamp (past-the-prefix and, with a window,
@@ -249,7 +280,7 @@ def paged_flash_decode(
         # indices make Pallas elide the DMA.
         bi = bh // hkv
         valid = lens_ref[bi]
-        jj = banded_block_clamp(j, valid, page, window, sinks)
+        jj = banded_block_clamp(j, valid, page, w_eff, sinks)
         # max(..., 0): a length-0 row lands on page_table[bi, 0], which a
         # hand-built PagedKV may legitimately leave as the -1 free-slot
         # sentinel; the output is masked anyway, but the DMA index must
@@ -268,7 +299,7 @@ def paged_flash_decode(
     kernel = functools.partial(
         _paged_kernel, hkv=hkv, page=page,
         softcap2=None if softcap is None else softcap * _LOG2E,
-        window=window, sinks=sinks,
+        window=window, sinks=sinks, chunk=s_chunk,
     )
     if return_stats:
         stat_spec = pl.BlockSpec(
@@ -317,6 +348,10 @@ def paged_flash_decode(
     if not isinstance(outs, (list, tuple)):
         outs = [outs]
 
+    if s_chunk is not None:
+        out = outs[0][:, :rows].reshape(b, h, s_chunk, dv)
+        return jnp.where(lens_raw[:, None, None, None] < 0, jnp.nan,
+                         out.astype(jnp.float32)).astype(out.dtype)
     out = outs[0][:, :group].reshape(b, h, dv)
     if return_stats:
         row_max = outs[1][:, :group, 0].reshape(b, h)
@@ -457,6 +492,32 @@ def paged_append(cache: PagedKV, k_new: jax.Array,
     new_lengths = jnp.where(bad, -1, cache.lengths + 1)
     return cache._replace(k_pool=k_pool, v_pool=v_pool,
                           lengths=new_lengths)
+
+
+def paged_append_chunk(cache: PagedKV, k_new: jax.Array,
+                       v_new: jax.Array) -> PagedKV:
+    """Write S new tokens per sequence (k/v (B, Hkv, S, d)) at each
+    sequence's next slots — the speculative-verify append.
+
+    S single-row appends (S is small and static — the draft lookahead),
+    so page-boundary straddles and the unclaimed-page poison contract
+    are exactly `paged_append`'s, row by row.  Rollback after rejected
+    drafts is a LENGTH rewind (the caller resets ``lengths``): the rows
+    stay claimed in the table and are simply overwritten by the next
+    chunk — pages never need unclaiming because speculative serving
+    claims its full capacity up front (`paged_from_dense`'s
+    ``total_pages_per_seq``), the same up-front-claim discipline the
+    token loop uses."""
+    if (k_new.ndim != 4 or v_new.ndim != 4
+            or k_new.shape[:3] != v_new.shape[:3]):
+        # head dims may differ (dk != dv caches are supported throughout)
+        raise ValueError(
+            f"expected (B, Hkv, S, d) chunks: K{k_new.shape} V{v_new.shape}"
+        )
+    for s in range(k_new.shape[2]):
+        cache = paged_append(cache, k_new[:, :, s:s + 1],
+                             v_new[:, :, s:s + 1])
+    return cache
 
 
 def paged_from_dense(k_cache: jax.Array, v_cache: jax.Array,

@@ -158,18 +158,28 @@ def _decode_q_kernel(
     acc_scr, m_scr, l_scr,
     *, hkv: int, block_k: int, softcap2: float | None = None,
     window: int | None = None, sinks: int | None = None,
+    chunk: int | None = None, unpack=None,
 ):
-    """One (batch*kv-head, kv-block) grid step of int8-cache decode.
+    """One (batch*kv-head, kv-block) grid step of quantized-cache
+    decode (int8, and int4 via ``unpack``).
 
     ``window``/``sinks``: the same per-sequence [len-w, len) band +
-    pinned sink rows as the bf16 decode kernel (ops/decode.py)."""
+    pinned sink rows as the bf16 decode kernel (ops/decode.py).
+    ``chunk``: speculative-verify mode, mirroring
+    `decode._decode_kernel`: rows pack (group, chunk) with s minor,
+    row (g, s) at position ``valid - chunk + s``, causal + per-row
+    window band.  ``unpack``: tile dequantizer (storage block -> bf16
+    values block); None = plain int8 convert.  ONE kernel body serves
+    every storage format so masking/band logic cannot drift between
+    them."""
     bh = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
     valid = lens_ref[bh // hkv]
     kv_min = None
-    if window is not None:
+    if chunk is None and window is not None:
         kv_min = jnp.maximum(valid - window, 0)
+    w_eff = (window + chunk - 1) if (chunk and window) else window
 
     @pl.when(j == 0)
     def _init():
@@ -177,12 +187,15 @@ def _decode_q_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = banded_live(j, valid, block_k, window, sinks)
+    live = banded_live(j, valid, block_k, w_eff, sinks)
+
+    deq = ((lambda x: x.astype(jnp.bfloat16)) if unpack is None
+           else unpack)
 
     @pl.when(live)
     def _tile():
         q = q_ref[0]                       # (group_pad, d), log2-prescaled
-        kq = k_ref[0].astype(q.dtype)      # (block_k, d) int8 -> bf16
+        kq = deq(k_ref[0])                 # (block_k, d) bf16 values
         s = jax.lax.dot_general(
             q, kq, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -194,7 +207,18 @@ def _decode_q_kernel(
             s = softcap2 * jnp.tanh(s / softcap2)
         col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < valid
-        if kv_min is not None:
+        if chunk is not None:
+            # per-row chunk position: causal + window band per row
+            pos = valid - chunk + jax.lax.rem(
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0), chunk
+            )
+            mask = jnp.logical_and(mask, col <= pos)
+            if window is not None:
+                keep = col >= pos - (window - 1)
+                if sinks is not None:
+                    keep = jnp.logical_or(keep, col < sinks)
+                mask = jnp.logical_and(mask, keep)
+        elif kv_min is not None:
             mask = jnp.logical_and(mask, banded_keep(col, kv_min, sinks))
         s = jnp.where(mask, s, NEG_INF)
 
@@ -202,7 +226,7 @@ def _decode_q_kernel(
         v_scale = jnp.max(vs_ref[0], axis=0, keepdims=True)  # (1, block_k)
         pv = jax.lax.dot_general(
             (p * v_scale).astype(jnp.bfloat16),   # dequant folded into P
-            v_ref[0].astype(jnp.bfloat16),
+            deq(v_ref[0]),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -310,6 +334,302 @@ def flash_decode_quantized(
             _decode_q_kernel, hkv=hkv, block_k=block_k,
             softcap2=None if softcap is None else softcap * _LOG2E,
             window=window, sinks=sinks,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, d), jnp.bfloat16),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * n * d,
+            bytes_accessed=kc.size + vc.size + (ks.size + vs.size) * 4
+            + qs.size * 2,
+            transcendentals=b * h * n,
+        ),
+        interpret=interpret,
+    )(lens, qs, kc, ks, vc, vs)
+
+    return out[:, :group].reshape(b, h, d)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_k", "interpret", "softcap", "window",
+                     "sinks"),
+)
+def flash_decode_quantized_chunk(
+    q: jax.Array,          # (B, H, S, d) — S new tokens per sequence
+    cache: QuantizedKV,    # chunk rows ALREADY appended (int8)
+    new_lengths: jax.Array,  # (B,) int32 lengths AFTER the append
+    *,
+    scale: float | None = None,
+    block_k: int = 4096,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """Score S appended tokens against the int8 cache in one stream
+    -> (B, H, S, d): the speculative-verify primitive on the quantized
+    cache (`ops.decode.flash_decode_chunk`'s layout and masking, this
+    module's scales-commute-out dequantization)."""
+    check_softcap(softcap)
+    check_band(window, sinks)
+    if q.ndim != 4:
+        raise ValueError(f"expected q (B,H,S,d), got {q.shape}")
+    b, h, s_chunk, d = q.shape
+    bk_, hkv, n, dk_ = cache.k_q.shape
+    if bk_ != b or dk_ != d or cache.v_q.shape != (b, hkv, n, d):
+        raise ValueError(
+            f"cache shapes inconsistent: Q{q.shape} K{cache.k_q.shape} "
+            f"V{cache.v_q.shape}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(new_lengths, jnp.int32), (b,))
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(jnp.bfloat16)
+    qs = qs.reshape(b * hkv, group * s_chunk, d)
+    rows = group * s_chunk
+    rows_pad = _ceil_to(rows, 16)
+    if rows_pad != rows:
+        qs = jnp.pad(qs, ((0, 0), (0, rows_pad - rows), (0, 0)))
+
+    block_k = _pick_block_k(n, block_k)
+    kc = cache.k_q.reshape(b * hkv, n, d)
+    vc = cache.v_q.reshape(b * hkv, n, d)
+    ks = cache.k_scale.reshape(b * hkv, 8, n)
+    vs = cache.v_scale.reshape(b * hkv, 8, n)
+    w_eff = None if window is None else window + s_chunk - 1
+
+    def kv_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, banded_block_clamp(j, valid, block_k, w_eff, sinks), 0)
+
+    def scale_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, 0, banded_block_clamp(j, valid, block_k, w_eff, sinks))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n // block_k),
+        in_specs=[
+            pl.BlockSpec((1, rows_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, rows_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows_pad, d), jnp.float32),
+            pltpu.VMEM((rows_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((rows_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_q_kernel, hkv=hkv, block_k=block_k,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+            window=window, sinks=sinks, chunk=s_chunk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, rows_pad, d), jnp.bfloat16),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * s_chunk * n * d,
+            bytes_accessed=kc.size + vc.size + (ks.size + vs.size) * 4
+            + qs.size * 2,
+            transcendentals=b * h * s_chunk * n,
+        ),
+        interpret=interpret,
+    )(lens, qs, kc, ks, vc, vs)
+
+    return out[:, :rows].reshape(b, h, s_chunk, d)
+
+
+# ---------------------------------------------------------------------------
+# int4 KV cache (round 5): half the int8 value bytes.  Decode sits at
+# frac 1.00 of the measured HBM streaming ceiling (BENCH_r04), so the
+# only remaining currency is bytes streamed — int4 cuts the VALUE
+# stream to 0.25x bf16; with the 32B/row replicated fp32 scales the
+# total at d=128 is (64+32)/256 = 0.375x bf16 (0.6x of int8's 0.625x
+# — the fixed scale bytes dilute the nibble saving; bench.py's
+# int4_bytes accounting uses the same formula).
+#
+# Packing: two int4 values per int8 byte along the FEATURE dim, split
+# halves — byte f of a row holds feature f in its low nibble and
+# feature f + d/2 in its high nibble, so the in-kernel unpack is two
+# arithmetic shifts and a lane concat (lo half ++ hi half restores
+# natural feature order — no interleave relayout, the trap that made
+# the byte-planar int8 experiment 1.7x slower, see module docstring).
+# Scales stay per-token symmetric absmax (they commute out of both
+# matmuls exactly as in int8).
+# ---------------------------------------------------------------------------
+
+
+class Int4KV(NamedTuple):
+    """int4-packed KV cache: values (B, Hkv, N, d//2) int8 (two nibbles
+    per byte) + per-token fp32 scales (B, Hkv, 8, N), layout-compatible
+    with `QuantizedKV`'s scales."""
+
+    k_q: jax.Array
+    k_scale: jax.Array
+    v_q: jax.Array
+    v_scale: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.k_q.shape[2]
+
+    @property
+    def head_dim(self) -> int:
+        return 2 * self.k_q.shape[3]
+
+
+def _quant_rows_int4(x):
+    """Symmetric per-token absmax int4: (..., N, d) -> packed
+    (..., N, d//2) int8 + (..., 8, N) replicated scales."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"head_dim {d} must be even for int4 packing")
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)  # (..., N)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 7.0)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    q = jnp.clip(q, -7, 7).astype(jnp.int8)
+    lo = q[..., : d // 2]
+    hi = q[..., d // 2:]
+    packed = jnp.bitwise_or(
+        jnp.bitwise_and(lo, 0xF), jnp.left_shift(hi, 4)
+    ).astype(jnp.int8)
+    scale_rep = jnp.broadcast_to(
+        scale[..., None, :], (*scale.shape[:-1], 8, scale.shape[-1])
+    )
+    return packed, scale_rep
+
+
+def _unpack_int4(packed):
+    """(rows, d//2) int8 nibbles -> (rows, d) bf16 in natural feature
+    order: arithmetic shifts sign-extend each nibble, halves concat
+    along lanes (cheap — no element interleave)."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.bfloat16)
+
+
+def quantize_kv_int4(k: jax.Array, v: jax.Array) -> Int4KV:
+    """Quantize full (B, Hkv, N, d) K/V caches to the int4 cache format.
+
+    MEASURED error budget (tests/test_quant.py, RESULTS.md round 5):
+    ~4-8e-2 max abs output error on unit-normal inputs at d=64/128
+    decode shapes — ~30x int8's ~2e-3, dominated by K's nibble
+    granularity (absmax/7 per element) perturbing the logits.  That
+    EXCEEDS the framework's ±0.02 harness contract: int4 is an OPT-IN
+    bytes/quality trade (0.375x bf16 cache bytes at d=128 vs int8's
+    0.625x — scales included) for workloads that tolerate it, NOT a
+    drop-in.  Workloads needing contract-grade logits stay on
+    `quantize_kv` (int8)."""
+    k_q, k_s = _quant_rows_int4(k)
+    v_q, v_s = _quant_rows_int4(v)
+    return Int4KV(k_q, k_s, v_q, v_s)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_k", "interpret", "softcap", "window",
+                     "sinks"),
+)
+def flash_decode_int4(
+    q: jax.Array,          # (B, H, d)
+    cache: Int4KV,
+    lengths: jax.Array,    # (B,) int32 or scalar
+    *,
+    scale: float | None = None,
+    block_k: int = 4096,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """softmax(q K[:len]^T * scale) V[:len] against an int4 cache.
+
+    Same per-sequence band semantics as :func:`flash_decode_quantized`;
+    streams 0.375x the bf16 cache bytes at d=128 (0.6x int8's, scales
+    included).  Error budget:
+    see `quantize_kv_int4`."""
+    check_softcap(softcap)
+    check_band(window, sinks)
+    b, h, d = q.shape
+    bk_, hkv, n, dk_half = cache.k_q.shape
+    if bk_ != b or 2 * dk_half != d or cache.v_q.shape != (b, hkv, n, d // 2):
+        raise ValueError(
+            f"cache shapes inconsistent: Q{q.shape} K{cache.k_q.shape} "
+            f"V{cache.v_q.shape}"
+        )
+    if cache.k_scale.shape != (b, hkv, 8, n) or \
+            cache.v_scale.shape != (b, hkv, 8, n):
+        raise ValueError(
+            f"scale shapes {cache.k_scale.shape}/{cache.v_scale.shape} "
+            f"!= {(b, hkv, 8, n)}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(jnp.bfloat16)
+    qs = qs.reshape(b * hkv, group, d)
+    group_pad = _ceil_to(group, 16)
+    if group_pad != group:
+        qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
+
+    block_k = _pick_block_k(n, block_k)
+    kc = cache.k_q.reshape(b * hkv, n, d // 2)
+    vc = cache.v_q.reshape(b * hkv, n, d // 2)
+    ks = cache.k_scale.reshape(b * hkv, 8, n)
+    vs = cache.v_scale.reshape(b * hkv, 8, n)
+
+    def kv_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, banded_block_clamp(j, valid, block_k, window, sinks), 0)
+
+    def scale_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, 0, banded_block_clamp(j, valid, block_k, window, sinks))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n // block_k),
+        in_specs=[
+            pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d // 2), kv_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+            pl.BlockSpec((1, block_k, d // 2), kv_index),
+            pl.BlockSpec((1, 8, block_k), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, d), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            # ONE kernel body with the int8 path (unpack hook): the
+            # masking/band logic exists in one place for both formats
+            _decode_q_kernel, hkv=hkv, block_k=block_k,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+            window=window, sinks=sinks, unpack=_unpack_int4,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, d), jnp.bfloat16),

@@ -117,11 +117,23 @@ def head_sharded_decode(
     device (q head j reads kv head j // group; chunk r holds q heads
     [r·H/R, (r+1)·H/R) and exactly their kv heads [r·Hkv/R, ...)), so
     each chip runs a complete :func:`flash_decode` on its slice.
+
+    A 4-D ``q`` (B, H, S, d) runs the speculative-verify chunk kernel
+    (:func:`ops.decode.flash_decode_chunk`) per head shard instead —
+    ``lengths`` is then the post-append length.
     """
     lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (q.shape[0],))
     c_spec = P(None, axis_name, None, None)
 
     def kernel(q_local, k_local, v_local, lens_full):
+        if q_local.ndim == 4:
+            from attention_tpu.ops.decode import flash_decode_chunk
+
+            return flash_decode_chunk(
+                q_local, k_local, v_local, lens_full,
+                scale=scale, block_k=block_k, interpret=interpret,
+                softcap=softcap, window=window, sinks=sinks,
+            )
         return flash_decode(
             q_local, k_local, v_local, lens_full,
             scale=scale, block_k=block_k, interpret=interpret,
@@ -170,6 +182,17 @@ def head_sharded_decode_quantized(
     cache_specs = QuantizedKV(f_spec, f_spec, f_spec, f_spec)
 
     def kernel(q_local, cache_local, lens_full):
+        if q_local.ndim == 4:  # speculative-verify chunk (see
+            # head_sharded_decode): per-shard chunk kernel, same layout
+            from attention_tpu.ops.quant import (
+                flash_decode_quantized_chunk,
+            )
+
+            return flash_decode_quantized_chunk(
+                q_local, cache_local, lens_full,
+                scale=scale, block_k=block_k, interpret=interpret,
+                softcap=softcap, window=window, sinks=sinks,
+            )
         return flash_decode_quantized(
             q_local, cache_local, lens_full,
             scale=scale, block_k=block_k, interpret=interpret,

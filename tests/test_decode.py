@@ -51,6 +51,81 @@ def test_flash_decode_matches_oracle_ragged(rng, h, hkv):
     np.testing.assert_allclose(got, want, atol=2e-5)
 
 
+def _chunk_oracle(q, k_cache, v_cache, new_lens, scale, *,
+                  window=None, sinks=None):
+    """fp64 reference for chunk verify: token s of sequence b attends
+    its causal prefix [0, new_lens[b]-S+s] (window/sinks banded)."""
+    b, h, s_chunk, d = q.shape
+    hkv = k_cache.shape[1]
+    group = h // hkv
+    out = np.zeros((b, h, s_chunk, v_cache.shape[-1]))
+    for bi in range(b):
+        for hi in range(h):
+            kv = hi // group
+            for si in range(s_chunk):
+                pos = int(new_lens[bi]) - s_chunk + si
+                cols = np.arange(pos + 1)
+                if window is not None:
+                    keep = cols >= pos - (window - 1)
+                    if sinks is not None:
+                        keep |= cols < sinks
+                    cols = cols[keep]
+                s = (k_cache[bi, kv, cols].astype(np.float64)
+                     @ q[bi, hi, si].astype(np.float64)) * scale
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[bi, hi, si] = p @ v_cache[bi, kv, cols].astype(
+                    np.float64)
+    return out
+
+
+@pytest.mark.parametrize(
+    "h,hkv,band",
+    [(4, 4, {}), (8, 2, {}), (8, 2, dict(window=64)),
+     (4, 2, dict(window=48, sinks=3))],
+    ids=["mha", "gqa", "window", "window_sinks"],
+)
+def test_flash_decode_chunk_matches_oracle(rng, h, hkv, band):
+    """The speculative-verify chunk kernel: S appended tokens scored in
+    one cache stream, per-row causal/window masks, ragged lengths."""
+    from attention_tpu.ops.decode import flash_decode_chunk
+
+    b, n, d, s_chunk = 3, 384, 64, 5
+    new_lens = np.array([384, 130, 9], np.int32)  # lengths AFTER append
+    q = rng.standard_normal((b, h, s_chunk, d)).astype(np.float32)
+    kc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    got = np.asarray(flash_decode_chunk(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(new_lens), block_k=128, **band,
+    ))
+    want = _chunk_oracle(q, kc, vc, new_lens, 1.0 / d**0.5, **band)
+    np.testing.assert_allclose(got, want, atol=3e-5)
+
+
+def test_flash_decode_chunk_equals_sequential_decode(rng):
+    """Chunk scoring must equal S sequential decode steps (the
+    speculative exactness contract at the kernel level)."""
+    from attention_tpu.ops.decode import flash_decode_chunk
+
+    b, h, hkv, n, d, s_chunk = 2, 8, 4, 256, 64, 4
+    lens0 = np.array([100, 37], np.int32)
+    q = rng.standard_normal((b, h, s_chunk, d)).astype(np.float32)
+    kc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, n, d)).astype(np.float32)
+    new_lens = lens0 + s_chunk
+    got = np.asarray(flash_decode_chunk(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(new_lens), block_k=128,
+    ))
+    for si in range(s_chunk):
+        step = np.asarray(flash_decode(
+            jnp.asarray(q[:, :, si]), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(lens0 + si + 1), block_k=128,
+        ))
+        np.testing.assert_allclose(got[:, :, si], step, atol=2e-5)
+
+
 def test_flash_decode_scalar_length_and_bf16(rng):
     b, h, hkv, n, d = 2, 8, 4, 256, 128
     q = rng.standard_normal((b, h, d)).astype(np.float32)

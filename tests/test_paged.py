@@ -359,3 +359,42 @@ def test_generate_paged_rope_sinks_matches_ragged(rng):
     toks, _caches, _pools = generate_paged(model, params, prompt, lengths,
                                            steps=24)
     np.testing.assert_array_equal(a, np.asarray(toks))
+
+
+def test_paged_chunk_equals_sequential_decode(rng):
+    """The paged speculative-verify chunk (4-D q through
+    `paged_flash_decode`) must equal S sequential paged decode steps,
+    scrambled physical pages included."""
+    import random
+
+    from attention_tpu.ops.paged import paged_append_chunk
+
+    b, h, hkv, n, d, s_chunk = 2, 4, 2, 512, 64, 4
+    lens0 = jnp.asarray([200, 130], jnp.int32)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, n, d)), jnp.float32)
+    pool = PagePool(num_pages=2 * (n // 128))
+    ids = pool.alloc(pool.num_pages)
+    random.Random(7).shuffle(ids)
+    pool.free(ids)
+    cache = paged_from_dense(kc, vc, lens0, pool,
+                             num_pages=pool.num_pages, page_size=128,
+                             total_pages_per_seq=n // 128)
+    k_new = jnp.asarray(
+        rng.standard_normal((b, hkv, s_chunk, d)), jnp.float32)
+    v_new = jnp.asarray(
+        rng.standard_normal((b, hkv, s_chunk, d)), jnp.float32)
+    cache2 = paged_append_chunk(cache, k_new, v_new)
+    assert np.array_equal(np.asarray(cache2.lengths),
+                          np.asarray(lens0) + s_chunk)
+    q = jnp.asarray(
+        rng.standard_normal((b, h, s_chunk, d)), jnp.float32)
+    got = np.asarray(paged_flash_decode(q, cache2))
+
+    # sequential: append row by row, decode each position
+    seq_cache = cache
+    for si in range(s_chunk):
+        seq_cache = paged_append(seq_cache, k_new[:, :, si:si + 1],
+                                 v_new[:, :, si:si + 1])
+        step = np.asarray(paged_flash_decode(q[:, :, si], seq_cache))
+        np.testing.assert_allclose(got[:, :, si], step, atol=2e-5)

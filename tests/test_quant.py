@@ -120,7 +120,11 @@ def test_generate_int8_cache_runs_and_matches(rng):
     np.testing.assert_array_equal(q8, fp)
 
 
-def test_quant_cache_rejects_prefill_and_xla(rng):
+def test_quant_cache_chunked_append_and_xla_reject(rng):
+    """Round 5: S > 1 on the int8 cache is the speculative-verify chunk
+    path (was a ValueError through round 4) — its logits must match the
+    same tokens fed one at a time.  The xla impl still has no
+    quantized-cache path and must reject loudly."""
     from attention_tpu.models import TinyDecoder
 
     model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
@@ -130,8 +134,16 @@ def test_quant_cache_rejects_prefill_and_xla(rng):
     caches = model.init_caches(batch=1, capacity=128)
     _, caches = model.apply({"params": params}, tokens[:, :1], caches)
     qcaches = tuple(c.quantize() for c in caches)
-    with pytest.raises(ValueError, match="single-token"):
-        model.apply({"params": params}, tokens[:, 1:4], qcaches)
+    chunk_logits, _ = model.apply(
+        {"params": params}, tokens[:, 1:4], qcaches)
+    step_caches = qcaches
+    for i in range(1, 4):
+        step_l, step_caches = model.apply(
+            {"params": params}, tokens[:, i:i + 1], step_caches)
+        np.testing.assert_allclose(
+            np.asarray(chunk_logits[:, i - 1]), np.asarray(step_l[:, 0]),
+            atol=1e-4,
+        )
 
     xla_model = TinyDecoder(vocab=31, dim=32, depth=1, num_q_heads=4,
                             num_kv_heads=2, impl="xla", dtype=jnp.float32)
@@ -217,3 +229,127 @@ def test_int8_rope_sinks_window_matches_bf16_logits(rng):
         np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
                                    atol=1e-1, rtol=5e-2,
                                    err_msg=f"step {t}")
+
+
+def test_quantized_chunk_equals_sequential_decode(rng):
+    """The int8 speculative-verify chunk kernel must equal S sequential
+    quantized decode steps over the same cache rows."""
+    from attention_tpu.ops.quant import flash_decode_quantized_chunk
+
+    b, h, hkv, n, d, s_chunk = 2, 8, 4, 256, 64, 4
+    lens0 = np.array([50, 7], np.int32)
+    kc, vc = _caches(rng, b, hkv, n, d)
+    qkv = quantize_kv(kc, vc)
+    q = jnp.asarray(
+        rng.standard_normal((b, h, s_chunk, d)), jnp.float32
+    )
+    new_lens = jnp.asarray(lens0 + s_chunk)
+    got = np.asarray(flash_decode_quantized_chunk(
+        q, qkv, new_lens, block_k=128,
+    ))
+    for si in range(s_chunk):
+        step = np.asarray(flash_decode_quantized(
+            q[:, :, si], qkv, jnp.asarray(lens0 + si + 1), block_k=128,
+        ))
+        np.testing.assert_allclose(got[:, :, si], step, atol=2e-3)
+
+
+def test_quantized_chunk_windowed(rng):
+    """Chunk verify with per-row window+sinks bands on the int8 cache."""
+    from attention_tpu.ops.quant import flash_decode_quantized_chunk
+
+    b, h, hkv, n, d, s_chunk = 1, 4, 2, 256, 64, 3
+    lens0 = np.array([120], np.int32)
+    kc, vc = _caches(rng, b, hkv, n, d)
+    qkv = quantize_kv(kc, vc)
+    q = jnp.asarray(rng.standard_normal((b, h, s_chunk, d)), jnp.float32)
+    kw = dict(window=32, sinks=2, block_k=128)
+    got = np.asarray(flash_decode_quantized_chunk(
+        q, qkv, jnp.asarray(lens0 + s_chunk), **kw,
+    ))
+    for si in range(s_chunk):
+        step = np.asarray(flash_decode_quantized(
+            q[:, :, si], qkv, jnp.asarray(lens0 + si + 1), **kw,
+        ))
+        np.testing.assert_allclose(got[:, :, si], step, atol=2e-3)
+
+
+def test_int4_roundtrip_and_unpack_order(rng):
+    """Nibble packing: unpack(pack(x)) == round(x/scale) with features
+    in NATURAL order (lo half ++ hi half)."""
+    from attention_tpu.ops.quant import (
+        Int4KV,
+        _quant_rows_int4,
+        quantize_kv_int4,
+    )
+
+    x = jnp.asarray(rng.standard_normal((1, 1, 8, 16)), jnp.float32)
+    packed, scale = _quant_rows_int4(x)
+    assert packed.shape == (1, 1, 8, 8) and packed.dtype == jnp.int8
+    lo = np.right_shift(np.left_shift(np.asarray(packed), 4), 4)
+    hi = np.right_shift(np.asarray(packed), 4)
+    unpacked = np.concatenate([lo, hi], axis=-1).astype(np.float32)
+    want = np.clip(np.round(np.asarray(x) / np.asarray(
+        scale[..., 0, :, None])), -7, 7)
+    np.testing.assert_array_equal(unpacked, want)
+    kc, vc = _caches(rng, 1, 2, 128, 64)
+    c4 = quantize_kv_int4(kc, vc)
+    assert isinstance(c4, Int4KV)
+    assert c4.head_dim == 64 and c4.capacity == 128
+    # dequantized error bounded by one nibble step per element
+    deq = np.concatenate([
+        np.right_shift(np.left_shift(np.asarray(c4.k_q), 4), 4),
+        np.right_shift(np.asarray(c4.k_q), 4),
+    ], axis=-1) * np.asarray(c4.k_scale)[:, :, 0, :, None]
+    step = np.asarray(c4.k_scale)[:, :, 0, :, None]
+    assert np.all(np.abs(deq - np.asarray(kc)) <= 0.5 * step + 1e-6)
+
+
+def test_int4_decode_close_to_fp(rng):
+    """int4 decode vs the bf16 decode kernel — pins the MEASURED error
+    budget: ~4-8e-2 max abs on unit-normal inputs at d=64/128 (int8 is
+    ~2e-3 here), i.e. int4 does NOT meet the ±0.02 harness contract —
+    it is the documented opt-in bytes/quality trade (see
+    `quantize_kv_int4` and RESULTS.md round 5)."""
+    from attention_tpu.ops.quant import flash_decode_int4, quantize_kv_int4
+
+    for d in (64, 128):
+        b, h, hkv, n = 2, 8, 4, 512
+        lens = np.array([512, 300], np.int32)
+        kc, vc = _caches(rng, b, hkv, n, d)
+        q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+        want = np.asarray(flash_decode(
+            q.astype(jnp.bfloat16), kc.astype(jnp.bfloat16),
+            vc.astype(jnp.bfloat16), jnp.asarray(lens),
+            block_k=128)).astype(np.float32)
+        got = np.asarray(flash_decode_int4(
+            q, quantize_kv_int4(kc, vc), jnp.asarray(lens),
+            block_k=128)).astype(np.float32)
+        err = np.max(np.abs(got - want))
+        # regression rail at the measured budget's edge; a pass at the
+        # strict 0.02 contract would mean the budget doc is stale
+        assert err < 0.15, f"int4 error regressed: {err}"
+
+
+def test_int4_decode_windowed_and_empty(rng):
+    from attention_tpu.ops.quant import flash_decode_int4, quantize_kv_int4
+
+    b, h, hkv, n, d = 2, 4, 2, 256, 64
+    kc, vc = _caches(rng, b, hkv, n, d)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    c4 = quantize_kv_int4(kc, vc)
+    lens = jnp.asarray([200, 64], jnp.int32)
+    got = np.asarray(flash_decode_int4(q, c4, lens, block_k=128,
+                                       window=32, sinks=2))
+    want = np.asarray(flash_decode_quantized(
+        q, quantize_kv(kc, vc), lens, block_k=128, window=32, sinks=2))
+    # int4-vs-int8 difference at the measured int4 budget; windowed
+    # reads average over ~window tokens instead of the whole prefix, so
+    # the quantization noise averages down LESS than the full-cache
+    # case (measured ~0.16 here vs ~0.08 full) — the budget scales with
+    # 1/sqrt(tokens-attended) (module docstrings + RESULTS.md round 5)
+    assert np.max(np.abs(got.astype(np.float32)
+                         - want.astype(np.float32))) < 0.25
+    zero = np.asarray(flash_decode_int4(
+        q, c4, jnp.zeros((b,), jnp.int32), block_k=128))
+    assert np.all(zero == 0)

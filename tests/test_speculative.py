@@ -56,6 +56,38 @@ def test_speculative_with_rope_and_softcap(rng):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("cache_type", ["ragged", "int8", "paged"])
+def test_speculative_cache_matrix_matches_greedy(rng, cache_type):
+    """Round-5 matrix close: speculative serving on every cache type
+    must emit EXACTLY target-only greedy tokens.  int8 compares against
+    int8 target-only generation (quantization changes logits, so the
+    exactness contract is per cache type, not across types)."""
+    target, tp, draft, dp, prompt = _models()
+    if cache_type == "int8":
+        want = np.asarray(generate(target, tp, prompt, steps=10,
+                                   int8_cache=True))
+    else:
+        want = np.asarray(generate(target, tp, prompt, steps=10))
+    got = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=10, gamma=3,
+        cache_type=cache_type,
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("cache_type", ["ragged", "paged"])
+def test_speculative_cache_matrix_windowed(rng, cache_type):
+    """Windowed (sliding-window + sinks) models through the chunk-verify
+    kernels' per-row bands, on the ragged and paged caches."""
+    target, tp, draft, dp, prompt = _models(window=8, attn_sinks=2)
+    want = np.asarray(generate(target, tp, prompt, steps=8))
+    got = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=8, gamma=3,
+        cache_type=cache_type,
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_speculative_validations(rng):
     target, tp, draft, dp, prompt = _models()
     with pytest.raises(ValueError, match="batch 1"):
@@ -69,3 +101,14 @@ def test_speculative_validations(rng):
                             dtype=jnp.float32)
     with pytest.raises(ValueError, match="vocab"):
         generate_speculative(target, tp, bad_draft, dp, prompt, steps=4)
+    with pytest.raises(ValueError, match="cache_type"):
+        generate_speculative(target, tp, draft, dp, prompt, steps=4,
+                             cache_type="fp7")
+    # rope+window+sinks targets: chunk verify keeps absolute sink
+    # rotations while step decode re-rotates — exactness would silently
+    # break, so the combination must be rejected loudly
+    sink_t, sink_tp, sink_d, sink_dp, sink_prompt = _models(
+        rope=True, window=8, attn_sinks=2)
+    with pytest.raises(ValueError, match="sink"):
+        generate_speculative(sink_t, sink_tp, sink_d, sink_dp,
+                             sink_prompt, steps=4)
