@@ -88,33 +88,35 @@ def _ensure_registered() -> None:
     def _auto(q, k, v, threshold_bytes=None, **kw):
         # The adaptive distribution policy (attention-mpi.c:210-266): small
         # KV -> replicate KV / shard Q (zero per-batch collectives); large
-        # KV -> shard KV rows + two-phase softmax collectives.
+        # KV -> shard KV rows + two-phase softmax collectives.  Round 5:
+        # with the full call shape in hand the decision is the measured
+        # byte-ratio model (`choose_kv_placement` with m) — an explicit
+        # ``threshold_bytes`` forces the legacy bytes-only comparison
+        # (escape hatch + test hook).
         from attention_tpu.parallel.kv_sharded import (
             kv_sharded_attention,
             q_sharded_attention,
         )
-        from attention_tpu.parallel.mesh import (
-            KV_REPLICATE_THRESHOLD_BYTES,
-            choose_kv_placement,
-        )
+        from attention_tpu.parallel.mesh import choose_kv_placement
 
         n, dk = k.shape[-2], k.shape[-1]
         dv = v.shape[-1]
         kv_heads = 1
         for dim in k.shape[:-2]:
             kv_heads *= dim
-        placement = choose_kv_placement(
-            n,
-            dk,
-            dv,
-            itemsize=k.dtype.itemsize,
-            kv_heads=kv_heads,
-            threshold_bytes=(
-                KV_REPLICATE_THRESHOLD_BYTES
-                if threshold_bytes is None
-                else threshold_bytes
-            ),
-        )
+        q_heads = 1
+        for dim in q.shape[:-2]:
+            q_heads *= dim
+        if threshold_bytes is not None:
+            placement = choose_kv_placement(
+                n, dk, dv, itemsize=k.dtype.itemsize,
+                kv_heads=kv_heads, threshold_bytes=threshold_bytes,
+            )
+        else:
+            placement = choose_kv_placement(
+                n, dk, dv, itemsize=k.dtype.itemsize,
+                kv_heads=kv_heads, m=q.shape[-2], q_heads=q_heads,
+            )
         if placement == "replicate":
             kw.pop("impl", None)  # q-sharded is always the fused kernel
             return q_sharded_attention(q, k, v, **kw)

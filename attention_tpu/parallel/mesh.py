@@ -24,12 +24,29 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-# The reference flips from Bcast (replicate-style transport) to Scatterv
-# (shard-style transport) at 64 MB of fp32 KV (`attention-mpi.c:213-215`).
-# We reuse the same threshold for the replicate-vs-shard placement choice;
-# v5e has 16 GB HBM per chip, so replication is about HBM headroom and
-# collective cost, not a hard limit.
-KV_REPLICATE_THRESHOLD_BYTES = 64 * 2**20
+# Fallback threshold for callers that cannot supply the query-side shape
+# (legacy signature).  The reference flipped Bcast->Scatterv at a
+# measured 64 MB (`attention-mpi.c:213-215`, report Q8) — an
+# MPI-tree-topology fact, not a TPU one.  When `m` is known the decision
+# below uses the fabric-independent byte model instead (see
+# `choose_kv_placement`); this constant only gates the m-less path and
+# is set where the byte model lands for the repo's square headline
+# shapes (m == n, d = 128: crossover at n ~ 2.6k -> ~2.7 MB of fp32 KV;
+# kept at the reference's 64 MB would mis-place every square shape from
+# 2.6k to 32k — artifacts/placement_sweep.json).
+KV_REPLICATE_THRESHOLD_BYTES = 4 * 2**20
+
+# Allreduce-vs-broadcast byte ratio: sharding pays a two-phase merge
+# (reduce-scatter + all-gather ~ 2x bytes on the wire) every call where
+# replication pays a one-time (1 - 1/R) broadcast — fabric-independent
+# factors (the same 2x the reference's Iallreduce pair pays over its
+# Ibcast, `attention-mpi.c:342,354` vs `:305`).  Validated directionally
+# on the 8-CPU mesh (scripts/placement_sweep.py).
+MERGE_ALPHA = 2.0
+
+# Replicating KV on every chip is capacity-bounded long before 16 GB
+# HBM fills: leave room for Q, outputs, double buffers.
+KV_REPLICATE_HBM_CAP_BYTES = 4 * 2**30
 
 
 def default_mesh(axis_name: str = "kv", devices=None) -> Mesh:
@@ -80,14 +97,44 @@ def choose_kv_placement(
     itemsize: int = 4,
     threshold_bytes: int = KV_REPLICATE_THRESHOLD_BYTES,
     kv_heads: int = 1,
+    m: int | None = None,
+    q_heads: int | None = None,
+    n_devices: int | None = None,
 ) -> str:
-    """'replicate' or 'shard' — the adaptive distribution policy (C11).
+    """'replicate' or 'shard' — the adaptive distribution policy (C11),
+    re-derived for TPU (round 5).
 
-    Mirrors the reference's ``total_kv = n*(dk+dv)*4B`` vs 64 MB test
-    (`attention-mpi.c:213-215`) with the placement decision that makes
-    sense on TPU: below the threshold, replicate KV on every chip and
-    shard the *queries* (no per-batch collectives at all); above it,
-    shard KV rows and pay the two-phase softmax collectives.
+    The reference compared KV size against a measured 64 MB Bcast/
+    Scatterv flip (`attention-mpi.c:213-215`) — a property of MPI's
+    pre-built broadcast tree.  On a TPU mesh both placements execute
+    identical FLOPs; what differs is bytes moved:
+
+      * replicate KV / shard Q: a one-time (1 - 1/R) broadcast of the
+        full KV, then ZERO per-call collectives (outputs are already
+        Q-sharded);
+      * shard KV rows: 1/R of the KV moves, but every call pays the
+        two-phase merge — pmax/psum of the (h, m) stats and a psum of
+        the (h, m, dv) fp32 contribs, ~2x those bytes on the wire
+        (reduce-scatter + all-gather).
+
+    So with the query side known the decision is a byte RATIO (m
+    against n), not an absolute KV size: replicate iff
+    ``(1 - 1/R) * kv_bytes < MERGE_ALPHA * merge_bytes``, capacity-
+    capped by per-chip HBM headroom.  Validated on the 8-CPU mesh
+    (scripts/placement_sweep.py -> artifacts/placement_sweep.json).
+    Callers that cannot supply ``m`` fall back to the bytes threshold
+    (now set where the model lands for square shapes, not at MPI's
+    64 MB).
     """
     total_kv = kv_heads * n * (dk + dv) * itemsize
-    return "replicate" if total_kv < threshold_bytes else "shard"
+    if total_kv > KV_REPLICATE_HBM_CAP_BYTES:
+        return "shard"  # capacity-forced regardless of comm optimum
+    if m is None:
+        return "replicate" if total_kv < threshold_bytes else "shard"
+    if n_devices is None:
+        n_devices = max(len(jax.devices()), 1)
+    bcast_bytes = (1.0 - 1.0 / n_devices) * total_kv
+    # stats ride lane-replicated fp32 (2 vectors) + fp32 contribs
+    merge_bytes = (q_heads or kv_heads) * m * (dv + 2) * 4
+    return ("replicate"
+            if bcast_bytes < MERGE_ALPHA * merge_bytes else "shard")

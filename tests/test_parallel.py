@@ -38,9 +38,29 @@ def test_mesh_has_8_devices():
 
 
 def test_choose_kv_placement_threshold():
-    # the reference's 64 MB Bcast/Scatterv flip (attention-mpi.c:213-215)
+    # m-less legacy path: bytes threshold (measured, no longer MPI's 64 MB)
     assert choose_kv_placement(1024, 128, 128, itemsize=4) == "replicate"
     assert choose_kv_placement(1 << 20, 128, 128, itemsize=4) == "shard"
+
+
+def test_choose_kv_placement_byte_model():
+    """Round-5 model path: the decision is the m-vs-n byte RATIO —
+    (1-1/R)*kv_bytes vs 2x merge bytes — not absolute KV size."""
+    # 256 MB of KV but a huge query side: merge traffic dwarfs the
+    # broadcast -> replicate (the old 64 MB rule got this wrong)
+    assert choose_kv_placement(
+        1 << 18, 128, 128, itemsize=4, m=1 << 20, q_heads=1,
+        n_devices=8,
+    ) == "replicate"
+    # same KV, tiny query side: broadcast dwarfs the merge -> shard
+    assert choose_kv_placement(
+        1 << 18, 128, 128, itemsize=4, m=256, q_heads=1, n_devices=8,
+    ) == "shard"
+    # capacity cap forces sharding no matter the ratio
+    assert choose_kv_placement(
+        1 << 23, 512, 512, itemsize=4, m=1 << 24, q_heads=1,
+        n_devices=8,
+    ) == "shard"
 
 
 @pytest.mark.parametrize("impl", ["flash", "xla"])
