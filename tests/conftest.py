@@ -32,6 +32,68 @@ jax.config.update("jax_platforms", _platform)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The fast round-gate tier (`pytest -m smoke`): one or two representative
+# tests per kernel / distributed / serving family, <=5 min on a 1-core
+# host (the full suite is ~35-40 min there — README "Testing").  Keys are
+# test modules, values are test-function base names (parameter brackets
+# stripped).  Families with no entry (multi-process crash/multihost
+# tests, exhaustive feature matrices) stay full-suite-only.
+SMOKE_TESTS = {
+    "test_core": ["test_oracle_matches_scalar_loops",
+                  "test_testcase_roundtrip", "test_verify_tolerance"],
+    "test_native_cli": ["test_native_matches_numpy_oracle",
+                        "test_cli_end_to_end"],
+    "test_ops": ["test_flash_causal", "test_flash_mha_gqa",
+                 "test_bound_mode_matches_online",
+                 "test_bound_mode_underflow_demotes"],
+    "test_vjp": ["test_grads_match_dense_causal", "test_grads_gqa_3d"],
+    "test_flash_bwd": ["test_pallas_matches_xla_backward_causal",
+                       "test_fused_and_two_kernel_paths_agree"],
+    "test_decode": ["test_flash_decode_matches_oracle_ragged",
+                    "test_flash_decode_chunk_equals_sequential_decode",
+                    "test_cached_decode_matches_full_forward"],
+    "test_quant": ["test_quantized_decode_close_to_fp",
+                   "test_quantized_chunk_equals_sequential_decode"],
+    "test_paged": ["test_paged_decode_matches_dense",
+                   "test_paged_chunk_equals_sequential_decode"],
+    "test_ragged": ["test_ragged_equal_lengths_match_plain_generate"],
+    "test_window": ["test_window_forward_matches_oracle"],
+    "test_sinks": ["test_sinks_forward_matches_oracle"],
+    "test_softcap": ["test_softcap_forward_matches_oracle"],
+    "test_segments": ["test_segmented_forward_matches_oracle"],
+    "test_rope": ["test_rope_cached_decode_matches_full_forward"],
+    "test_parallel": ["test_kv_sharded_matches_oracle",
+                      "test_ring_matches_oracle",
+                      "test_ulysses_matches_oracle",
+                      "test_q_sharded_matches_oracle"],
+    "test_cp": ["test_cp_matches_single_device",
+                "test_ring_diff_matches_single_device"],
+    "test_models": ["test_sharded_training_step_decreases_loss"],
+    "test_moe": ["test_moe_matches_per_token_reference"],
+    "test_pipeline": ["test_pipeline_matches_sequential"],
+    "test_serving": ["test_head_sharded_matches_single_device"],
+    "test_tp_serving": ["test_tp_generate_matches_single_device"],
+    "test_speculative": ["test_speculative_matches_greedy_random_draft"],
+    "test_beam": ["test_beam_one_equals_greedy"],
+    "test_seq2seq": ["test_seq2seq_flash_matches_xla_impl"],
+    "test_cross_attention": ["test_cross_attention_matches_manual_oracle"],
+    "test_checkpoint": ["test_checkpoint_roundtrip_resumes_training"],
+    "test_sampling": ["test_select_token_top_p_keeps_minimal_nucleus"],
+    "test_properties": ["test_matches_jax_softmax_spec"],
+    "test_benchmarks": ["test_blocksizes_for_shape_rules"],
+    "test_graft_entry": ["test_entry_compiles_single_device"],
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        names = SMOKE_TESTS.get(mod)
+        if not names:
+            continue
+        if item.name.split("[", 1)[0] in names:
+            item.add_marker(pytest.mark.smoke)
+
 
 @pytest.fixture
 def rng():
