@@ -54,6 +54,13 @@ _LN2 = 0.6931471805599453  # 1/log2(e)
 # assuming it.
 SAFE_OVERSHOOT_LOG2 = 96.0
 
+# Perf-triage ONLY (see the dispatch in `_flash_call`): monkeypatch to
+# True to time the bound kernel without its guard/cond.  Deliberately a
+# code-settable module global, not an env var — correctness bypasses
+# must not ride process environments into CI, and jit caches freeze the
+# value at first trace anyway.
+_UNSAFE_SKIP_GUARD = False
+
 
 def _compiler_params(semantics, vmem_limit_bytes=None):
     """CompilerParams with dimension semantics, tolerant of API spelling
@@ -859,8 +866,21 @@ def _flash_call(
         # instead.  Both branches compile once; the predicate is a
         # scalar and the guard's own cost is O(m*d) — ~1% of a 32k
         # forward, 0 of the grid's FLOPs.
-        outs = jax.lax.cond(bound_safe,
-                            lambda: _run(True), lambda: _run(False))
+        if _UNSAFE_SKIP_GUARD:
+            # Perf-triage hatch (module global, code-settable only — a
+            # process env var would silently disable the guard
+            # fleet-wide and be frozen into jit caches): runs the bound
+            # kernel with no guard/cond — WRONG (all-zero rows) on
+            # inputs whose overshoot leaves fp32 exp2 range.
+            import sys
+
+            print("attention_tpu: _UNSAFE_SKIP_GUARD is set — bound-"
+                  "mode overshoot guard DISABLED (triage only)",
+                  file=sys.stderr)
+            outs = _run(True)
+        else:
+            outs = jax.lax.cond(bound_safe,
+                                lambda: _run(True), lambda: _run(False))
     else:
         outs = _run(False)
 

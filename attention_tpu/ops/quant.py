@@ -463,8 +463,8 @@ def flash_decode_quantized_chunk(
 #
 # Packing: two int4 values per int8 byte along the FEATURE dim, split
 # halves — byte f of a row holds feature f in its low nibble and
-# feature f + d/2 in its high nibble, so the in-kernel unpack is two
-# arithmetic shifts and a lane concat (lo half ++ hi half restores
+# feature f + d/2 in its high nibble, so the in-kernel unpack is a few
+# float floor/fma ops and a lane concat (lo half ++ hi half restores
 # natural feature order — no interleave relayout, the trap that made
 # the byte-planar int8 experiment 1.7x slower, see module docstring).
 # Scales stay per-token symmetric absmax (they commute out of both
@@ -514,10 +514,19 @@ def _quant_rows_int4(x):
 
 def _unpack_int4(packed):
     """(rows, d//2) int8 nibbles -> (rows, d) bf16 in natural feature
-    order: arithmetic shifts sign-extend each nibble, halves concat
-    along lanes (cheap — no element interleave)."""
-    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
-    hi = jnp.right_shift(packed, 4)
+    order; halves concat along lanes (no element interleave).
+
+    Nibble extraction is float floor arithmetic, NOT integer shifts:
+    Mosaic fails to legalize `arith.shli` on int8 vectors in-kernel
+    (remote-compile HTTP 500, 'failed to legalize operation'), while
+    convert/floor/fma all lower cleanly.  floor(p/16) IS the
+    arithmetic right shift (rounds toward -inf), so `hi` comes out
+    sign-extended; the low nibble is the remainder re-signed.  Values
+    are small integers — exact in fp32."""
+    p = packed.astype(jnp.float32)
+    hi = jnp.floor(p * (1.0 / 16.0))
+    lo = p - 16.0 * hi                       # [0, 15] unsigned nibble
+    lo = jnp.where(lo >= 8.0, lo - 16.0, lo)  # two's-complement sign
     return jnp.concatenate([lo, hi], axis=-1).astype(jnp.bfloat16)
 
 

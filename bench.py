@@ -194,10 +194,17 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
             l, grads = jax.value_and_grad(loss)((x, kk_, vv_))
             # fold ALL grads into the timed value: returning only dQ
             # would let XLA dead-code-eliminate the dK/dV kernel and
-            # overstate backward utilization ~1.8x
-            return (grads[0].astype(jnp.float32)
-                    + jnp.sum(grads[1]).astype(jnp.float32)
-                    + jnp.sum(grads[2]).astype(jnp.float32))
+            # overstate backward utilization ~1.8x.  The carry must
+            # stay DISTRIBUTION-STATIONARY: chaining the raw gradient
+            # (plus broadcast scalar sums) as the next Q inflates
+            # ||q|| ~1e4, which bound mode's overshoot guard correctly
+            # demotes to the online kernel — the chain would then time
+            # a kernel no sane training step runs (round-5 find: the
+            # "regression" was the guard doing its job on garbage Q).
+            combined = (grads[0].astype(jnp.float32)
+                        + jnp.sum(grads[1]).astype(jnp.float32)
+                        + jnp.sum(grads[2]).astype(jnp.float32))
+            return x.astype(jnp.float32) + 1e-12 * combined
 
         return benchmark_auto(grad_step, q, repeats=repeats,
                               n_short=n_short, n_long=n_long,
