@@ -413,6 +413,92 @@ def _():
     return got, want
 
 
+@case("decode/chunk verify == sequential decode (speculative)")
+def _():
+    from attention_tpu.ops.decode import flash_decode_chunk
+
+    b, h, hkv, n, d, S = 2, 4, 2, 512, 64, 3
+    lens0 = np.array([300, 140], np.int32)
+    q = _arr(b, h, S, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    got = flash_decode_chunk(q, kc, vc, jnp.asarray(lens0 + S),
+                             block_k=128)
+    steps = [
+        flash_decode(q[:, :, si], kc, vc, jnp.asarray(lens0 + si + 1),
+                     block_k=128)
+        for si in range(S)
+    ]
+    return got, jnp.stack(steps, axis=2)
+
+
+@case("decode/chunk verify int8 + window+sinks")
+def _():
+    from attention_tpu.ops.quant import flash_decode_quantized_chunk
+
+    b, h, hkv, n, d, S = 1, 4, 2, 512, 64, 3
+    lens0 = np.array([300], np.int32)
+    q = _arr(b, h, S, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    qkv = quantize_kv(kc, vc)
+    kw = dict(block_k=128, window=64, sinks=2)
+    got = flash_decode_quantized_chunk(q, qkv, jnp.asarray(lens0 + S),
+                                       **kw)
+    steps = [
+        flash_decode_quantized(q[:, :, si], qkv,
+                               jnp.asarray(lens0 + si + 1), **kw)
+        for si in range(S)
+    ]
+    return got, jnp.stack(steps, axis=2), 5e-3  # int8 noise x2 paths
+
+
+@case("decode/chunk verify paged (4-D q through the table)")
+def _():
+    from attention_tpu.ops.decode import flash_decode_chunk
+
+    b, h, hkv, n, d, S = 2, 4, 2, 512, 64, 3
+    lens = np.array([303, 143], np.int32)  # post-append lengths
+    q = _arr(b, h, S, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    pool = PagePool(num_pages=2 * (n // 128))
+    cache = paged_from_dense(kc, vc, jnp.asarray(lens), pool,
+                             num_pages=pool.num_pages, page_size=128)
+    got = paged_flash_decode(q, cache)
+    want = flash_decode_chunk(q, kc, vc, jnp.asarray(lens), block_k=128)
+    return got, want
+
+
+@case("decode/int4 cache within its documented budget")
+def _():
+    from attention_tpu.ops.quant import flash_decode_int4, quantize_kv_int4
+
+    b, h, hkv, n, d = 2, 4, 2, 512, 128
+    lens = jnp.asarray([512, 300], jnp.int32)
+    q = _arr(b, h, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    got = flash_decode_int4(q, quantize_kv_int4(kc, vc), lens,
+                            block_k=128)
+    want = flash_decode(q, kc, vc, lens, block_k=128)
+    # int4's measured opt-in budget, NOT the ±0.02 contract
+    # (quant.py::quantize_kv_int4, RESULTS.md round 5)
+    return got, want, 0.15
+
+
+@case("fwd/bound guard demotes adversarial norms on-chip")
+def _():
+    d = 128
+    qa = np.zeros((64, d), np.float32)
+    qa[:, 0] = 45.0
+    ka = np.zeros((64, d), np.float32)
+    ka[0, 1] = 45.0  # orthogonal huge key: unguarded bound underflows
+    va = RNG.standard_normal((64, d)).astype(np.float32)
+    got = flash_attention(jnp.asarray(qa), jnp.asarray(ka),
+                          jnp.asarray(va), max_mode="bound")
+    want = flash_attention(jnp.asarray(qa), jnp.asarray(ka),
+                           jnp.asarray(va))
+    assert float(jnp.max(jnp.abs(got))) > 0.1, "demotion returned zeros"
+    return got, want
+
+
 # ------------- distributed arms on a real-chip mesh -------------
 # (round-3 VERDICT missing #1: ring / kv-sharded / ulysses / CP train /
 # serving had only ever executed on virtual CPU meshes.)  A 1-device
