@@ -43,19 +43,13 @@ def decode_step(model: TinyDecoder, params, token: jax.Array, caches):
     return logits[:, -1], caches
 
 
-def _select_token(logits, rng, *, temperature, top_k, top_p):
-    """(B, V) fp32 logits -> (B,) int32 next tokens.
-
-    ``rng is None`` is greedy argmax.  Otherwise temperature (traced
-    scalar, > 0) scales the logits and top-k / top-p (nucleus) restrict
-    the support BEFORE the categorical draw; both are implemented with
-    static shapes (`lax.top_k` + sorted cumulative mass) so the whole
-    selector lives inside the decode scan.  Only ``top_k`` is static
-    (lax.top_k needs a concrete k); temperature/top_p trace, so sweeping
-    them reuses one compiled executable.
-    """
-    if rng is None:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def warp_logits(logits, *, temperature, top_k, top_p):
+    """Apply the sampling warp (temperature scaling, then top-k and
+    nucleus top-p support truncation) to (B, V) fp32 logits.  Factored
+    out of `_select_token` so speculative SAMPLING can warp the draft
+    and target distributions identically — the rejection-sampling
+    exactness theorem needs the ratio taken between the WARPED
+    distributions (the ones actually being sampled)."""
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
@@ -70,7 +64,25 @@ def _select_token(logits, rng, *, temperature, top_k, top_p):
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def _select_token(logits, rng, *, temperature, top_k, top_p):
+    """(B, V) fp32 logits -> (B,) int32 next tokens.
+
+    ``rng is None`` is greedy argmax.  Otherwise temperature (traced
+    scalar, > 0) scales the logits and top-k / top-p (nucleus) restrict
+    the support BEFORE the categorical draw; both are implemented with
+    static shapes (`lax.top_k` + sorted cumulative mass) so the whole
+    selector lives inside the decode scan.  Only ``top_k`` is static
+    (lax.top_k needs a concrete k); temperature/top_p trace, so sweeping
+    them reuses one compiled executable.
+    """
+    if rng is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    warped = warp_logits(logits, temperature=temperature, top_k=top_k,
+                         top_p=top_p)
+    return jax.random.categorical(rng, warped, axis=-1).astype(jnp.int32)
 
 
 def _validate_sampling(model, temperature, top_k, top_p, rng):

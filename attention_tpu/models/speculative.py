@@ -81,13 +81,27 @@ def generate_speculative(
     capacity: int | None = None,
     cache_type: str = "dense",
     page_size: int = 128,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
-    """Greedy speculative generation: (1, S) prompt -> (1, steps).
+    """Speculative generation: (1, S) prompt -> (1, steps).
 
-    Exactly equals ``generate(target, ...)``'s greedy output for EVERY
-    ``cache_type``.  ``gamma`` is the draft lookahead per verify step;
-    speedup comes from the target scoring gamma+1 positions per forward
-    instead of one.  ``page_size`` applies to ``cache_type="paged"``.
+    ``temperature == 0`` (default) is greedy and exactly equals
+    ``generate(target, ...)``'s greedy output for EVERY ``cache_type``.
+    ``temperature > 0`` (requires ``rng``) is speculative SAMPLING via
+    the rejection scheme (Leviathan/Chen): draft token x_i ~ p_d is
+    accepted with probability min(1, p_t(x_i)/p_d(x_i)); the first
+    rejection resamples from normalize(max(p_t - p_d, 0)); a fully
+    accepted window draws one extra token from p_t.  Emitted tokens are
+    distributed EXACTLY as target-only sampling — for any draft — with
+    the same temperature/top-k/top-p warp `generate` applies (both
+    distributions warp identically; the ratio is taken between the
+    warped distributions).  ``gamma`` is the draft lookahead per verify
+    step; speedup comes from the target scoring gamma+1 positions per
+    forward instead of one.  ``page_size`` applies to
+    ``cache_type="paged"``.
     """
     if prompt.shape[0] != 1:
         raise ValueError(
@@ -109,6 +123,9 @@ def generate_speculative(
             f"cache_type {cache_type!r} requires the target's "
             f"impl='flash' (got {target.impl!r})"
         )
+    from attention_tpu.models.decode import _validate_sampling
+
+    rng = _validate_sampling(target, temperature, top_k, top_p, rng)
     if target.rope and target.attn_sinks and target.window is not None:
         # chunk verify keeps absolute sink rotations (every cache
         # type's s_new > 1 rule) while single-token decode re-rotates
@@ -174,47 +191,80 @@ def generate_speculative(
             )
             for c in t_caches
         )
-    t_next = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (1,)
+    if rng is None:
+        t_next = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        key = None
+    else:
+        from attention_tpu.models.decode import _select_token
+
+        key, k0 = jax.random.split(jax.random.fold_in(rng, 0))
+        t_next = _select_token(t_logits[:, -1], k0,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p)
 
     return _speculative_loop(
         target, target_params, draft, draft_params,
         t_next, t_caches, d_caches,
         ctx0=s, steps=steps, gamma=gamma,
+        rng=key, temperature=jnp.float32(temperature), top_k=top_k,
+        top_p=top_p,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("target", "draft", "ctx0", "steps", "gamma"),
+    static_argnames=("target", "draft", "ctx0", "steps", "gamma",
+                     "top_k"),
 )
 def _speculative_loop(
     target, target_params, draft, draft_params,
     t_next, t_caches, d_caches, *, ctx0: int, steps: int, gamma: int,
+    rng=None, temperature=None, top_k=None, top_p=None,
 ):
     """The draft/verify `lax.while_loop` (cache-type-agnostic: the
-    attention layer dispatches chunk scoring per cache class)."""
+    attention layer dispatches chunk scoring per cache class).
+
+    ``rng is None``: greedy accept-if-argmax-agrees.  Otherwise the
+    rejection-sampling scheme over the WARPED distributions — exact
+    against target-only sampling (see `generate_speculative`)."""
+    from attention_tpu.models.decode import warp_logits
+
+    sampling = rng is not None
     buf = jnp.zeros((steps + gamma + 1,), jnp.int32)
     buf = buf.at[0].set(t_next[0])  # first token comes from the prefill
 
+    def warp(logits):  # (B, V) -> warped fp32 logits
+        return warp_logits(logits, temperature=temperature,
+                           top_k=top_k, top_p=top_p)
+
     def cond(carry):
-        _, _, _, _, _, count = carry
-        return count < steps
+        return carry[-1] < steps
 
     def body(carry):
         t_next, ctx, t_caches, d_caches, buf, count = carry
+        if sampling:
+            it_key = jax.random.fold_in(rng, count)
+            kd, kacc, kres = jax.random.split(it_key, 3)
         # --- draft gamma+1 tokens (last one only fills the cache row) ---
         d_caches = _set_len(d_caches, ctx)
 
-        def d_step(c, _):
+        def d_step(c, k_i):
             tok, caches = c
             logits, caches = draft.apply(
                 {"params": draft_params}, tok[:, None], caches
             )
+            if sampling:
+                w = warp(logits[:, -1])            # (1, V)
+                nxt = jax.random.categorical(k_i, w, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                return (nxt, caches), (nxt, jax.nn.softmax(w[0]))
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return (nxt, caches), nxt
+            return (nxt, caches), (nxt, jnp.zeros((), jnp.float32))
 
-        (_, d_caches), drafts = lax.scan(
-            d_step, (t_next, d_caches), None, length=gamma + 1
+        d_keys = (jax.random.split(kd, gamma + 1) if sampling
+                  else jnp.zeros((gamma + 1,)))
+        (_, d_caches), (drafts, pds) = lax.scan(
+            d_step, (t_next, d_caches), d_keys
         )
         drafts = drafts[:, 0]  # (gamma+1,); drafts[gamma] is discarded
 
@@ -224,17 +274,42 @@ def _speculative_loop(
         logits, t_caches = target.apply(
             {"params": target_params}, chunk, t_caches
         )
-        preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # (g+1,)
 
-        # --- longest accepted prefix: preds[i] == drafts[i] ---
-        agree = preds[:gamma] == drafts[:gamma]
-        accepted = jnp.argmin(
-            jnp.concatenate([agree, jnp.asarray([False])])
-        ).astype(jnp.int32)  # first disagreement == count of agreements
-
-        # emit drafts[0..accepted-1] then the correction preds[accepted]
         idx = jnp.arange(gamma + 1)
-        emit = jnp.where(idx < accepted, drafts, preds[accepted])
+        if sampling:
+            pt = jax.nn.softmax(warp(logits[0]), axis=-1)  # (g+1, V)
+            # accept draft i with prob min(1, p_t(x_i)/p_d(x_i)); the
+            # ratio is between the warped distributions — the ones the
+            # tokens were actually drawn from
+            p_d_at = pds[idx[:gamma], drafts[:gamma]]
+            p_t_at = pt[idx[:gamma], drafts[:gamma]]
+            u = jax.random.uniform(kacc, (gamma,))
+            agree = u * p_d_at < p_t_at  # u < min(1, pt/pd), div-free
+            accepted = jnp.argmin(
+                jnp.concatenate([agree, jnp.asarray([False])])
+            ).astype(jnp.int32)
+            # correction: first rejection resamples from the residual
+            # normalize(max(p_t - p_d, 0)); full acceptance draws the
+            # bonus token from p_t at position gamma
+            res_row = jnp.maximum(pt[accepted] - pds[accepted], 0.0)
+            pt_row = pt[jnp.minimum(accepted, gamma)]
+            row = jnp.where(accepted < gamma, res_row, pt_row)
+            # degenerate residual (p_t == p_d exactly): any sample from
+            # p_t is distributed correctly conditioned on rejection
+            # being impossible there
+            row = jnp.where(jnp.sum(row) > 0.0, row, pt_row)
+            corr = jax.random.categorical(kres, jnp.log(row))
+            corr = corr.astype(jnp.int32)
+        else:
+            preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+            agree = preds[:gamma] == drafts[:gamma]
+            accepted = jnp.argmin(
+                jnp.concatenate([agree, jnp.asarray([False])])
+            ).astype(jnp.int32)  # first disagreement == # of agreements
+            corr = preds[accepted]
+
+        # emit drafts[0..accepted-1] then the correction token
+        emit = jnp.where(idx < accepted, drafts, corr)
         # masked window write at `count` (buffer has gamma+1 slack)
         window = lax.dynamic_slice(buf, (count,), (gamma + 1,))
         keep = idx <= accepted
@@ -244,7 +319,7 @@ def _speculative_loop(
 
         new_ctx = ctx + accepted + 1
         return (
-            preds[accepted][None],
+            corr[None],
             new_ctx,
             _set_len(t_caches, new_ctx),
             _set_len(d_caches, new_ctx),

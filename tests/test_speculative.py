@@ -88,6 +88,70 @@ def test_speculative_cache_matrix_windowed(rng, cache_type):
     np.testing.assert_array_equal(got, want)
 
 
+def test_speculative_sampling_low_temperature_equals_greedy(rng):
+    """T -> 0 concentrates both warped distributions on their argmax;
+    the rejection scheme then reduces to the greedy accept rule, so the
+    sampled output must equal the greedy output exactly."""
+    target, tp, draft, dp, prompt = _models()
+    want = np.asarray(generate(target, tp, prompt, steps=10))
+    got = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=10, gamma=3,
+        temperature=1e-6, rng=jax.random.PRNGKey(3),
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_sampling_matches_target_distribution(rng):
+    """The rejection-sampling exactness theorem, tested empirically:
+    over many keys, the marginal distribution of each emitted position
+    must match target-only sampling (any draft).  Deterministic — the
+    key set is fixed — so no flake."""
+    target, tp, draft, dp, prompt = _models(vocab=11)
+    steps, n_runs = 3, 250
+    spec = np.zeros((n_runs, steps), np.int64)
+    tonly = np.zeros((n_runs, steps), np.int64)
+    for i in range(n_runs):
+        spec[i] = np.asarray(generate_speculative(
+            target, tp, draft, dp, prompt, steps=steps, gamma=2,
+            temperature=1.0, rng=jax.random.PRNGKey(1000 + i),
+        ))[0]
+        tonly[i] = np.asarray(generate(
+            target, tp, prompt, steps=steps, temperature=1.0,
+            rng=jax.random.PRNGKey(5000 + i),
+        ))[0]
+    # Two-sample TV noise floor at vocab 11, n=250 is ~0.11 per
+    # position (sum of ~sqrt(2pq/n) half-deviations); a systematic
+    # distribution bug shows as >=0.3.  Per-position rails sit above
+    # the noise; the pooled histogram (n=750) gives the tight check.
+    for pos in range(steps):
+        hs = np.bincount(spec[:, pos], minlength=11) / n_runs
+        ht = np.bincount(tonly[:, pos], minlength=11) / n_runs
+        tv = 0.5 * np.abs(hs - ht).sum()
+        assert tv < 0.2, f"position {pos}: total variation {tv:.3f}"
+    hs = np.bincount(spec.ravel(), minlength=11) / spec.size
+    ht = np.bincount(tonly.ravel(), minlength=11) / tonly.size
+    tv = 0.5 * np.abs(hs - ht).sum()
+    assert tv < 0.1, f"pooled total variation {tv:.3f}"
+
+
+def test_speculative_sampling_on_ragged_cache(rng):
+    """Sampling composes with the serving-cache matrix (here: ragged);
+    same fixed key -> deterministic output, inside the vocab."""
+    target, tp, draft, dp, prompt = _models()
+    a = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=8, gamma=3,
+        temperature=0.8, top_k=7, rng=jax.random.PRNGKey(9),
+        cache_type="ragged",
+    ))
+    b = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, steps=8, gamma=3,
+        temperature=0.8, top_k=7, rng=jax.random.PRNGKey(9),
+        cache_type="ragged",
+    ))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (1, 8) and (a >= 0).all() and (a < 41).all()
+
+
 def test_speculative_validations(rng):
     target, tp, draft, dp, prompt = _models()
     with pytest.raises(ValueError, match="batch 1"):
@@ -112,3 +176,6 @@ def test_speculative_validations(rng):
     with pytest.raises(ValueError, match="sink"):
         generate_speculative(sink_t, sink_tp, sink_d, sink_dp,
                              sink_prompt, steps=4)
+    with pytest.raises(ValueError, match="rng"):
+        generate_speculative(target, tp, draft, dp, prompt, steps=4,
+                             temperature=1.0)
