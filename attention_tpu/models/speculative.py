@@ -39,6 +39,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from attention_tpu.models.attention_layer import RaggedKVCache
+from attention_tpu.models.decode import (
+    _select_token,
+    _validate_sampling,
+    warp_logits,
+)
 from attention_tpu.models.transformer import TinyDecoder
 
 CACHE_TYPES = ("dense", "ragged", "int8", "paged")
@@ -123,8 +128,6 @@ def generate_speculative(
             f"cache_type {cache_type!r} requires the target's "
             f"impl='flash' (got {target.impl!r})"
         )
-    from attention_tpu.models.decode import _validate_sampling
-
     rng = _validate_sampling(target, temperature, top_k, top_p, rng)
     if target.rope and target.attn_sinks and target.window is not None:
         # chunk verify keeps absolute sink rotations (every cache
@@ -195,8 +198,6 @@ def generate_speculative(
         t_next = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
         key = None
     else:
-        from attention_tpu.models.decode import _select_token
-
         key, k0 = jax.random.split(jax.random.fold_in(rng, 0))
         t_next = _select_token(t_logits[:, -1], k0,
                                temperature=temperature, top_k=top_k,
@@ -227,8 +228,6 @@ def _speculative_loop(
     ``rng is None``: greedy accept-if-argmax-agrees.  Otherwise the
     rejection-sampling scheme over the WARPED distributions — exact
     against target-only sampling (see `generate_speculative`)."""
-    from attention_tpu.models.decode import warp_logits
-
     sampling = rng is not None
     buf = jnp.zeros((steps + gamma + 1,), jnp.int32)
     buf = buf.at[0].set(t_next[0])  # first token comes from the prefill

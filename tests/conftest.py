@@ -112,7 +112,8 @@ def pytest_collection_modifyitems(config, items):
     # the invocation selects individual nodes or keywords (those
     # legitimately collect a subset of a module).
     if (any("::" in str(a) for a in config.args)
-            or config.getoption("keyword", "")):
+            or config.getoption("keyword", "")
+            or config.getoption("deselect", None)):
         return
     stale = [
         f"{mod}::{name}"
