@@ -879,6 +879,19 @@ def _flash_call(
                   file=sys.stderr)
             outs = _run(True)
         else:
+            # The cond's STRUCTURE costs ~30-50 us per call on this
+            # toolchain regardless of branch content — measured round 5
+            # (scripts/guard_cost_exp.py, scripts/passthrough_cond_exp
+            # .py, artifacts/guard_cost_exp.json): a trivial-predicate
+            # cond pays the same, a pass-through-branch cond pays MORE
+            # (37-52 us), and moving the branch in-kernel (one kernel,
+            # two grid-invariant @pl.when tile bodies reading the
+            # verdict from a scalar-prefetch slot) ran 359 us vs 214 at
+            # 8k — Mosaic schedules the union CFG without cross-step
+            # overlap, the causal-split lesson again.  Since guarded
+            # bound (214 us @8k) still beats online (228 us), this cond
+            # IS the measured optimum among every structure tried; the
+            # flat cost is the price of the no-silent-zeros guarantee.
             outs = jax.lax.cond(bound_safe,
                                 lambda: _run(True), lambda: _run(False))
     else:

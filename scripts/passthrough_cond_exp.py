@@ -1,0 +1,64 @@
+"""Is a rare-branch `lax.cond` cheap when the hot branch passes through?
+
+The round-5 guard pays ~30 us of `lax.cond` STRUCTURE cost per call
+(scripts/guard_cost_exp.py: trivial-predicate cond = +33 us while the
+guard expression alone is 8.6 us).  A deferred-detection guard would
+run the bound kernel unconditionally and wrap only the FIXUP in a cond
+whose hot branch returns the already-computed output.  This measures
+that structure: kernel -> data-dependent always-true predicate ->
+cond(pred, passthrough, recompute), vs the bare kernel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.flash import flash_attention
+    from attention_tpu.utils.timing import benchmark_auto
+
+    for seq in (8192, 32768):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (seq, 128), jnp.bfloat16)
+        k = jax.random.normal(kk, (seq, 128), jnp.bfloat16)
+        v = jax.random.normal(kv, (seq, 128), jnp.bfloat16)
+
+        def bare(x, k_, v_):
+            return flash_attention(x, k_, v_)
+
+        def guarded(x, k_, v_):
+            out = flash_attention(x, k_, v_)
+            # data-dependent, never-true-in-practice predicate (mirrors
+            # the deferred failure flag)
+            bad = jnp.sum(jnp.abs(out[:8, :8]).astype(jnp.float32)) > 1e30
+            return jax.lax.cond(
+                bad,
+                lambda: flash_attention(x * 1.0001, k_, v_),  # rare fixup
+                lambda: out,
+            )
+
+        t_bare = statistics.median(
+            benchmark_auto(bare, q, repeats=5, n_long=32, operands=(k, v))
+            for _ in range(2))
+        t_guard = statistics.median(
+            benchmark_auto(guarded, q, repeats=5, n_long=32, operands=(k, v))
+            for _ in range(2))
+        print(json.dumps({
+            "seq": seq,
+            "bare_us": t_bare * 1e6,
+            "passthrough_cond_us": t_guard * 1e6,
+            "structure_overhead_us": (t_guard - t_bare) * 1e6,
+        }))
+
+
+if __name__ == "__main__":
+    main()
