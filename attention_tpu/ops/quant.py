@@ -170,8 +170,14 @@ def _decode_q_kernel(
     row (g, s) at position ``valid - chunk + s``, causal + per-row
     window band.  ``unpack``: tile dequantizer (storage block -> bf16
     values block); None = plain int8 convert.  ONE kernel body serves
-    every storage format so masking/band logic cannot drift between
-    them."""
+    every BYTE-PER-FEATURE storage format so masking/band logic cannot
+    drift between them.  Documented exception: the token-paired int4
+    layout (`_decode_tok4_kernel`) cannot ride the unpack hook — its
+    unpack doubles the ROW count, changing the score tile's lane->token
+    map — so it mirrors this body instead; any band/mask semantics
+    change here must touch that kernel too, and the cross-layout
+    equality tests (tests/test_quant.py::test_int4_tok_matches_feature_
+    layout, tpu_smoke's token-paired case) pin the two against drift."""
     bh = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
@@ -512,9 +518,8 @@ def _quant_rows_int4(x):
     return packed, scale_rep
 
 
-def _unpack_int4(packed):
-    """(rows, d//2) int8 nibbles -> (rows, d) bf16 in natural feature
-    order; halves concat along lanes (no element interleave).
+def _unpack_nibbles(packed):
+    """int8 byte tile -> (lo, hi) bf16 nibble tiles of the same shape.
 
     Nibble extraction is float floor arithmetic, NOT integer shifts:
     Mosaic fails to legalize `arith.shli` on int8 vectors in-kernel
@@ -522,12 +527,21 @@ def _unpack_int4(packed):
     convert/floor/fma all lower cleanly.  floor(p/16) IS the
     arithmetic right shift (rounds toward -inf), so `hi` comes out
     sign-extended; the low nibble is the remainder re-signed.  Values
-    are small integers — exact in fp32."""
+    are small integers — exact in fp32.  The ONE home of this
+    workaround: both int4 layouts (feature-dim and token-paired) build
+    their unpacks from it."""
     p = packed.astype(jnp.float32)
     hi = jnp.floor(p * (1.0 / 16.0))
     lo = p - 16.0 * hi                       # [0, 15] unsigned nibble
     lo = jnp.where(lo >= 8.0, lo - 16.0, lo)  # two's-complement sign
-    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.bfloat16)
+    return lo.astype(jnp.bfloat16), hi.astype(jnp.bfloat16)
+
+
+def _unpack_int4(packed):
+    """(rows, d//2) int8 nibbles -> (rows, d) bf16 in natural feature
+    order; halves concat along lanes (no element interleave)."""
+    lo, hi = _unpack_nibbles(packed)
+    return jnp.concatenate([lo, hi], axis=-1)
 
 
 def quantize_kv_int4(k: jax.Array, v: jax.Array) -> Int4KV:
@@ -639,6 +653,323 @@ def flash_decode_int4(
             _decode_q_kernel, hkv=hkv, block_k=block_k,
             softcap2=None if softcap is None else softcap * _LOG2E,
             window=window, sinks=sinks, unpack=_unpack_int4,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, d), jnp.bfloat16),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * n * d,
+            bytes_accessed=kc.size + vc.size + (ks.size + vs.size) * 4
+            + qs.size * 2,
+            transcendentals=b * h * n,
+        ),
+        interpret=interpret,
+    )(lens, qs, kc, ks, vc, vs)
+
+    return out[:, :group].reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
+# int4, token-paired packing (round 5, second attempt at the latency
+# side).  The feature-dim packing above measured 0.748 ms vs int8's
+# 0.445 at the bench decode shape: its (block_k, d/2=64) value tiles
+# are HALF the native 128-lane width, so the value stream loses the
+# full-width DMA efficiency the int8 kernel rides (RESULTS.md round 5).
+# This layout packs two ADJACENT TOKENS per byte instead — byte row r
+# holds token 2r in its low nibble and token 2r+1 in its high nibble,
+# per feature — so value tiles stay (rows, d=128) full lane width and
+# the unpack splits along SUBLANES (a concat on the major axis, no
+# lane relayout).  The pairing stride is a constant 2, so the layout is
+# independent of kernel tiling (no block_k coupling); scales ship
+# pre-split even/odd (rows 0-7 / 8-15 of a 16-row replicated band) so
+# the kernel's lane-concat of the two scale vectors matches the score
+# tile's [even tokens | odd tokens] lane order with contiguous fetches.
+# Quantization math (per-token symmetric absmax / 7) is IDENTICAL to
+# the feature packing, so the error budget carries over unchanged.
+# ---------------------------------------------------------------------------
+
+
+class Int4TokKV(NamedTuple):
+    """Token-paired int4 cache: values (B, Hkv, N//2, d) int8 (tokens
+    2r/2r+1 in the low/high nibbles of byte row r) + per-token fp32
+    scales (B, Hkv, 16, N//2) — sublanes 0-7 replicate the even-token
+    scale, 8-15 the odd-token scale."""
+
+    k_q: jax.Array
+    k_scale: jax.Array
+    v_q: jax.Array
+    v_scale: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return 2 * self.k_q.shape[2]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k_q.shape[3]
+
+
+def _quant_rows_int4_tok(x):
+    """Symmetric per-token absmax int4: (..., N, d) -> token-paired
+    packed (..., N//2, d) int8 + (..., 16, N//2) even/odd scales."""
+    n = x.shape[-2]
+    if n % 2:
+        raise ValueError(f"cache length {n} must be even for token pairing")
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)  # (..., N)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 7.0)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    q = jnp.clip(q, -7, 7).astype(jnp.int8)
+    lo = q[..., 0::2, :]   # even tokens
+    hi = q[..., 1::2, :]   # odd tokens
+    packed = jnp.bitwise_or(
+        jnp.bitwise_and(lo, 0xF), jnp.left_shift(hi, 4)
+    ).astype(jnp.int8)
+    se = jnp.broadcast_to(scale[..., None, 0::2],
+                          (*scale.shape[:-1], 8, n // 2))
+    so = jnp.broadcast_to(scale[..., None, 1::2],
+                          (*scale.shape[:-1], 8, n // 2))
+    return packed, jnp.concatenate([se, so], axis=-2)  # (..., 16, N//2)
+
+
+def _unpack_int4_tok(packed):
+    """(rows, d) token-paired int8 -> two (rows, d) bf16 value tiles
+    (even tokens, odd tokens) in natural within-block order — here the
+    two nibbles are two TOKEN rows sharing a byte row, so no lane
+    concat is needed; the caller stacks the halves along sublanes.
+    Nibble math lives in `_unpack_nibbles` (the Mosaic float-floor
+    workaround's one home)."""
+    return _unpack_nibbles(packed)
+
+
+def _pick_block_tok(n: int, want: int) -> int:
+    """Largest multiple of 256 that divides ``n`` and is <= ``want``.
+
+    The token-paired kernel's packed block is ``block_tok // 2`` byte
+    rows and must stay a multiple of the 128-row tile, so the token
+    block steps by 256 — `decode._pick_block_k`'s 128-stepped search
+    can land on an odd 128-multiple (e.g. n=4864, want=4096 -> 2432)
+    that is a valid int8 block but not a valid packed one.  A
+    256-multiple divisor always exists because `quantize_kv_int4_tok`
+    requires n % 256 == 0."""
+    if n % 256:
+        raise ValueError(
+            f"token-paired int4 cache capacity {n} must be a multiple "
+            f"of 256"
+        )
+    bk = min(_ceil_to(want, 256), n)
+    while n % bk:
+        bk -= 256
+    return bk
+
+
+def quantize_kv_int4_tok(k: jax.Array, v: jax.Array) -> Int4TokKV:
+    """Quantize full (B, Hkv, N, d) K/V caches to the token-paired int4
+    format.  Same quantization math — and therefore the same measured
+    ~4-8e-2 opt-in error budget — as :func:`quantize_kv_int4`; see that
+    docstring for the contract discussion."""
+    n = k.shape[-2]
+    if n % 256:
+        # the decode grid needs a 256-multiple token block dividing the
+        # capacity; for n ≡ 128 (mod 256) no such block exists, so the
+        # cache would be unusable by construction — fail at build time
+        # with a capacity-phrased error, not at decode with a
+        # block-size one
+        raise ValueError(
+            f"token-paired int4 needs a 256-multiple cache capacity, "
+            f"got {n} (use the feature-dim layout for smaller caches)"
+        )
+    k_q, k_s = _quant_rows_int4_tok(k)
+    v_q, v_s = _quant_rows_int4_tok(v)
+    return Int4TokKV(k_q, k_s, v_q, v_s)
+
+
+def _decode_tok4_kernel(
+    lens_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+    acc_scr, m_scr, l_scr,
+    *, hkv: int, block_tok: int, softcap2: float | None = None,
+    window: int | None = None, sinks: int | None = None,
+):
+    """One (batch*kv-head, token-block) grid step against a
+    token-paired int4 cache.  Mirrors `_decode_q_kernel`'s band logic
+    through the same helpers (`banded_live`/`banded_keep`); the body
+    differs because the unpack doubles the ROW count: a (bp, d) packed
+    block becomes [even-token tile; odd-token tile] stacked along
+    sublanes, the score tile's lanes run [even | odd], and the mask's
+    column->token map is 2*lane (+1 for the odd half).
+
+    This is the documented EXCEPTION to `_decode_q_kernel`'s one-body
+    invariant (see its docstring): keep the two bodies' band/mask
+    logic mirrored by hand; the cross-layout equality tests pin them.
+    No ``chunk`` (speculative-verify) mode — neither int4 layout has
+    one (speculative serving composes with the int8 cache,
+    `flash_decode_quantized_chunk`; int4 remains an opt-in decode-only
+    capacity/latency trade outside the ±0.02 contract)."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    valid = lens_ref[bh // hkv]
+    bp = block_tok // 2
+    kv_min = None
+    if window is not None:
+        kv_min = jnp.maximum(valid - window, 0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = banded_live(j, valid, block_tok, window, sinks)
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0]                          # (group_pad, d), log2-prescaled
+        k_lo, k_hi = _unpack_int4_tok(k_ref[0])
+        kt = jnp.concatenate([k_lo, k_hi], axis=0)  # (block_tok, d)
+        s = jax.lax.dot_general(
+            q, kt, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                     # (group_pad, block_tok)
+        ks = ks_ref[0]                        # (16, bp): even rows 0-7
+        k_scale = jnp.concatenate(
+            [jnp.max(ks[:8], axis=0, keepdims=True),
+             jnp.max(ks[8:], axis=0, keepdims=True)], axis=-1
+        )                                     # (1, block_tok), [even|odd]
+        s = s * k_scale
+        if softcap2 is not None:
+            s = softcap2 * jnp.tanh(s / softcap2)
+        lam = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        base = j * block_tok
+        col = jnp.where(lam < bp,
+                        base + 2 * lam,
+                        base + 2 * (lam - bp) + 1)
+        mask = col < valid
+        if kv_min is not None:
+            mask = jnp.logical_and(mask, banded_keep(col, kv_min, sinks))
+        s = jnp.where(mask, s, NEG_INF)
+
+        p, corr = _online_softmax_update(s, m_scr, l_scr, masked=True)
+        vs = vs_ref[0]
+        v_scale = jnp.concatenate(
+            [jnp.max(vs[:8], axis=0, keepdims=True),
+             jnp.max(vs[8:], axis=0, keepdims=True)], axis=-1
+        )
+        v_lo, v_hi = _unpack_int4_tok(v_ref[0])
+        vt = jnp.concatenate([v_lo, v_hi], axis=0)  # (block_tok, d)
+        pv = jax.lax.dot_general(
+            (p * v_scale).astype(jnp.bfloat16),
+            vt,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr + pv
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_k", "interpret", "softcap", "window",
+                     "sinks"),
+)
+def flash_decode_int4_tok(
+    q: jax.Array,          # (B, H, d)
+    cache: Int4TokKV,
+    lengths: jax.Array,    # (B,) int32 or scalar
+    *,
+    scale: float | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """softmax(q K[:len]^T * scale) V[:len] against a token-paired int4
+    cache.  Same band semantics and error budget as
+    :func:`flash_decode_int4`; ``block_k`` counts TOKENS (the packed
+    block is ``block_k // 2`` byte rows at full d-lane width).
+
+    Default block: **16384** tokens unwindowed — the measured optimum
+    at the bench decode shape (b8/32q/4kv/32k, device clock: 0.565 /
+    0.455 / 0.415 / 0.402 ms at 2048/4096/8192/16384; the unpack's VPU
+    cost rewards fewer, larger steps once the stream is no longer
+    DMA-bound) — and 4096 windowed, where block granularity bounds the
+    wasted stream past the band the same way it does for int8."""
+    check_softcap(softcap)
+    check_band(window, sinks)
+    if block_k is None:
+        block_k = 16384 if window is None else 4096
+    b, h, d = q.shape
+    bk_, hkv, n_half, dk_ = cache.k_q.shape
+    n = 2 * n_half
+    if bk_ != b or dk_ != d or cache.v_q.shape != (b, hkv, n_half, d):
+        raise ValueError(
+            f"cache shapes inconsistent: Q{q.shape} K{cache.k_q.shape} "
+            f"V{cache.v_q.shape}"
+        )
+    if cache.k_scale.shape != (b, hkv, 16, n_half) or \
+            cache.v_scale.shape != (b, hkv, 16, n_half):
+        raise ValueError(
+            f"scale shapes {cache.k_scale.shape}/{cache.v_scale.shape} "
+            f"!= {(b, hkv, 16, n_half)}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+    group = h // hkv
+
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(jnp.bfloat16)
+    qs = qs.reshape(b * hkv, group, d)
+    group_pad = _ceil_to(group, 16)
+    if group_pad != group:
+        qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
+
+    block_tok = _pick_block_tok(n, block_k)
+    bp = block_tok // 2
+    kc = cache.k_q.reshape(b * hkv, n_half, d)
+    vc = cache.v_q.reshape(b * hkv, n_half, d)
+    ks = cache.k_scale.reshape(b * hkv, 16, n_half)
+    vs = cache.v_scale.reshape(b * hkv, 16, n_half)
+
+    def kv_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, banded_block_clamp(j, valid, block_tok, window, sinks), 0)
+
+    def scale_index(bh, j, lens_ref):
+        valid = lens_ref[bh // hkv]
+        return (bh, 0, banded_block_clamp(j, valid, block_tok, window, sinks))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * hkv, n // block_tok),
+        in_specs=[
+            pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+            pl.BlockSpec((1, bp, d), kv_index),
+            pl.BlockSpec((1, 16, bp), scale_index),
+            pl.BlockSpec((1, bp, d), kv_index),
+            pl.BlockSpec((1, 16, bp), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, group_pad, d), lambda bh, j, lr: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, d), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((group_pad, _STAT_LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_tok4_kernel, hkv=hkv, block_tok=block_tok,
+            softcap2=None if softcap is None else softcap * _LOG2E,
+            window=window, sinks=sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, d), jnp.bfloat16),

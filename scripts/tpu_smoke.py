@@ -483,6 +483,28 @@ def _():
     return got, want, 0.15
 
 
+@case("decode/int4 token-paired layout == feature layout")
+def _():
+    from attention_tpu.ops.quant import (
+        flash_decode_int4,
+        flash_decode_int4_tok,
+        quantize_kv_int4,
+        quantize_kv_int4_tok,
+    )
+
+    b, h, hkv, n, d = 2, 4, 2, 512, 128
+    lens = jnp.asarray([512, 300], jnp.int32)
+    q = _arr(b, h, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    # the two layouts share quantization math exactly; on-chip they may
+    # differ only by fp reassociation of the lane order
+    got = flash_decode_int4_tok(q, quantize_kv_int4_tok(kc, vc), lens,
+                                block_k=256)
+    want = flash_decode_int4(q, quantize_kv_int4(kc, vc), lens,
+                             block_k=256)
+    return got, want, 1e-2
+
+
 @case("fwd/bound guard demotes adversarial norms on-chip")
 def _():
     d = 128
