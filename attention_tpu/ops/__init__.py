@@ -2,9 +2,15 @@ from attention_tpu.ops.reference import attention_xla  # noqa: F401
 from attention_tpu.ops.flash import flash_attention, flash_attention_partials  # noqa: F401
 from attention_tpu.ops.decode import flash_decode  # noqa: F401
 from attention_tpu.ops.quant import (  # noqa: F401
+    Int4KV,
+    Int4TokKV,
     QuantizedKV,
+    flash_decode_int4,
+    flash_decode_int4_tok,
     flash_decode_quantized,
     quantize_kv,
+    quantize_kv_int4,
+    quantize_kv_int4_tok,
     update_quantized_kv,
 )
 from attention_tpu.ops.paged import (  # noqa: F401

@@ -59,7 +59,11 @@ def bench_mode(seq, dim, causal, max_mode, repeats, n_long, unsafe=False,
         # earlier arms creating the attribute).
         import inspect
 
-        if "inkernel" not in inspect.getsource(F._flash_call):
+        # match the dispatch CODE, not comment prose mentioning the
+        # experiment (a decision comment citing 'inkernel' must not
+        # re-enable the arm)
+        if '_GUARD_IMPL == "inkernel"' not in inspect.getsource(
+                F._flash_call):
             return None
     old = F._UNSAFE_SKIP_GUARD
     old_impl = getattr(F, "_GUARD_IMPL", "cond")
