@@ -505,6 +505,28 @@ def _():
     return got, want, 1e-2
 
 
+@case("decode/int4 token-paired windowed+sinks band")
+def _():
+    from attention_tpu.ops.quant import (
+        flash_decode_int4,
+        flash_decode_int4_tok,
+        quantize_kv_int4,
+        quantize_kv_int4_tok,
+    )
+
+    b, h, hkv, n, d = 2, 4, 2, 512, 128
+    lens = jnp.asarray([512, 300], jnp.int32)
+    q = _arr(b, h, d)
+    kc, vc = _arr(b, hkv, n, d), _arr(b, hkv, n, d)
+    # the [even|odd] column->token map must agree with the band keep
+    # mask under real Mosaic lowering, not just interpret mode
+    got = flash_decode_int4_tok(q, quantize_kv_int4_tok(kc, vc), lens,
+                                block_k=256, window=128, sinks=4)
+    want = flash_decode_int4(q, quantize_kv_int4(kc, vc), lens,
+                             block_k=256, window=128, sinks=4)
+    return got, want, 1e-2
+
+
 @case("fwd/bound guard demotes adversarial norms on-chip")
 def _():
     d = 128
@@ -737,8 +759,23 @@ def main() -> int:
     if platform not in ("tpu", "axon"):
         print("WARNING: not on TPU — this sweep validates Mosaic "
               "lowering and only proves that on a real chip")
+    # optional substring filters: `tpu_smoke.py int4 ring` runs only
+    # cases whose name contains any argument (full sweep otherwise) —
+    # for spot-checking one new case without the ~25-min full pass
+    filters = sys.argv[1:]
+    if any(a.startswith("-") for a in filters):
+        # no flags exist; silently dropping a mistyped one would launch
+        # the full ~25-min sweep the filter exists to avoid
+        print("usage: tpu_smoke.py [name-substring ...]  "
+              "(no flags; bare substrings filter cases)")
+        return 1
+    cases = ([c for c in CASES if any(f in c[0] for f in filters)]
+             if filters else CASES)
+    if filters and not cases:
+        print(f"no case matches filters {filters}")
+        return 1
     failures = []
-    for name, fn in CASES:
+    for name, fn in cases:
         try:
             res = fn()
             got, want = res[0], res[1]
@@ -754,7 +791,8 @@ def main() -> int:
         except Exception as e:  # lowering failures land here
             print(f"FAIL {name}: {type(e).__name__}: {e}")
             failures.append(name)
-    print(f"\n{len(CASES) - len(failures)}/{len(CASES)} variants green"
+    print(f"\n{len(cases) - len(failures)}/{len(cases)} variants green"
+          + (f" (of {len(CASES)} total; filtered)" if filters else "")
           + (f"; FAILED: {failures}" if failures else ""))
     return 1 if failures else 0
 
