@@ -61,6 +61,14 @@ SAFE_OVERSHOOT_LOG2 = 96.0
 # value at first trace anyway.
 _UNSAFE_SKIP_GUARD = False
 
+# Static small-shape resolution of max_mode="bound" -> online (see the
+# dispatch in `_flash_call`): below this many score elements
+# (h * m_pad * n_pad, halved for causal) the overshoot guard's flat
+# cond cost exceeds bound mode's VPU saving.  Measured round 5 between
+# causal 4k (8.4M elems, online wins by 35%) and causal 8k (33.6M,
+# bound wins by 21%) — 24M sits with margin on both sides.
+_BOUND_MIN_SCORE_ELEMS = 24 * 2**20
+
 
 def _compiler_params(semantics, vmem_limit_bytes=None):
     """CompilerParams with dimension semantics, tolerant of API spelling
@@ -684,6 +692,21 @@ def _flash_call(
         # runtime overshoot guard is a FLAT cost that dwarfs the tiny
         # band kernel (+70% at w=1024).  Same outputs either way —
         # windowed calls statically resolve to the online recurrence.
+        bound_mode = False
+    if bound_mode and (h * m_pad * n_pad * (0.5 if causal else 1.0)
+                       < _BOUND_MIN_SCORE_ELEMS):
+        # Measured crossover (round 5, device clock, d=128 single
+        # head; scripts/guard_cost_exp.py, artifacts/guard_cost_exp
+        # .json): the guard's flat ~9-30 us cond cost exceeds bound
+        # mode's VPU saving on small grids — guarded bound loses to
+        # online by 51% at 2k, 27% at 4k, 35% at causal 4k, and wins
+        # from 8k (+6%) / causal 8k (+21%) up.  Same outputs either
+        # way (bound is exact and demotes to online when unsafe), so
+        # small calls statically resolve to the online recurrence;
+        # the threshold sits between causal 4k (8.4M elems, online
+        # side) and causal 8k (33.6M, bound side) with margin both
+        # ways.  Grid work scales with h*m*n (halved causal), so the
+        # dispatch uses score elements, mirroring the measurement.
         bound_mode = False
     softcap2 = None if softcap is None else softcap * _LOG2E
     kernel_kwargs = dict(

@@ -568,11 +568,23 @@ def main(argv=None) -> int:
         cq = jax.random.normal(kq, (4096, args.dim), jnp.bfloat16)
         ck = jax.random.normal(kk, (4096, args.dim), jnp.bfloat16)
         cv = jax.random.normal(kv, (4096, args.dim), jnp.bfloat16)
-        got = np.asarray(
-            flash_attention(cq, ck, cv, max_mode=args.max_mode,
-                            block_sizes=check_bs),
-            np.float32,
-        )
+        # pin off the small-shape bound->online static resolution: this
+        # 4k check exists to validate the MODE the headline timed, and
+        # 4k sits below the production dispatch threshold
+        import attention_tpu.ops.flash as _F
+
+        old_min = _F._BOUND_MIN_SCORE_ELEMS
+        _F._BOUND_MIN_SCORE_ELEMS = 0
+        jax.clear_caches()
+        try:
+            got = np.asarray(
+                flash_attention(cq, ck, cv, max_mode=args.max_mode,
+                                block_sizes=check_bs),
+                np.float32,
+            )
+        finally:
+            _F._BOUND_MIN_SCORE_ELEMS = old_min
+            jax.clear_caches()
         with jax.default_matmul_precision("highest"):
             want = np.asarray(
                 attention_xla(

@@ -68,6 +68,11 @@ def bench_mode(seq, dim, causal, max_mode, repeats, n_long, unsafe=False,
     old = F._UNSAFE_SKIP_GUARD
     old_impl = getattr(F, "_GUARD_IMPL", "cond")
     old_est = F._bound_overshoot_estimate
+    old_min = F._BOUND_MIN_SCORE_ELEMS
+    # this experiment studies the KERNELS; production's small-shape
+    # bound->online resolution would make 2k/4k arms measure the
+    # online kernel under the bound label
+    F._BOUND_MIN_SCORE_ELEMS = 0
     F._UNSAFE_SKIP_GUARD = unsafe
     F._GUARD_IMPL = guard_impl
     if trivial_pred:
@@ -85,6 +90,7 @@ def bench_mode(seq, dim, causal, max_mode, repeats, n_long, unsafe=False,
         F._UNSAFE_SKIP_GUARD = old
         F._GUARD_IMPL = old_impl
         F._bound_overshoot_estimate = old_est
+        F._BOUND_MIN_SCORE_ELEMS = old_min
         jax.clear_caches()
 
 

@@ -27,6 +27,12 @@ def main() -> int:
     args = p.parse_args()
 
     from bench import _bench_flash_s
+    import attention_tpu.ops.flash as _F
+
+    # tile sweeps label results with the mode they name; pin off the
+    # production small-shape bound->online dispatch so --seq <= 4096
+    # sweeps the BOUND kernel, not the online one under its label
+    _F._BOUND_MIN_SCORE_ELEMS = 0
 
     from attention_tpu.utils.flops import attention_flops, peak_flops
 
