@@ -693,6 +693,16 @@ def _flash_call(
         # band kernel (+70% at w=1024).  Same outputs either way —
         # windowed calls statically resolve to the online recurrence.
         bound_mode = False
+    if bound_mode and block_k % _STAT_LANES != 0:
+        # the bound kernel accumulates l in _STAT_LANES-wide lane
+        # slices (`_flash_tile`): a narrower tile cannot feed the
+        # (block_q, _STAT_LANES) scratch (shape error), and a wider
+        # NON-MULTIPLE tile silently drops columns past the last full
+        # slice from l while still accumulating them into P·V —
+        # measured 0.31 max abs error at block_k=192.  Both resolve to
+        # the online recurrence (latent since round 3, exposed when
+        # the sharded paths gained max_mode threading).
+        bound_mode = False
     if bound_mode and (h * m_pad * n_pad * (0.5 if causal else 1.0)
                        < _BOUND_MIN_SCORE_ELEMS):
         # Measured crossover (round 5, device clock, d=128 single

@@ -61,14 +61,18 @@ def merge_partials(out_un, lmax, lsum, axis_name: str):
 def _local_partials(
     q, k, v, *, impl, scale, block_sizes, kv_valid, causal=False, q_offset=0,
     kv_offset=0, softcap=None, window=None, sinks=None, q_segment_ids=None,
-    kv_segment_ids=None,
+    kv_segment_ids=None, max_mode="online",
 ):
+    # ``max_mode`` reaches the flash kernel only: the xla impl is the
+    # fp32 oracle whose exact max IS the online recurrence (bound is a
+    # kernel optimization, not a semantics change — same outputs)
     if impl == "flash":
         return flash_attention_partials(
             q, k, v, scale=scale, block_sizes=block_sizes, kv_valid=kv_valid,
             causal=causal, q_offset=q_offset, kv_offset=kv_offset,
             softcap=softcap, window=window, sinks=sinks,
             q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            max_mode=max_mode,
         )
     if window is not None or sinks is not None or q_segment_ids is not None:
         raise ValueError(
@@ -94,6 +98,7 @@ def _local_partials(
         "softcap",
         "window",
         "sinks",
+        "max_mode",
     ),
 )
 def kv_sharded_attention(
@@ -112,6 +117,7 @@ def kv_sharded_attention(
     sinks: int | None = None,
     q_segment_ids=None,
     kv_segment_ids=None,
+    max_mode: str = "bound",
 ) -> jax.Array:
     """Distributed attention with K/V rows sharded over a 1D mesh.
 
@@ -189,6 +195,7 @@ def kv_sharded_attention(
             sinks=sinks,
             q_segment_ids=seg_local[0] if seg_local else None,
             kv_segment_ids=seg_local[1] if seg_local else None,
+            max_mode=max_mode,
         )
         return merge_partials(out_un, lmax, lsum, axis_name).astype(q_full.dtype)
 
@@ -198,7 +205,7 @@ def kv_sharded_attention(
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
-                     "softcap"),
+                     "softcap", "max_mode"),
 )
 def q_sharded_attention(
     q: jax.Array,
@@ -211,6 +218,7 @@ def q_sharded_attention(
     block_sizes: BlockSizes | None = None,
     causal: bool = False,
     softcap: float | None = None,
+    max_mode: str = "bound",
 ) -> jax.Array:
     """Replicated-KV attention with Q rows sharded — the 'replicate' arm of
     the adaptive placement policy (small KV, `attention-mpi.c:217-241`).
@@ -240,6 +248,7 @@ def q_sharded_attention(
         return flash_attention(
             q_local, k_full, v_full, scale=scale, block_sizes=block_sizes,
             causal=causal, q_offset=q_offset, softcap=softcap,
+            max_mode=max_mode,
         )
 
     out = run(q, k, v)

@@ -41,7 +41,7 @@ NEG_INF = float("-inf")
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "scale", "block_sizes", "causal",
-                     "softcap", "schedule", "window", "sinks"),
+                     "softcap", "schedule", "window", "sinks", "max_mode"),
 )
 def ring_attention(
     q: jax.Array,
@@ -59,6 +59,7 @@ def ring_attention(
     sinks: int | None = None,
     q_segment_ids=None,
     kv_segment_ids=None,
+    max_mode: str = "bound",
 ) -> jax.Array:
     """Ring attention over a 1D mesh axis; output is Q-sharded like Q.
 
@@ -102,7 +103,7 @@ def ring_attention(
         return _zigzag_ring(
             q, k, v, mesh=mesh, axis_name=axis_name, scale=scale,
             block_sizes=block_sizes, softcap=softcap, window=window,
-            sinks=sinks,
+            sinks=sinks, max_mode=max_mode,
             segment_ids=(q_segment_ids, kv_segment_ids) if segmented
             else None,
         )
@@ -139,6 +140,7 @@ def ring_attention(
         axis_name=axis_name, n_dev=n_dev, n=n, m_local=m_local,
         n_local=n_local, scale=scale, block_sizes=block_sizes,
         causal=causal, softcap=softcap, window=window, sinks=sinks,
+        max_mode=max_mode,
     )
 
     @functools.partial(
@@ -167,7 +169,7 @@ def ring_attention(
     jax.jit,
     static_argnames=("mesh", "axis_name", "batch_axis", "head_axis",
                      "scale", "block_sizes", "causal", "softcap", "window",
-                     "sinks", "schedule"),
+                     "sinks", "schedule", "max_mode"),
 )
 def ring_attention_diff(
     q: jax.Array,
@@ -187,6 +189,7 @@ def ring_attention_diff(
     schedule: str = "contiguous",
     q_segment_ids=None,
     kv_segment_ids=None,
+    max_mode: str = "bound",
 ) -> jax.Array:
     """Differentiable ring attention: O(n/R) KV memory per device in
     BOTH passes.
@@ -256,7 +259,7 @@ def ring_attention_diff(
             q, k, v, mesh=mesh, axis_name=axis_name,
             batch_axis=batch_axis, head_axis=head_axis, scale=scale,
             block_sizes=block_sizes, softcap=softcap, window=window,
-            sinks=sinks,
+            sinks=sinks, max_mode=max_mode,
             segment_ids=(q_segment_ids, kv_segment_ids) if segmented
             else None,
         )
@@ -297,6 +300,7 @@ def ring_attention_diff(
         axis_name=axis_name, n_dev=n_dev, n=n, m_local=m_local,
         n_local=n_local, scale=scale, block_sizes=block_sizes,
         causal=causal, softcap=softcap, window=window, sinks=sinks,
+        max_mode=max_mode,
     )
 
     in_specs = [seq_spec, seq_spec, seq_spec]
@@ -349,6 +353,7 @@ class _RingCfg(NamedTuple):
     softcap: "float | None"
     window: "int | None"
     sinks: "int | None" = None
+    max_mode: str = "bound"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -391,6 +396,7 @@ def _ring_fwd_loop(q, k, v, cfg: _RingCfg, seg=None):
             kv_offset=shard * cfg.n_local,
             kv_valid=jnp.clip(cfg.n - shard * cfg.n_local, 0, cfg.n_local),
             softcap=cfg.softcap, window=cfg.window, sinks=cfg.sinks,
+            max_mode=cfg.max_mode,
             **seg_kw,
         )
         acc, m_run, l_run = _merge_step((acc, m_run, l_run),
@@ -574,7 +580,8 @@ def _zig_pad_ids(segment_ids, m, n, c_pad):
 
 
 def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
-                 window=None, sinks=None, segment_ids=None):
+                 window=None, sinks=None, segment_ids=None,
+                 max_mode="bound"):
     """Causal ring attention with the llama-3-style zigzag layout.
 
     The sequence is split into 2R chunks; device d owns chunks
@@ -620,7 +627,7 @@ def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
     zcfg = _ZigCfg(
         axis_name=axis_name, n_dev=n_dev, n=n, chunk=chunk, scale=scale,
         block_sizes=block_sizes, softcap=softcap, window=window,
-        sinks=sinks,
+        sinks=sinks, max_mode=max_mode,
     )
 
     extra = []
@@ -661,6 +668,7 @@ class _ZigCfg(NamedTuple):
     softcap: "float | None"
     window: "int | None"
     sinks: "int | None" = None
+    max_mode: str = "bound"
 
 
 def _zig_slices(ndim, chunk):
@@ -721,6 +729,7 @@ def _zig_fwd_loop(q_local, k_local, v_local, z: _ZigCfg, seg=None):
             softcap=z.softcap,
             window=z.window,
             sinks=z.sinks,
+            max_mode=z.max_mode,
             **seg_kw,
         )
 
@@ -945,7 +954,7 @@ def _zigzag_exchange(x, axis_name, n_dev, chunk, *, inverse=False):
 
 def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
                       scale, block_sizes, softcap, window, sinks=None,
-                      segment_ids=None):
+                      segment_ids=None, max_mode="bound"):
     """Differentiable zigzag ring: in-shard_map layout exchange ->
     _zig_diff -> inverse exchange (all collective-based; autodiff
     transposes the ppermutes).  Segment ids ride replicated in GLOBAL
@@ -972,7 +981,7 @@ def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
     zcfg = _ZigCfg(
         axis_name=axis_name, n_dev=n_dev, n=n, chunk=chunk, scale=scale,
         block_sizes=block_sizes, softcap=softcap, window=window,
-        sinks=sinks,
+        sinks=sinks, max_mode=max_mode,
     )
 
     in_specs = [seq_spec, seq_spec, seq_spec]

@@ -33,7 +33,8 @@ from attention_tpu.parallel.mesh import default_mesh
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "batch_axis", "scale",
-                     "block_sizes", "causal", "softcap", "window", "sinks"),
+                     "block_sizes", "causal", "softcap", "window", "sinks",
+                     "max_mode"),
 )
 def ulysses_attention(
     q: jax.Array,
@@ -51,6 +52,7 @@ def ulysses_attention(
     sinks: int | None = None,
     q_segment_ids=None,
     kv_segment_ids=None,
+    max_mode: str = "bound",
 ) -> jax.Array:
     """All-to-all sequence-parallel attention for multi-head inputs.
 
@@ -135,6 +137,7 @@ def ulysses_attention(
             qh, kh, vh, scale=scale, block_sizes=block_sizes, causal=causal,
             softcap=softcap, window=window, sinks=sinks,
             q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            max_mode=max_mode,
         )
         # head-sharded -> seq-sharded
         return lax.all_to_all(out, axis_name, seq_axis, head_axis, tiled=True)

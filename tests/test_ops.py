@@ -389,3 +389,32 @@ def test_bound_small_shape_resolves_online(rng, monkeypatch):
         assert calls, "guard missing for a large shape"
     finally:
         jax.clear_caches()
+
+
+def test_bound_non_multiple_block_k_resolves_online(rng, monkeypatch):
+    """block_k values that are not _STAT_LANES multiples must resolve
+    bound -> online: the bound kernel's per-lane l accumulation drops
+    columns past the last full 128-lane slice (while P.V keeps them),
+    which measured 0.31 max abs error at block_k=192 before the guard
+    — a silent under-normalization, not a crash."""
+    import attention_tpu.ops.flash as F
+
+    jax.clear_caches()
+    monkeypatch.setattr(F, "_BOUND_MIN_SCORE_ELEMS", 0)
+    try:
+        q, k, v = _rand_qkv(rng, 128, 384, 64, 64)
+        for bk in (32, 192):
+            got = np.asarray(flash_attention(
+                q, k, v, block_sizes=BlockSizes(64, bk),
+                max_mode="bound"))
+            want = np.asarray(flash_attention(
+                q, k, v, block_sizes=BlockSizes(64, bk)))
+            np.testing.assert_array_equal(got, want, err_msg=f"bk={bk}")
+        # a proper multiple still runs the bound kernel and agrees
+        got = np.asarray(flash_attention(
+            q, k, v, block_sizes=BlockSizes(64, 128), max_mode="bound"))
+        want = np.asarray(flash_attention(
+            q, k, v, block_sizes=BlockSizes(64, 128)))
+        np.testing.assert_allclose(got, want, atol=2e-5)
+    finally:
+        jax.clear_caches()

@@ -250,3 +250,70 @@ def test_ulysses_gqa_minimal_expansion_matches_flash(rng):
     got = ulysses_attention(q, k, v, mesh=mesh)
     want = flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_distributed_bound_mode_threads_through(rng, monkeypatch):
+    """max_mode reaches the local partials of every sharded path (round
+    5: kv-sharded/ring/zigzag/ulysses/q-sharded previously ran the
+    online kernel unconditionally while cp.py already defaulted to
+    bound).  With the small-shape resolution pinned off, bound (the new
+    default) must equal an explicit online run on the 8-device mesh —
+    the shard-local bound partials carry a DIFFERENT per-row max, so
+    equality here proves the two-phase merge is mode-agnostic under
+    shard_map, not just that the plumbing parses."""
+    import attention_tpu.ops.flash as F
+
+    # 128-lane KV tiles: the bound kernel needs block_k >= _STAT_LANES
+    # (narrower tiles statically resolve to online — also covered below)
+    bs128 = BlockSizes(32, 128)
+    calls = []
+    orig = F._bound_overshoot_estimate
+    jax.clear_caches()
+    monkeypatch.setattr(F, "_BOUND_MIN_SCORE_ELEMS", 0)
+    monkeypatch.setattr(
+        F, "_bound_overshoot_estimate",
+        lambda *a, **k: (calls.append(1), orig(*a, **k))[1])
+    try:
+        # square shapes: the zigzag schedule is self-attention-shaped
+        q, k, v = _qkv(rng, 128, 128, 32, 32)
+        mesh = default_mesh()
+        for fn, kw in (
+            (kv_sharded_attention, dict(block_sizes=bs128, causal=True)),
+            (q_sharded_attention, dict(block_sizes=bs128, causal=True)),
+            (ring_attention, dict(block_sizes=bs128, causal=True,
+                                  axis_name="kv")),
+            (ring_attention, dict(block_sizes=bs128, causal=True,
+                                  schedule="zigzag", axis_name="kv")),
+        ):
+            seen = len(calls)
+            got = np.asarray(fn(q, k, v, mesh=mesh, **kw))
+            assert len(calls) > seen, \
+                f"bound guard never traced in {fn.__name__} {kw}"
+            want = np.asarray(fn(q, k, v, mesh=mesh, max_mode="online",
+                                 **kw))
+            np.testing.assert_allclose(got, want, atol=2e-5,
+                                       err_msg=str((fn.__name__, kw)))
+        # ulysses needs multi-head input (head count % mesh == 0)
+        qh = jnp.asarray(rng.standard_normal((8, 64, 32)), jnp.float32)
+        kh = jnp.asarray(rng.standard_normal((8, 64, 32)), jnp.float32)
+        vh = jnp.asarray(rng.standard_normal((8, 64, 32)), jnp.float32)
+        seen = len(calls)
+        got = np.asarray(ulysses_attention(qh, kh, vh, mesh=mesh,
+                                           axis_name="kv", causal=True,
+                                           block_sizes=bs128))
+        assert len(calls) > seen, "bound guard never traced in ulysses"
+        want = np.asarray(ulysses_attention(qh, kh, vh, mesh=mesh,
+                                            axis_name="kv", causal=True,
+                                            block_sizes=bs128,
+                                            max_mode="online"))
+        np.testing.assert_allclose(got, want, atol=2e-5)
+        # narrow tiles: bound resolves to online instead of a kernel
+        # shape error (latent until the sharded paths gained max_mode)
+        narrow = np.asarray(kv_sharded_attention(
+            q, k, v, mesh=mesh, block_sizes=BS, causal=True))
+        full = np.asarray(kv_sharded_attention(
+            q, k, v, mesh=mesh, block_sizes=BS, causal=True,
+            max_mode="online"))
+        np.testing.assert_array_equal(narrow, full)
+    finally:
+        jax.clear_caches()
