@@ -14,6 +14,9 @@ Usage:
       --dk 128 --dv 128 [--seed 0]
   python -m attention_tpu.cli suite <out_dir>     # simple..scale5 ladder
   python -m attention_tpu.cli backends
+  python -m attention_tpu.cli tune --kernel flash --seq 32768 --dim 128
+      # timed on-device tile search; winners persist in the per-device
+      # cache (~/.cache/attention_tpu/) and future calls pick them up
 """
 
 from __future__ import annotations
@@ -113,6 +116,38 @@ def _cmd_backends(_args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_tune(args: argparse.Namespace) -> int:
+    import json
+
+    from attention_tpu.tuning.search import CLI_KERNELS, tune
+
+    kernels = (list(CLI_KERNELS) if args.kernel == "all"
+               else [args.kernel])
+    rc = 0
+    for name in kernels:
+        print(f"tuning {name} (seq={args.seq}, dim={args.dim})...",
+              file=sys.stderr)
+        try:
+            rec = tune(
+                CLI_KERNELS[name],
+                seq=args.seq, dim=args.dim, heads=args.heads,
+                kv_heads=args.kv_heads, batch=args.batch,
+                dtype=args.dtype, causal=args.causal,
+                window=args.window, sinks=args.sinks, stats=args.stats,
+                repeats=args.repeats, cache_path=args.cache,
+                write=not args.dry_run,
+                log=lambda s: print(s, file=sys.stderr),
+            )
+        except Exception as e:  # noqa: BLE001 - report and keep sweeping
+            print(json.dumps({"kernel": name,
+                              "error": f"{type(e).__name__}: "
+                                       f"{str(e)[:200]}"}))
+            rc = 1
+            continue
+        print(json.dumps(rec))
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="attention-tpu", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -142,6 +177,38 @@ def main(argv: list[str] | None = None) -> int:
 
     be = sub.add_parser("backends", help="list available backends")
     be.set_defaults(fn=_cmd_backends)
+
+    tn = sub.add_parser(
+        "tune",
+        help="timed on-device kernel tile search; winners persist in "
+             "the per-device tuning cache (see attention_tpu.tuning)",
+    )
+    tn.add_argument("--kernel", default="flash",
+                    choices=["flash", "flash-bwd", "flash-bwd-fused",
+                             "decode", "paged", "all"])
+    tn.add_argument("--seq", type=int, default=32768,
+                    help="sequence length (cache capacity for "
+                         "decode/paged)")
+    tn.add_argument("--dim", type=int, default=128)
+    tn.add_argument("--heads", type=int, default=1)
+    tn.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA KV heads (default: = --heads)")
+    tn.add_argument("--batch", type=int, default=8,
+                    help="batch size (decode/paged families)")
+    tn.add_argument("--dtype", default="bfloat16")
+    tn.add_argument("--causal", action="store_true")
+    tn.add_argument("--stats", action="store_true",
+                    help="tune the partials (stats-emitting) forward")
+    tn.add_argument("--window", type=int, default=None)
+    tn.add_argument("--sinks", type=int, default=None)
+    tn.add_argument("--repeats", type=int, default=3,
+                    help="median-of-k timing repeats per candidate")
+    tn.add_argument("--cache", default=None,
+                    help="cache file to write (default: "
+                         "~/.cache/attention_tpu/tuning_cache.json)")
+    tn.add_argument("--dry-run", action="store_true",
+                    help="search and report but write nothing")
+    tn.set_defaults(fn=_cmd_tune)
 
     args = parser.parse_args(argv)
     return args.fn(args)

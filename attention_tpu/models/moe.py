@@ -33,23 +33,43 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+def _active_mesh_axes() -> tuple | None:
+    """Axis names of the mesh context the caller entered (via
+    `attention_tpu.parallel.mesh.mesh_context`), or None when no mesh
+    is active — tolerant of jax API generations:
+    ``jax.sharding.get_abstract_mesh`` where it exists, else the
+    thread-resource env older jax keeps for ``with mesh:`` contexts."""
+    gam = getattr(jax.sharding, "get_abstract_mesh", None)
+    if gam is not None:
+        mesh = gam()
+        return None if mesh.empty else tuple(mesh.axis_names)
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+    except Exception:  # noqa: BLE001 - private-path drift reads as no mesh
+        return None
+    return None if mesh.empty else tuple(mesh.axis_names)
+
+
 def _maybe_constrain(x, spec: P | None):
     if spec is None:
         return x
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh.empty:
+    mesh_axes = _active_mesh_axes()
+    if mesh_axes is None:
         # no mesh context: single-device and test runs go unsharded
         return x
     axes = [a for a in spec if a is not None]
-    missing = [a for a in axes if a not in mesh.axis_names]
+    missing = [a for a in axes if a not in mesh_axes]
     if missing:
         # a named-but-absent axis is a misconfiguration, not a
         # fall-through: silently replicating would claim EP while
         # spending full expert memory on every device
         raise ValueError(
             f"ep_axis {missing} not in the current mesh "
-            f"(axes {mesh.axis_names}); enter the mesh with "
-            "jax.sharding.set_mesh or fix the axis name"
+            f"(axes {mesh_axes}); enter the mesh with "
+            "attention_tpu.parallel.mesh.mesh_context or fix the "
+            "axis name"
         )
     return jax.lax.with_sharding_constraint(x, spec)
 

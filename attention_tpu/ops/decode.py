@@ -187,6 +187,35 @@ def _pick_block_k(n: int, want: int) -> int:
     return bk
 
 
+# The sweep-chosen dense-decode KV block (the heuristic the tuner falls
+# back to): 512-row blocks cap streaming at ~450-500 GB/s where 2048
+# reaches ~730-900 (module docstring).
+_DEFAULT_BLOCK_K = 2048
+
+
+def _default_block_k(batch: int, h: int, hkv: int, n: int, d: int,
+                     dtype, window, sinks) -> int:
+    """Resolve an unspecified decode ``block_k``: tuning tables first
+    (user cache -> shipped table, keyed by device kind — see
+    `attention_tpu.tuning`), then the measured `_DEFAULT_BLOCK_K`, so
+    hosts with no cache entries behave exactly as before."""
+    try:
+        from attention_tpu.tuning.lookup import key_fields, lookup
+
+        entry = lookup(
+            "decode", dtype=dtype,
+            **key_fields("decode", heads=h, kv_heads=hkv, seq=n, dim=d,
+                         batch=batch, window=window, sinks=sinks),
+        )
+        if entry is not None:
+            bk = int(entry["block_k"])
+            if bk > 0 and bk % 128 == 0:
+                return bk
+    except Exception:  # noqa: BLE001 - tuning must never break dispatch
+        pass
+    return _DEFAULT_BLOCK_K
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_k", "interpret", "softcap", "window",
@@ -199,7 +228,7 @@ def flash_decode(
     lengths: jax.Array,  # (B,) int32 valid rows per sequence, or scalar
     *,
     scale: float | None = None,
-    block_k: int = 2048,
+    block_k: int | None = None,
     interpret: bool | None = None,
     softcap: float | None = None,
     window: int | None = None,
@@ -245,6 +274,8 @@ def flash_decode(
     if group_pad != group:
         qs = jnp.pad(qs, ((0, 0), (0, group_pad - group), (0, 0)))
 
+    if block_k is None:
+        block_k = _default_block_k(b, h, hkv, n, d, q.dtype, window, sinks)
     block_k = _pick_block_k(n, block_k)
     kc = k_cache.reshape(b * hkv, n, d)
     vc = v_cache.reshape(b * hkv, n, dv)
@@ -305,7 +336,7 @@ def flash_decode_chunk(
     new_lengths: jax.Array,  # (B,) int32 lengths AFTER the append
     *,
     scale: float | None = None,
-    block_k: int = 2048,
+    block_k: int | None = None,
     interpret: bool | None = None,
     softcap: float | None = None,
     window: int | None = None,
@@ -357,6 +388,8 @@ def flash_decode_chunk(
     if rows_pad != rows:
         qs = jnp.pad(qs, ((0, 0), (0, rows_pad - rows), (0, 0)))
 
+    if block_k is None:
+        block_k = _default_block_k(b, h, hkv, n, d, q.dtype, window, sinks)
     block_k = _pick_block_k(n, block_k)
     kc = k_cache.reshape(b * hkv, n, d)
     vc = v_cache.reshape(b * hkv, n, dv)

@@ -70,20 +70,33 @@ _UNSAFE_SKIP_GUARD = False
 _BOUND_MIN_SCORE_ELEMS = 24 * 2**20
 
 
+def _compiler_params_cls():
+    """The pallas TPU compiler-params class under either of its
+    spellings (``CompilerParams`` in newer pallas, ``TPUCompilerParams``
+    in older), or None when neither exists."""
+    return (getattr(pltpu, "CompilerParams", None)
+            or getattr(pltpu, "TPUCompilerParams", None))
+
+
 def _compiler_params(semantics, vmem_limit_bytes=None):
     """CompilerParams with dimension semantics, tolerant of API spelling
-    drift across pallas versions (shared by the forward and backward
-    kernels).  ``vmem_limit_bytes`` raises Mosaic's scoped-VMEM budget —
-    the fused backward kernel's VMEM-resident (m_pad, d) fp32 dQ block
-    legitimately exceeds the default budget."""
+    drift across pallas versions — both the CLASS name
+    (CompilerParams/TPUCompilerParams) and its kwargs (shared by the
+    forward and backward kernels).  ``vmem_limit_bytes`` raises
+    Mosaic's scoped-VMEM budget — the fused backward kernel's
+    VMEM-resident (m_pad, d) fp32 dQ block legitimately exceeds the
+    default budget."""
+    cls = _compiler_params_cls()
+    if cls is None:
+        return None
     kw = {"dimension_semantics": semantics}
     if vmem_limit_bytes is not None:
         kw["vmem_limit_bytes"] = vmem_limit_bytes
     try:
-        return pltpu.CompilerParams(**kw)
+        return cls(**kw)
     except TypeError:  # older/newer param spelling
         try:
-            return pltpu.CompilerParams(dimension_semantics=semantics)
+            return cls(dimension_semantics=semantics)
         except TypeError:
             return None
 
@@ -106,8 +119,35 @@ class BlockSizes(NamedTuple):
     def for_shape(cls, heads: int, m: int, d: int,
                   window: int | None = None,
                   returns_stats: bool = False,
-                  causal: bool = False) -> "BlockSizes":
-        """Measured per-shape defaults (callers may always override).
+                  causal: bool = False,
+                  dtype=None) -> "BlockSizes":
+        """Per-shape defaults (callers may always override): the tuning
+        tables first (user cache, then the shipped table — both keyed
+        by device kind, so CPU/interpret runs with no cache entries
+        resolve exactly as before), then the measured heuristic
+        (:meth:`heuristic_for_shape`).  ``python -m attention_tpu.cli
+        tune`` records fresh per-device optima into the user cache.
+        """
+        tuned = _tuned_flash_tiles(heads, m, d, window=window,
+                                   returns_stats=returns_stats,
+                                   causal=causal, dtype=dtype)
+        if tuned is not None:
+            return cls(*tuned)
+        return cls(*cls.heuristic_for_shape(m, d, window=window,
+                                            returns_stats=returns_stats,
+                                            causal=causal))
+
+    @classmethod
+    def heuristic_for_shape(cls, m: int, d: int, *,
+                            window: int | None = None,
+                            returns_stats: bool = False,
+                            causal: bool = False,
+                            big_tiles: bool | None = None
+                            ) -> tuple[int, int]:
+        """The measured heuristic defaults (the tuner's final fallback;
+        ``scripts/make_shipped_table.py`` seeds the shipped table from
+        this with ``big_tiles=True`` — the measured-generation value —
+        while ``None`` probes the local device).
 
         Round 4: raising the kernel's scoped-VMEM budget (it sat at
         Mosaic's ~16 MB default, which rejected every tile bigger than
@@ -129,13 +169,15 @@ class BlockSizes(NamedTuple):
         """
         if d <= 128 and m >= 8192:
             if window is not None:
-                return cls(512, 512)
-            if not (_vmem_limit_supported() and _big_tile_device()):
+                return (512, 512)
+            if big_tiles is None:
+                big_tiles = _vmem_limit_supported() and _big_tile_device()
+            if not big_tiles:
                 # without the raised budget (old pallas) or enough
                 # physical VMEM (v2/v3 cores ~16 MB accept the kwarg
                 # but cannot honor it) the big tiles cannot compile:
                 # keep the round-3 defaults that fit ~16 MB
-                return cls(1024, 1024) if returns_stats else cls(2048, 1024)
+                return (1024, 1024) if returns_stats else (2048, 1024)
             # padding-aware: _flash_call pads m to a block_q multiple,
             # so a 4096-row tile on e.g. m=10240 would compute +20%
             # garbage rows; 2048 bounds the padding at 2047 rows
@@ -145,17 +187,59 @@ class BlockSizes(NamedTuple):
                 # measured 1.580 ms at causal 32k vs 1.643 for the
                 # non-causal optimum (and 1.618 for the old 2048x1024)
                 bq = min(bq, 2048)
-            return cls(bq, 2048 if m % 2048 == 0 else 1024)
-        return cls()
+            return (bq, 2048 if m % 2048 == 0 else 1024)
+        return (cls._field_defaults["block_q"],
+                cls._field_defaults["block_k"])
+
+
+def _tuned_flash_tiles(heads, m, d, *, window, returns_stats, causal,
+                       dtype):
+    """Tuning-table tiles for the forward kernel, or None (heuristic).
+
+    Floor-pow2 bucketing means an entry measured at one shape serves a
+    range; the entry's tiles are re-bounded to THIS call's padding the
+    same way the heuristic bounds its own (block_q that doesn't divide
+    m caps at 2048 / block_k at 1024 — `_flash_call` pads m to a
+    block_q multiple, and an unbounded tile on an unaligned m computes
+    garbage rows).
+    """
+    try:
+        from attention_tpu.tuning.lookup import key_fields, lookup
+
+        entry = lookup(
+            "flash_fwd", dtype=dtype,
+            **key_fields("flash_fwd", heads=heads, seq=m, dim=d,
+                         causal=causal, window=window,
+                         stats=returns_stats),
+        )
+    except Exception:  # noqa: BLE001 - tuning must never break dispatch
+        return None
+    if entry is None:
+        return None
+    try:
+        bq, bk = int(entry["block_q"]), int(entry["block_k"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if bq % 128 or bk % 128 or bq <= 0 or bk <= 0:
+        return None
+    bq = min(bq, _ceil_to(m, 128))
+    bk = min(bk, _ceil_to(m, 128))
+    if m % bq:
+        bq = min(bq, 2048)
+    if m % bk:
+        bk = min(bk, 1024)
+    return bq, bk
 
 
 def _vmem_limit_supported() -> bool:
     """Whether this pallas accepts ``vmem_limit_bytes`` — the big-tile
     forward default and the fused backward both NEED the raised budget;
     without support the defaults must stay inside Mosaic's ~16 MB."""
+    cls = _compiler_params_cls()
+    if cls is None:
+        return False
     try:
-        pltpu.CompilerParams(dimension_semantics=("parallel",),
-                             vmem_limit_bytes=2**20)
+        cls(dimension_semantics=("parallel",), vmem_limit_bytes=2**20)
         return True
     except TypeError:
         return False
@@ -1085,7 +1169,7 @@ def flash_attention(
         normalize=True,
         block_sizes=block_sizes or BlockSizes.for_shape(
             qh.shape[0], qh.shape[1], qh.shape[2], window,
-            causal=causal),
+            causal=causal, dtype=qh.dtype),
         return_stats=False,
         interpret=interpret,
         out_dtype=v.dtype,
@@ -1151,7 +1235,7 @@ def flash_attention_partials(
         normalize=False,
         block_sizes=block_sizes or BlockSizes.for_shape(
             qh.shape[0], qh.shape[1], qh.shape[2], window,
-            returns_stats=True, causal=causal),
+            returns_stats=True, causal=causal, dtype=qh.dtype),
         return_stats=True,
         interpret=interpret,
         out_dtype=jnp.float32,

@@ -427,12 +427,21 @@ _FUSED_VMEM_BUDGET = 88 * 2**20
 # the chunked path at test scale.
 _FUSED_CHUNK_CANDIDATES = (65536, 32768, 16384, 8192)
 
+# Perf-triage/tuning ONLY (the `_UNSAFE_SKIP_GUARD` precedent in
+# flash.py: a code-settable module global, not an env var): force the
+# two-kernel backward even where the fused plan fits.  The tuner's
+# "flash_bwd" family sets this around its sweep — its entries feed
+# `default_bwd_block_sizes`, which only governs the non-fused dispatch,
+# so measuring them through the fused kernel would tune the wrong path.
+_FORCE_TWO_KERNEL = False
+
 
 def _fused_plan(m, n, d, dv, block_sizes, dtype, window=None):
     """The (BlockSizes, vmem_estimate) the fused kernel would run with,
     or None when its working set (including the caller's explicit tiles
     and the REAL block-multiple padding) exceeds the VMEM budget."""
-    bs = block_sizes or default_fused_bwd_block_sizes(d, dtype, window)
+    bs = block_sizes or default_fused_bwd_block_sizes(d, dtype, window,
+                                                      m=m, n=n)
     bq = min(bs.block_q, _ceil_to(m, 128))
     bk = min(bs.block_k, _ceil_to(n, 128))
     m_pad = _ceil_to(m, bq)
@@ -668,9 +677,38 @@ def _gqa_repeat(x, group):
     return jnp.repeat(x, group, axis=0) if group > 1 else x
 
 
-def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
+def _tuned_bwd_tiles(kernel: str, d: int, dtype, window, m, n):
+    """Tuning-table tiles for a backward family, or None (heuristic).
+    Skipped when the caller has no shape (``m`` None — the defaults are
+    also exercised shape-free by tests and docs)."""
+    if m is None:
+        return None
+    try:
+        from attention_tpu.tuning.lookup import key_fields, lookup
+
+        entry = lookup(kernel, dtype=dtype,
+                       **key_fields(kernel, seq=m, dim=d, window=window))
+    except Exception:  # noqa: BLE001 - tuning must never break dispatch
+        return None
+    if entry is None:
+        return None
+    try:
+        bq, bk = int(entry["block_q"]), int(entry["block_k"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if bq <= 0 or bk <= 0 or bq % 128 or bk % 128:
+        return None
+    return BlockSizes(min(bq, _ceil_to(m, 128)),
+                      min(bk, _ceil_to(n if n is not None else m, 128)))
+
+
+def default_bwd_block_sizes(d: int, dtype, window, *,
+                            m: int | None = None,
+                            n: int | None = None) -> BlockSizes:
     """Measured backward tile defaults (see the rationale comment at the
-    use site in :func:`flash_backward`).  Windowed shapes keep the
+    use site in :func:`flash_backward`), behind a tuning-table lookup
+    (`attention_tpu.tuning`; a host with no cache entries resolves to
+    the heuristic below unchanged).  Windowed shapes keep the
     round-1 512x512 — the banded grid covers
     ceil((window-1+block_q)/block_k)+1 KV blocks, so a taller tile
     computes more masked band columns; confirmed by a device-clock
@@ -678,6 +716,9 @@ def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
     other tile tried."""
     import jax.numpy as _jnp
 
+    tuned = _tuned_bwd_tiles("flash_bwd", d, dtype, window, m, n)
+    if tuned is not None:
+        return tuned
     if window is not None or d > 128:
         return BlockSizes(512, 512)
     if _jnp.dtype(dtype).itemsize <= 2:
@@ -686,7 +727,9 @@ def default_bwd_block_sizes(d: int, dtype, window) -> BlockSizes:
 
 
 def default_fused_bwd_block_sizes(d: int, dtype,
-                                  window=None) -> BlockSizes:
+                                  window=None, *,
+                                  m: int | None = None,
+                                  n: int | None = None) -> BlockSizes:
     """Tile defaults for the fused single-pass backward kernel (swept
     separately from the two-kernel path: the fused kernel's VMEM also
     holds the per-head (m_pad, d) fp32 dQ block, so its tile budget is
@@ -701,7 +744,14 @@ def default_fused_bwd_block_sizes(d: int, dtype,
     default).  Swept at seq=32k: 512x512 wins w=1024 (0.977 ms vs
     1.068 for 512x1024) and w=256 (0.707, tied with 256x256's 0.705),
     and sits 2% off 1024x1024 at w=4096 (2.028 vs 1.987) — one default
-    within 2% of best across the window range beats a size ladder."""
+    within 2% of best across the window range beats a size ladder.
+    Like :func:`default_bwd_block_sizes`, a tuning-table entry (user
+    cache -> shipped table) overrides the heuristic; note tuned fused
+    tiles still pass through `_fused_plan`'s VMEM feasibility check, so
+    an oversized entry demotes the call rather than failing compile."""
+    tuned = _tuned_bwd_tiles("flash_bwd_fused", d, dtype, window, m, n)
+    if tuned is not None:
+        return tuned
     if window is not None:
         return BlockSizes(512, 512)
     return BlockSizes(512, 4096)
@@ -784,8 +834,9 @@ def flash_backward(
     # 131k.  Chunk rounding to bf16 before the sum matches the CP
     # path's per-shard precision (each shard's dK/dV are cast before
     # the psum there too).
-    chunk = _fused_chunk_choice(m, n, d, dv, block_sizes, q.dtype,
-                                window=window, segmented=segmented)
+    chunk = (None if _FORCE_TWO_KERNEL else
+             _fused_chunk_choice(m, n, d, dv, block_sizes, q.dtype,
+                                 window=window, segmented=segmented))
     if chunk is not None:
         base_off = 0 if q_offset is None else q_offset
         dq_parts = []
@@ -809,7 +860,7 @@ def flash_backward(
         return (jnp.concatenate(dq_parts, axis=1),
                 dk32.astype(k.dtype), dv32.astype(v.dtype))
 
-    use_fused = fused_backward_applicable(
+    use_fused = not _FORCE_TWO_KERNEL and fused_backward_applicable(
         m, d, window=window, sinks=sinks, segmented=segmented,
         n=n, dv=dv, block_sizes=block_sizes, dtype=q.dtype)
     if use_fused:
@@ -817,7 +868,8 @@ def flash_backward(
     elif block_sizes is not None:
         bs = block_sizes
     else:
-        bs = default_bwd_block_sizes(q.shape[-1], q.dtype, window)
+        bs = default_bwd_block_sizes(q.shape[-1], q.dtype, window,
+                                     m=m, n=n)
 
     # Same pre-scaled (and re-rounded) Q the forward kernel saw, so the
     # recomputed P matches the forward probabilities bit-for-bit modulo

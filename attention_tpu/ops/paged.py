@@ -136,6 +136,42 @@ class PagePool:
                            jnp.int32)
 
 
+def recommended_page_size(cache_len: int, *, batch: int = 1,
+                          heads: int = 1, kv_heads: int | None = None,
+                          d: int = 128, dtype=None,
+                          window: int | None = None,
+                          sinks: int | None = None) -> int:
+    """Page size to build a pool with for this serving shape.
+
+    Tuning tables first (`attention_tpu.tuning`, the "paged" family —
+    page size IS the paged kernel's tile, so it is what the tuner
+    sweeps), then the measured heuristic: the largest power-of-two page
+    up to 2048 that divides the capacity (2048 is the bench-measured
+    dense-decode streaming block; a page must divide the capacity for
+    `paged_from_dense`).  A tuned page that does not divide
+    ``cache_len`` falls through to the heuristic rather than producing
+    an unusable pool."""
+    try:
+        from attention_tpu.tuning.lookup import key_fields, lookup
+
+        entry = lookup(
+            "paged", dtype=dtype,
+            **key_fields("paged", heads=heads, kv_heads=kv_heads,
+                         seq=cache_len, dim=d, batch=batch,
+                         window=window, sinks=sinks),
+        )
+        if entry is not None:
+            page = int(entry["page_size"])
+            if page > 0 and page % 128 == 0 and cache_len % page == 0:
+                return page
+    except Exception:  # noqa: BLE001 - tuning must never break dispatch
+        pass
+    for page in (2048, 1024, 512, 256):
+        if cache_len % page == 0:
+            return page
+    return 128
+
+
 def _paged_kernel(
     lens_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
     acc_scr, m_scr, l_scr,

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from attention_tpu.ops.flash import BlockSizes
 from attention_tpu.ops.flash_vjp import flash_attention_diff
+from attention_tpu.parallel.mesh import shard_map
 
 
 def _maybe_axis(mesh: Mesh, axis: str | None, dim: int) -> str | None:
@@ -141,7 +142,7 @@ def cp_flash_attention(
         in_specs += [P(axis_name), P()]
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=tuple(in_specs),

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
 from attention_tpu.ops.reference import attention_xla_partials
-from attention_tpu.parallel.mesh import default_mesh
+from attention_tpu.parallel.mesh import default_mesh, shard_map
 
 NEG_INF = float("-inf")
 
@@ -169,7 +169,7 @@ def kv_sharded_attention(
         in_specs += [P(), P(axis_name)]
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=tuple(in_specs),
@@ -240,7 +240,7 @@ def q_sharded_attention(
     from attention_tpu.ops.flash import flash_attention
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False, in_specs=(q_spec, P(), P()), out_specs=q_spec
+        shard_map, mesh=mesh, check_vma=False, in_specs=(q_spec, P(), P()), out_specs=q_spec
     )
     def run(q_local, k_full, v_full):
         m_local = q_local.shape[-2]

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from attention_tpu.parallel.mesh import default_mesh
+from attention_tpu.parallel.mesh import default_mesh, shard_map
 
 
 def pipeline_apply(
@@ -78,7 +78,7 @@ def pipeline_apply(
     )
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=(params_spec, P()),

@@ -33,7 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
-from attention_tpu.parallel.mesh import default_mesh
+from attention_tpu.parallel.mesh import default_mesh, shard_map
 
 NEG_INF = float("-inf")
 
@@ -144,7 +144,7 @@ def ring_attention(
     )
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=tuple(in_specs),
@@ -312,7 +312,7 @@ def ring_attention_diff(
         in_specs += [P(axis_name), P()]
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=tuple(in_specs),
@@ -638,7 +638,7 @@ def _zigzag_ring(q, k, v, *, mesh, axis_name, scale, block_sizes, softcap,
         in_specs += [P(), P()]
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=tuple(in_specs),
@@ -991,7 +991,7 @@ def _zigzag_ring_diff(q, k, v, *, mesh, axis_name, batch_axis, head_axis,
         in_specs += [P(), P()]
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=tuple(in_specs),

@@ -40,7 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from attention_tpu.ops.decode import flash_decode
 from attention_tpu.ops.flash import BlockSizes, flash_attention_partials
 from attention_tpu.parallel.kv_sharded import merge_partials
-from attention_tpu.parallel.mesh import default_mesh
+from attention_tpu.parallel.mesh import default_mesh, shard_map
 
 
 def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
@@ -60,7 +60,7 @@ def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
     q_spec = P(None, axis_name, *([None] * (q.ndim - 2)))
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=(q_spec, *operand_specs),
@@ -300,7 +300,7 @@ def cache_sharded_decode(
     c_spec = P(None, axis_name, None)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=(P(), c_spec, c_spec, P()),

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from attention_tpu.ops.flash import BlockSizes
 from attention_tpu.ops.flash_vjp import flash_attention_diff
-from attention_tpu.parallel.mesh import default_mesh
+from attention_tpu.parallel.mesh import default_mesh, shard_map
 
 
 @functools.partial(
@@ -122,7 +122,7 @@ def ulysses_attention(
         seq_spec = P(None, axis_name, None)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=(seq_spec, seq_spec, seq_spec),

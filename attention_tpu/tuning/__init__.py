@@ -1,0 +1,44 @@
+"""Measured kernel autotuning with a persistent per-device cache.
+
+The reference project's performance story is an empirical sweep: it
+measured batch sizes, placement, and tile shapes on its target cluster
+and baked the winners in (README benchmark tables, report Q1-Q8).  This
+package turns that one-time sweep into a subsystem:
+
+- ``space``  — the tunable-parameter space per kernel family (flash
+  forward, flash backward two-kernel + fused, decode, paged).
+- ``cache``  — the persistent JSON result table: a user cache under
+  ``~/.cache/attention_tpu/`` plus an in-repo shipped table seeded from
+  the measured heuristics, both keyed by (device kind, kernel, shape
+  bucket, dtype, flags).
+- ``lookup`` — the read path the kernels consult: user cache first,
+  shipped table second, ``None`` third (the caller's heuristic remains
+  the final fallback, so CPU/interpret runs with no cache are
+  byte-for-byte unaffected).
+- ``search`` — the timed on-device search (compile-failure tolerant:
+  VMEM-overflow candidates are skipped, not fatal), run by
+  ``python -m attention_tpu.cli tune`` and ``bench.py --autotune``.
+
+Kernel integration stays thin: `BlockSizes.for_shape`
+(`ops/flash.py`), `default_bwd_block_sizes` /
+`default_fused_bwd_block_sizes` (`ops/flash_bwd.py`), the decode
+``block_k`` default (`ops/decode.py`), and
+`recommended_page_size` (`ops/paged.py`) each try `lookup` and fall
+back to their existing measured heuristics.
+"""
+
+from attention_tpu.tuning.cache import (  # noqa: F401
+    TuningTable,
+    bucket_pow2,
+    default_cache_path,
+    device_key,
+    make_key,
+    parse_key,
+    shipped_table_path,
+)
+
+# NOTE: the lookup FUNCTION deliberately stays under
+# attention_tpu.tuning.lookup.lookup — re-exporting it here would
+# shadow the submodule attribute of the same name (a classic
+# package-namespace collision that breaks `import
+# attention_tpu.tuning.lookup as m` and monkeypatching).

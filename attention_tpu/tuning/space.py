@@ -1,0 +1,83 @@
+"""Tunable-parameter spaces per kernel family.
+
+Candidate lists cover every tile regime the measured history has ever
+picked (RESULTS.md rounds 1-5: 256x1024 seed default, 512x512 windowed,
+1024x1024 stats-capped, 2048x1024/2048 causal, 4096x2048 VMEM-unlocked)
+plus one step past each boundary so a new device generation can move
+the optimum without a code change.  Candidates that cannot compile on a
+given chip (VMEM overflow) are skipped by the search's failure
+tolerance, so the lists may safely overshoot.
+"""
+
+from __future__ import annotations
+
+# (block_q, block_k) for the flash forward kernel.
+FLASH_FWD_TILES = (
+    (256, 512), (256, 1024),
+    (512, 512), (512, 1024), (512, 2048),
+    (1024, 1024), (1024, 2048),
+    (2048, 1024), (2048, 2048),
+    (4096, 1024), (4096, 2048), (4096, 4096),
+)
+
+# (block_q, block_k) for the two-kernel backward (dQ + dK/dV).
+FLASH_BWD_TILES = (
+    (256, 256),
+    (512, 512), (512, 1024),
+    (1024, 512), (1024, 1024), (1024, 2048),
+    (2048, 1024),
+)
+
+# (block_q, block_k) for the fused single-pass backward (resident dQ
+# makes its VMEM budget tighter -> wide-k candidates).
+FLASH_BWD_FUSED_TILES = (
+    (256, 256),
+    (512, 512), (512, 1024), (512, 2048), (512, 4096),
+    (1024, 1024), (1024, 2048), (1024, 4096),
+)
+
+# KV block row counts for the dense decode kernel.
+DECODE_BLOCK_K = (256, 512, 1024, 2048, 4096, 8192)
+
+# Physical page sizes for the paged decode kernel.
+PAGED_PAGE_SIZES = (128, 256, 512, 1024, 2048, 4096)
+
+
+def _ceil_to(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def candidates(kernel: str, *, m: int, n: int, d: int,
+               window: int | None = None) -> list:
+    """Shape-legal candidates for one kernel family.
+
+    Tiles are clipped to the padded problem (a 4096-row block on a 2k
+    sequence is the 2k block in disguise) and de-duplicated; decode and
+    paged blocks must divide the cache capacity (the kernels' own
+    `_pick_block_k`-style constraint).
+    """
+    if kernel == "flash_fwd":
+        tiles = FLASH_FWD_TILES
+    elif kernel == "flash_bwd":
+        tiles = FLASH_BWD_TILES
+    elif kernel == "flash_bwd_fused":
+        tiles = FLASH_BWD_FUSED_TILES
+    elif kernel == "decode":
+        return [bk for bk in dict.fromkeys(
+            min(bk, _ceil_to(n, 128)) for bk in DECODE_BLOCK_K)
+            if n % bk == 0]
+    elif kernel == "paged":
+        return [p for p in PAGED_PAGE_SIZES if n % p == 0]
+    else:
+        raise ValueError(f"unknown kernel family {kernel!r}")
+    m_pad = _ceil_to(m, 128)
+    n_pad = _ceil_to(n, 128)
+    out = []
+    for bq, bk in tiles:
+        cand = (min(bq, m_pad), min(bk, n_pad))
+        if window is not None and cand[1] > _ceil_to(window, 128) * 4:
+            # a KV block much wider than the band is all masked columns
+            continue
+        if cand not in out:
+            out.append(cand)
+    return out

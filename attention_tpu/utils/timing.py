@@ -246,6 +246,28 @@ def benchmark_traced(
     return statistics.median(samples)
 
 
+def benchmark_candidate(
+    fn: Callable,
+    x,
+    *,
+    operands: tuple = (),
+    repeats: int = 3,
+) -> float:
+    """Per-iteration seconds for one AUTOTUNE candidate.
+
+    The tuner's clock (`attention_tpu.tuning.search`): same honest
+    chained-scan measurement as :func:`benchmark_auto` (device-trace
+    preferred, wall-clock slope fallback — median-of-``repeats`` either
+    way), with deliberately short chains (2/8 vs the bench default
+    4/20): a sweep compiles and times a dozen candidates per shape, so
+    per-candidate wall time matters more than squeezing the last few
+    percent of clock variance — rank order between tiles is far coarser
+    than the short-chain noise floor.
+    """
+    return benchmark_auto(fn, x, operands=operands, repeats=repeats,
+                          n_short=2, n_long=8)
+
+
 def benchmark_auto(
     fn: Callable,
     x,

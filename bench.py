@@ -100,7 +100,8 @@ def _headline_contract(seq: int, dim: int, *, seed: int = 7,
     from attention_tpu.ops.flash import BlockSizes, flash_attention
 
     if block_sizes is None:
-        block_sizes = BlockSizes.for_shape(1, seq, dim, None)
+        block_sizes = BlockSizes.for_shape(1, seq, dim, None,
+                                           dtype="bfloat16")
     t0 = time.time()
     case = generate_testcase(seq, seq, dim, dim, seed=seed)
     oracle_s = time.time() - t0
@@ -174,7 +175,8 @@ def _bench_flash_s(seq: int, dim: int, repeats: int, block_q: int | None,
     # a partial override fills the other field from that EFFECTIVE tile,
     # so the run and any FLOPs estimate derived from effective_block_sizes
     # agree in every flag combination.
-    eff = BlockSizes.for_shape(heads or 1, seq, dim, window)
+    eff = BlockSizes.for_shape(heads or 1, seq, dim, window,
+                               dtype="bfloat16")
     if block_q is None and block_k is None:
         bs = None  # let the library resolve (same as eff)
     else:
@@ -238,7 +240,25 @@ def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
         # token-paired packing — the measured-faster int4 layout
         # (0.402 ms vs 0.748 feature-dim vs 0.445 int8 at this shape;
         # scripts/int4_pack_exp.py, RESULTS.md round 5); identical
-        # quantization math and bytes, so the accounting is unchanged
+        # quantization math and bytes, so the accounting is unchanged.
+        # Capacities ≡ 128 (mod 256) have no valid token-paired block
+        # (quantize_kv_int4_tok rejects them at build time) — those
+        # fall back to the feature-dim layout instead of crashing the
+        # bench (ADVICE.md round 5).
+        if cache_len % 256:
+            from attention_tpu.ops.quant import (
+                flash_decode_int4,
+                quantize_kv_int4,
+            )
+
+            print(f"int4 bench: cache_len {cache_len} is not a "
+                  "256-multiple; using the feature-dim layout",
+                  file=sys.stderr)
+            c4f = quantize_kv_int4(kc, vc)
+            step4f = lambda x, c, ll: (  # noqa: E731
+                flash_decode_int4(x, c, ll).astype(x.dtype))
+            return benchmark_auto(step4f, q, repeats=repeats,
+                                  operands=(c4f, lens))
         from attention_tpu.ops.quant import (
             flash_decode_int4_tok,
             quantize_kv_int4_tok,
@@ -267,15 +287,22 @@ def _bench_decode_s(batch: int, heads: int, kv_heads: int, cache_len: int,
 
 def _bench_paged_decode_s(batch: int, heads: int, kv_heads: int,
                           cache_len: int, dim: int, repeats: int,
-                          *, page_size: int = 2048):
+                          *, page_size: int | None = None):
     """Per-step seconds of paged flash-decode (block-table translation)
-    at a full KV cache, physical pages scrambled."""
+    at a full KV cache, physical pages scrambled.  ``page_size`` None
+    resolves through `recommended_page_size` (tuning tables, falling
+    back to the measured 2048 streaming block)."""
     import jax
     import jax.numpy as jnp
 
     from attention_tpu.ops.paged import PagePool, paged_from_dense, \
-        paged_flash_decode
+        paged_flash_decode, recommended_page_size
     from attention_tpu.utils.timing import benchmark_auto
+
+    if page_size is None:
+        page_size = recommended_page_size(
+            cache_len, batch=batch, heads=heads, kv_heads=kv_heads,
+            d=dim, dtype=jnp.bfloat16)
 
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(kq, (batch, heads, dim), jnp.bfloat16)
@@ -519,6 +546,13 @@ def main(argv=None) -> int:
     )
     p.add_argument("--all", action="store_true", help="full config ladder")
     p.add_argument(
+        "--autotune", action="store_true",
+        help="run the timed tile search at the headline shape first "
+        "(attention_tpu.tuning), persist the winner in the per-device "
+        "cache, and time the headline with it; explicit --block-q/"
+        "--block-k still win",
+    )
+    p.add_argument(
         "--no-contract", action="store_true",
         help="skip the full-size .bin ±0.02 contract verification "
         "(~30 s of fp64 oracle at seq=32k; the reference verifies "
@@ -530,6 +564,28 @@ def main(argv=None) -> int:
 
     flops = attention_flops(args.seq, args.seq, args.dim, args.dim)
 
+    # Fresh measured optima on request: the tile search runs BEFORE the
+    # headline (recording winners in the per-device cache, where the
+    # next plain run's BlockSizes.for_shape finds them), and this run's
+    # headline times the freshly measured best.  Explicit tile flags
+    # keep priority — an operator pinning a tile is pinning it.
+    autotune_rec = None
+    if args.autotune and args.block_q is None and args.block_k is None:
+        from attention_tpu.tuning.search import tune
+
+        try:
+            autotune_rec = tune(
+                "flash_fwd", seq=args.seq, dim=args.dim,
+                max_mode=args.max_mode, repeats=args.repeats,
+                log=lambda s: print(s, file=sys.stderr),
+            )
+            args.block_q = autotune_rec["entry"]["block_q"]
+            args.block_k = autotune_rec["entry"]["block_k"]
+        except Exception as e:  # noqa: BLE001 - fall back to defaults
+            print(f"autotune failed (using defaults): {str(e)[:200]}",
+                  file=sys.stderr)
+            autotune_rec = {"error": str(e)[:200]}
+
     # The EXACT tile configuration the headline times (explicit flags,
     # else the library's per-shape default) — the correctness spot-check
     # AND the full-size contract below must verify this configuration,
@@ -537,7 +593,8 @@ def main(argv=None) -> int:
     # times, attention.c:181-184).
     from attention_tpu.ops.flash import BlockSizes
 
-    _eff_bs = BlockSizes.for_shape(1, args.seq, args.dim, None)
+    _eff_bs = BlockSizes.for_shape(1, args.seq, args.dim, None,
+                                   dtype="bfloat16")
     used_bs = BlockSizes(args.block_q or _eff_bs.block_q,
                          args.block_k or _eff_bs.block_k)
 
@@ -662,6 +719,8 @@ def main(argv=None) -> int:
             "reference_best_speedup": 7.49,
         },
     }
+    if autotune_rec is not None:
+        result["detail"]["autotune"] = autotune_rec
     if contract is not None:
         result["detail"]["headline_contract"] = contract
         if not contract.get("verified"):
@@ -719,8 +778,8 @@ def main(argv=None) -> int:
         # (explicit flag wins; else for_shape's windowed default)
         from attention_tpu.ops.flash import BlockSizes
 
-        w_bq = args.block_q or BlockSizes.for_shape(1, 32768, 128,
-                                                    window=1024).block_q
+        w_bq = args.block_q or BlockSizes.for_shape(
+            1, 32768, 128, window=1024, dtype="bfloat16").block_q
         w_fl = 2 * 32768 * (1024 + w_bq) * (128 + 128)
         w_s, w_ok = _measure_plausible(
             lambda: _bench_flash_s(32768, 128, args.repeats, args.block_q,
@@ -753,7 +812,8 @@ def main(argv=None) -> int:
         if args.block_q is None and args.block_k is None:
             bwd_bs = None
         else:
-            _eff = BlockSizes.for_shape(1, args.seq, args.dim, None)
+            _eff = BlockSizes.for_shape(1, args.seq, args.dim, None,
+                                        dtype="bfloat16")
             bwd_bs = BlockSizes(args.block_q or _eff.block_q,
                                 args.block_k or _eff.block_k)
         bwd_fused = fused_backward_applicable(

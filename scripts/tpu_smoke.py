@@ -34,6 +34,9 @@ from attention_tpu.ops.quant import flash_decode_quantized, quantize_kv
 # online kernel and test nothing new — pin it off for the whole sweep.
 import attention_tpu.ops.flash as _flash_mod
 
+# the production threshold, saved BEFORE the sweep-wide pin so the
+# dispatch-path case below can run with it intact
+_PROD_BOUND_MIN_SCORE_ELEMS = _flash_mod._BOUND_MIN_SCORE_ELEMS
 _flash_mod._BOUND_MIN_SCORE_ELEMS = 0
 
 RNG = np.random.default_rng(7)
@@ -534,6 +537,28 @@ def _():
     want = flash_decode_int4(q, quantize_kv_int4(kc, vc), lens,
                              block_k=256, window=128, sinks=4)
     return got, want, 1e-2
+
+
+@case("fwd/bound-max production dispatch (small shape -> online)")
+def _():
+    # Every other bound case pins _BOUND_MIN_SCORE_ELEMS = 0 so the
+    # BOUND kernel itself is what lowers; this case restores the
+    # PRODUCTION threshold so the small-shape bound->online static
+    # resolution (`_flash_call`) — the path production max_mode="bound"
+    # callers actually take below 24M score elements — is exercised on
+    # real Mosaic too, not only in the CPU unit tests (ADVICE.md r5).
+    # Distinct shape + cleared caches keep the pinned-off traces of the
+    # other cases from being reused here.
+    _flash_mod._BOUND_MIN_SCORE_ELEMS = _PROD_BOUND_MIN_SCORE_ELEMS
+    jax.clear_caches()
+    try:
+        q, k, v = _arr(3, 448, 64), _arr(3, 448, 64), _arr(3, 448, 64)
+        got = flash_attention(q, k, v, causal=True, max_mode="bound")
+        want = _dense(q, k, v, causal=True)
+    finally:
+        _flash_mod._BOUND_MIN_SCORE_ELEMS = 0
+        jax.clear_caches()
+    return got, want
 
 
 @case("fwd/bound guard demotes adversarial norms on-chip")

@@ -25,6 +25,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Hermetic tile resolution: a developer's real ~/.cache tuning entries
+# must not leak into unit-test kernel dispatch (the golden tests pin
+# the heuristic tiles byte-for-byte).  Tests that exercise cache pickup
+# monkeypatch ATTN_TPU_TUNING_CACHE to their own tmp file.
+if "ATTN_TPU_TUNING_CACHE" not in os.environ:
+    import tempfile as _tempfile
+
+    os.environ["ATTN_TPU_TUNING_CACHE"] = os.path.join(
+        _tempfile.mkdtemp(prefix="attn_tpu_test_tuning_"), "cache.json"
+    )
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
@@ -84,6 +95,11 @@ SMOKE_TESTS = {
     "test_cross_attention": ["test_cross_attention_matches_manual_oracle"],
     "test_checkpoint": ["test_checkpoint_roundtrip_resumes_training"],
     "test_benchmarks": ["test_blocksizes_for_shape_rules"],
+    "test_tuning": [
+        "test_golden_empty_cache_matches_heuristics_all_entry_points",
+        "test_cache_entry_overrides_for_shape_and_decode",
+        "test_shipped_table_passes_lint",
+    ],
     # test_graft_entry is NOT in the smoke tier: the driver
     # compile-checks the entry separately every round anyway
 }

@@ -35,7 +35,7 @@ def main() -> int:
     import numpy as np
 
     from attention_tpu.parallel.kv_sharded import merge_partials
-    from attention_tpu.parallel.mesh import hybrid_mesh
+    from attention_tpu.parallel.mesh import hybrid_mesh, shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = hybrid_mesh(inner_axis="kv", outer_axis="dp")
@@ -60,7 +60,7 @@ def main() -> int:
     )
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         check_vma=False,
         in_specs=(P("dp", "kv"), P("dp", "kv"), P("dp", "kv")),

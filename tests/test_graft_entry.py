@@ -7,9 +7,12 @@ the repo's default environment, jax possibly pre-initialized on another
 platform — so a regression shows up here, not in the round record.
 """
 
+import functools
 import os
 import subprocess
 import sys
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -31,9 +34,28 @@ def test_dryrun_multichip_8_from_fresh_process():
     assert "OK" in r.stdout
 
 
+@functools.lru_cache(maxsize=1)
+def _default_backend_initializes() -> bool:
+    """Whether bare ``import jax; jax.devices()`` completes promptly in
+    the driver's (unforced) environment.  With libtpu installed but no
+    reachable TPU behind it, PJRT initialization blocks for minutes —
+    the preinitialized-jax scenario cannot even establish its
+    precondition there, and one hung subprocess would eat the whole
+    tier-1 time budget."""
+    try:
+        r = _run("import jax; jax.devices(); print('INIT_OK')",
+                 timeout=90)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "INIT_OK" in r.stdout
+
+
 def test_dryrun_multichip_survives_preinitialized_jax():
     """The driver may have imported jax (and initialized its default
     platform) before calling; the platform forcing must still work."""
+    if not _default_backend_initializes():
+        pytest.skip("default jax backend does not initialize in this "
+                    "environment (hung/absent accelerator runtime)")
     r = _run(
         "import jax; jax.devices(); "
         "import __graft_entry__ as g; g.dryrun_multichip(4); print('OK')"

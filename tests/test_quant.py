@@ -386,7 +386,7 @@ def test_int4_tok_roundtrip_layout(rng):
 
 def test_int4_tok_matches_feature_layout(rng):
     """The two int4 layouts share quantization math EXACTLY, so their
-    decode outputs must agree (bitwise in interpret mode) across plain,
+    decode outputs must agree to fp32 roundoff across plain,
     windowed+sinks, softcap, ragged, and empty-length calls — the
     layout change is invisible to numerics (scripts/int4_pack_exp.py
     measures the latency side: 0.402 ms token-paired vs 0.748
@@ -412,7 +412,12 @@ def test_int4_tok_matches_feature_layout(rng):
         want = np.asarray(flash_decode_int4(q, cf, lens, block_k=256, **kw))
         got = np.asarray(flash_decode_int4_tok(q, ct, lens, block_k=256,
                                                **kw))
-        np.testing.assert_array_equal(got, want)
+        # NOT bitwise: the layouts contract lanes in different orders
+        # (natural vs [even|odd] token order), and identical fp sums
+        # across reduction orders are an XLA implementation detail that
+        # can change with backend/version (ADVICE.md round 5); the
+        # shared quantization math pins them to fp32 roundoff.
+        np.testing.assert_allclose(got, want, atol=1e-6)
     zero = np.asarray(flash_decode_int4_tok(
         q, ct, jnp.zeros((b,), jnp.int32), block_k=256))
     assert np.all(zero == 0)
