@@ -17,6 +17,10 @@ Usage:
   python -m attention_tpu.cli tune --kernel flash --seq 32768 --dim 128
       # timed on-device tile search; winners persist in the per-device
       # cache (~/.cache/attention_tpu/) and future calls pick them up
+  python -m attention_tpu.cli serve-sim [--trace trace.json]
+      [--num-requests 8 --shared-prefix-len 129 --shared-count 4 ...]
+      # continuous-batching engine over a request trace; prints
+      # per-step (--per-step) and summary metrics JSON
 """
 
 from __future__ import annotations
@@ -116,6 +120,127 @@ def _cmd_backends(_args: argparse.Namespace) -> int:
     return 0
 
 
+def _build_sim_model(args: argparse.Namespace):
+    """Deterministic tiny decoder for serving simulation: params come
+    from PRNGKey(--model-seed), so a trace replays bit-identically."""
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.models import TinyDecoder
+
+    dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16}[args.dtype]
+    model = TinyDecoder(
+        vocab=args.vocab, dim=args.dim, depth=args.depth,
+        num_q_heads=args.q_heads, num_kv_heads=args.kv_heads,
+        impl="flash", dtype=dtype,
+    )
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(args.model_seed), probe)["params"]
+    return model, params
+
+
+def _cmd_serve_sim(args: argparse.Namespace) -> int:
+    """Run the continuous-batching engine on a request trace (from
+    --trace JSON, else synthetic) and print metrics JSON."""
+    import json
+
+    from attention_tpu.engine import (
+        EngineConfig,
+        ServingEngine,
+        load_trace,
+        replay,
+        synthetic_trace,
+    )
+
+    model, params = _build_sim_model(args)
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        trace = synthetic_trace(
+            args.num_requests, vocab=args.vocab, seed=args.seed,
+            prompt_len_min=args.prompt_len_min,
+            prompt_len_max=args.prompt_len_max,
+            max_tokens=args.max_tokens, arrival_every=args.arrival_every,
+            shared_prefix_len=args.shared_prefix_len,
+            shared_count=args.shared_count,
+            temperature=args.temperature,
+        )
+    if args.trace_out:
+        from attention_tpu.engine import save_trace
+
+        save_trace(args.trace_out, trace)
+        print(f"wrote trace: {args.trace_out}", file=sys.stderr)
+
+    config = EngineConfig(
+        num_pages=args.num_pages, page_size=args.page_size,
+        max_seq_len=args.max_seq_len,
+        max_decode_batch=args.max_decode_batch,
+        max_prefill_rows=args.max_prefill_rows,
+        prefill_chunk=args.prefill_chunk,
+        token_budget=args.token_budget,
+        watermark_pages=args.watermark_pages,
+    )
+    engine = ServingEngine(model, params, config)
+    summary, outputs = replay(engine, trace, max_steps=args.max_steps)
+    if args.per_step:
+        for m in engine.metrics.steps:
+            print(m.to_json())
+    record = engine.metrics.to_run_record(
+        config="engine-serve-sim",
+        extra={"num_pages": config.num_pages,
+               "page_size": config.page_size,
+               "prefill_chunk": config.prefill_chunk,
+               "max_decode_batch": config.max_decode_batch,
+               "token_budget": config.token_budget},
+    )
+    out = {"summary": summary, "run_record": json.loads(record.to_json())}
+    if args.outputs:
+        out["outputs"] = outputs
+    print(json.dumps(out))
+    return 0
+
+
+def _add_serve_sim_args(ss) -> None:
+    """serve-sim's flag set, shared with scripts/engine_trace.py."""
+    ss.add_argument("--trace", default=None,
+                    help="JSON request trace to replay (default: "
+                         "synthesize one from the --num-requests knobs)")
+    ss.add_argument("--trace-out", default=None,
+                    help="write the (possibly synthetic) trace here")
+    ss.add_argument("--per-step", action="store_true",
+                    help="emit one JSON line per engine step")
+    ss.add_argument("--outputs", action="store_true",
+                    help="include generated token ids in the summary")
+    ss.add_argument("--max-steps", type=int, default=10000)
+    # synthetic-trace knobs
+    ss.add_argument("--num-requests", type=int, default=8)
+    ss.add_argument("--seed", type=int, default=0)
+    ss.add_argument("--prompt-len-min", type=int, default=4)
+    ss.add_argument("--prompt-len-max", type=int, default=24)
+    ss.add_argument("--max-tokens", type=int, default=8)
+    ss.add_argument("--arrival-every", type=int, default=1)
+    ss.add_argument("--shared-prefix-len", type=int, default=0)
+    ss.add_argument("--shared-count", type=int, default=0)
+    ss.add_argument("--temperature", type=float, default=0.0)
+    # model knobs (deterministic from --model-seed)
+    ss.add_argument("--vocab", type=int, default=64)
+    ss.add_argument("--dim", type=int, default=64)
+    ss.add_argument("--depth", type=int, default=2)
+    ss.add_argument("--q-heads", type=int, default=4)
+    ss.add_argument("--kv-heads", type=int, default=2)
+    ss.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
+    ss.add_argument("--model-seed", type=int, default=0)
+    # engine knobs
+    ss.add_argument("--num-pages", type=int, default=64)
+    ss.add_argument("--page-size", type=int, default=128)
+    ss.add_argument("--max-seq-len", type=int, default=512)
+    ss.add_argument("--max-decode-batch", type=int, default=8)
+    ss.add_argument("--max-prefill-rows", type=int, default=2)
+    ss.add_argument("--prefill-chunk", type=int, default=32)
+    ss.add_argument("--token-budget", type=int, default=128)
+    ss.add_argument("--watermark-pages", type=int, default=1)
+
+
 def _cmd_tune(args: argparse.Namespace) -> int:
     import json
 
@@ -177,6 +302,14 @@ def main(argv: list[str] | None = None) -> int:
 
     be = sub.add_parser("backends", help="list available backends")
     be.set_defaults(fn=_cmd_backends)
+
+    ss = sub.add_parser(
+        "serve-sim",
+        help="continuous-batching engine on a synthetic or JSON request "
+             "trace (attention_tpu.engine); prints metrics JSON",
+    )
+    _add_serve_sim_args(ss)
+    ss.set_defaults(fn=_cmd_serve_sim)
 
     tn = sub.add_parser(
         "tune",

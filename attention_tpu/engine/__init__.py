@@ -1,0 +1,50 @@
+"""Continuous-batching serving engine over the paged KV kernels.
+
+The bridge from "fast kernel" to "high-throughput server": many
+concurrent requests in, batched `paged_append(_chunk)` +
+`paged_flash_decode` steps out.
+
+    requests ──> Scheduler ────────> ServingEngine.step()
+                   │  FCFS admission,     │  fixed-shape decode +
+                   │  chunked prefill ⊕   │  chunked-prefill calls
+                   │  decode batching,    ▼
+                   │  preemption      paged kernels (ops.paged)
+                   ▼                      │
+               BlockAllocator <───────────┘
+                   watermark-guarded pages + hash-keyed
+                   prefix cache (incref'd shared pages, LRU eviction)
+
+Modules: `request` (lifecycle + sampling params), `allocator` (pages +
+prefix cache), `scheduler` (iteration-level batch composition),
+`engine` (the step loop), `metrics` (TTFT/TPOT/page-utilization
+records), `sim` (JSON traces + replay — `cli serve-sim`'s core).
+"""
+
+from attention_tpu.engine.allocator import (  # noqa: F401
+    BlockAllocator,
+    pages_for_tokens,
+)
+from attention_tpu.engine.engine import (  # noqa: F401
+    EngineConfig,
+    ServingEngine,
+)
+from attention_tpu.engine.metrics import (  # noqa: F401
+    EngineMetrics,
+    RequestMetrics,
+    StepMetrics,
+)
+from attention_tpu.engine.request import (  # noqa: F401
+    Request,
+    RequestState,
+    SamplingParams,
+)
+from attention_tpu.engine.scheduler import (  # noqa: F401
+    ScheduledStep,
+    Scheduler,
+)
+from attention_tpu.engine.sim import (  # noqa: F401
+    load_trace,
+    replay,
+    save_trace,
+    synthetic_trace,
+)

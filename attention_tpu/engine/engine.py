@@ -1,0 +1,385 @@
+"""The continuous-batching serving engine: step loop over paged kernels.
+
+`ServingEngine` turns many concurrent requests into batched kernel
+steps.  Memory is ONE page-id space across all model layers (per-layer
+physical pools share the geometry, so a single `PagePool`/
+`BlockAllocator` and one table row per request drive the whole stack);
+compute is the model's own paged cache paths — `paged_append` +
+`paged_flash_decode` for decode rows, `paged_append_chunk` + the
+chunk-mode kernel for prefill slices — exactly the kernels
+`generate_paged` steps, which is what makes the engine's output
+token-for-token comparable to per-request sequential generation.
+
+Shape discipline (the TPU way): every step lowers onto at most TWO
+jitted calls with FIXED shapes — a ``(max_decode_batch, 1)`` decode
+call and a ``(max_prefill_rows, prefill_chunk)`` prefill call — so the
+whole serving life of an engine compiles exactly two executables.
+Unused rows are padded with an inactive sentinel (empty table, length
+-1) that the paged kernels already define semantics for: appends drop,
+outputs are masked, nothing is read or written.  Partial final chunks
+pad with token 0; pad rows sit causally AFTER every real row and their
+garbage KV lands beyond the request's tracked length, where the next
+real append overwrites it and no masked read ever sees it.
+
+Tokens stream out through callbacks (``on_token``/``on_finish``) the
+moment they are sampled — iteration-level, not request-level, latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attention_tpu.engine.allocator import BlockAllocator
+from attention_tpu.engine.metrics import (
+    EngineMetrics,
+    RequestMetrics,
+    StepMetrics,
+)
+from attention_tpu.engine.request import Request, RequestState, SamplingParams
+from attention_tpu.engine.scheduler import ScheduledStep, Scheduler
+from attention_tpu.ops.paged import OutOfPagesError, PagedKV, PagePool
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _paged_apply(model, params, tokens, caches):
+    """One batched model step over paged caches.  Module-level with a
+    static ``model`` (flax Modules hash by config, the `generate_paged`
+    discipline) so every engine instance serving the same model at the
+    same batch shapes shares ONE compiled executable per shape — two
+    total: ``(max_decode_batch, 1)`` and ``(max_prefill_rows,
+    prefill_chunk)``."""
+    return model.apply({"params": params}, tokens, caches)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine knobs.  Defaults are sized for tiny CPU tests;
+    production configs scale ``num_pages``/batch widths up."""
+
+    num_pages: int = 64
+    page_size: int = 128           # paged-kernel granule: 128-multiple
+    max_seq_len: int = 1024        # per-request prompt + generated cap
+    max_decode_batch: int = 8      # decode rows per step (fixed shape)
+    max_prefill_rows: int = 2      # prefill rows per step (fixed shape)
+    prefill_chunk: int = 64        # tokens per prefill slice (padded to)
+    token_budget: int = 128        # real tokens scheduled per step
+    watermark_pages: int = 1       # admission must leave this reserve
+    cache_dtype: Any = None        # None -> model dtype
+
+    def validate(self) -> None:
+        if self.page_size % 128:
+            raise ValueError(
+                f"page_size {self.page_size} must be a 128-multiple "
+                "(paged kernel granule)"
+            )
+        if min(self.num_pages, self.max_seq_len, self.max_decode_batch,
+               self.max_prefill_rows, self.prefill_chunk,
+               self.token_budget) < 1:
+            raise ValueError("engine config fields must all be >= 1")
+        if not (0 <= self.watermark_pages < self.num_pages):
+            raise ValueError(
+                f"watermark_pages {self.watermark_pages} outside "
+                f"[0, num_pages={self.num_pages})"
+            )
+
+    @property
+    def table_width(self) -> int:
+        """Page-table row width: covers max_seq_len PLUS one padded
+        prefill chunk, so pad rows of a final partial chunk always land
+        on claimable pages instead of NaN-poisoning the row."""
+        return -(-(self.max_seq_len + self.prefill_chunk)
+                 // self.page_size)
+
+
+class ServingEngine:
+    """Deterministic continuous-batching engine over a TinyDecoder-
+    family model (any ``impl='flash'`` model whose ``apply`` threads
+    per-layer caches, the `generate_paged` contract)."""
+
+    def __init__(self, model, params, config: EngineConfig, *,
+                 on_token: Callable[[Request, int], None] | None = None,
+                 on_finish: Callable[[Request], None] | None = None):
+        config.validate()
+        if model.impl != "flash":
+            raise ValueError(
+                f"ServingEngine requires impl='flash' (got {model.impl!r})"
+            )
+        self.model = model
+        self.params = params
+        self.config = config
+        self.on_token = on_token
+        self.on_finish = on_finish
+
+        head_dim = model.dim // model.num_q_heads
+        dtype = config.cache_dtype or model.dtype
+        pool_shape = (config.num_pages, model.num_kv_heads,
+                      config.page_size, head_dim)
+        self._k_pools = [jnp.zeros(pool_shape, dtype)
+                         for _ in range(model.depth)]
+        self._v_pools = [jnp.zeros(pool_shape, dtype)
+                         for _ in range(model.depth)]
+
+        self.pool = PagePool(config.num_pages)
+        self.allocator = BlockAllocator(
+            self.pool, config.page_size,
+            watermark_pages=config.watermark_pages,
+        )
+        self.scheduler = Scheduler(
+            self.allocator,
+            max_decode_batch=config.max_decode_batch,
+            max_prefill_rows=config.max_prefill_rows,
+            prefill_chunk=config.prefill_chunk,
+            token_budget=config.token_budget,
+        )
+        self.metrics = EngineMetrics()
+        self._step = 0
+        self._seq = itertools.count()
+        self._finished_in_step = 0
+        self._rng_keys: dict[str, jax.Array] = {}
+        self._wall: dict[str, dict[str, float]] = {}
+
+    # -- request intake ---------------------------------------------------
+
+    @property
+    def current_step(self) -> int:
+        return self._step
+
+    def add_request(self, prompt, sampling: SamplingParams | None = None,
+                    *, request_id: str | None = None,
+                    arrival: int | None = None) -> Request:
+        """Enqueue one request.  ``arrival`` (engine step) defaults to
+        now; future arrivals let traces replay deterministically."""
+        sampling = sampling or SamplingParams()
+        sampling.validate(self.model.vocab)
+        prompt = tuple(int(t) for t in prompt)
+        if any(not (0 <= t < self.model.vocab) for t in prompt):
+            raise ValueError(
+                f"prompt tokens must be in the vocab [0, "
+                f"{self.model.vocab})"
+            )
+        total = len(prompt) + sampling.max_tokens - 1
+        if total > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens "
+                f"({sampling.max_tokens}) - 1 = {total} exceeds "
+                f"max_seq_len {self.config.max_seq_len}"
+            )
+        seq = next(self._seq)
+        req = Request(
+            request_id=request_id or f"req-{seq}",
+            prompt=prompt,
+            sampling=sampling,
+            arrival=self._step if arrival is None else arrival,
+            seq=seq,
+        )
+        self._wall[req.request_id] = {"added": time.perf_counter()}
+        self.scheduler.add(req)
+        return req
+
+    # -- step loop --------------------------------------------------------
+
+    def step(self) -> StepMetrics:
+        """Run one scheduler iteration: compose a batch, lower it onto
+        the paged kernels, stream out sampled tokens."""
+        t0 = time.perf_counter()
+        self._finished_in_step = 0
+        sched = self.scheduler.schedule(self._step)
+        if sched.decode:
+            self._run_decode(sched.decode)
+        if sched.prefill:
+            self._run_prefill(sched.prefill)
+        m = StepMetrics(
+            step=self._step,
+            wall_s=time.perf_counter() - t0,
+            num_decode_reqs=len(sched.decode),
+            num_prefill_reqs=len(sched.prefill),
+            decode_tokens=sched.num_decode_tokens,
+            prefill_tokens=sched.num_prefill_tokens,
+            queue_depth=len(self.scheduler.waiting),
+            running=len(self.scheduler.running),
+            admitted=len(sched.admitted),
+            preempted=len(sched.preempted),
+            finished=self._finished_in_step,
+            free_pages=self.pool.free_pages,
+            used_pages=self.pool.used_pages,
+            page_utilization=self.pool.used_pages / self.pool.num_pages,
+            prefix_hit_tokens_total=self.allocator.prefix_hit_tokens,
+            preemptions_total=self.scheduler.num_preemptions,
+        )
+        self.metrics.record_step(m)
+        self._step += 1
+        return m
+
+    def run(self, *, max_steps: int | None = None) -> dict[str, Any]:
+        """Step until every request finishes; returns the metrics
+        summary.  Detects a permanently unschedulable queue (a request
+        that can never fit the pool) and raises instead of spinning."""
+        stalls = 0
+        while self.scheduler.has_work():
+            if max_steps is not None and self._step >= max_steps:
+                raise RuntimeError(
+                    f"engine exceeded max_steps={max_steps} with "
+                    f"{len(self.scheduler.waiting)} waiting / "
+                    f"{len(self.scheduler.running)} running"
+                )
+            m = self.step()
+            due = (self.scheduler.waiting
+                   and self.scheduler.waiting[0].arrival < self._step)
+            idle = (m.decode_tokens == 0 and m.prefill_tokens == 0
+                    and not self.scheduler.running)
+            stalls = stalls + 1 if (idle and due) else 0
+            if stalls > 2:
+                head = self.scheduler.waiting[0]
+                raise OutOfPagesError(
+                    f"request {head.request_id} cannot be admitted "
+                    "(needs more pages than the pool can ever free)"
+                )
+        return self.metrics.summary()
+
+    # -- batch lowering ---------------------------------------------------
+
+    def _table_rows(self, reqs: list[Request]) -> np.ndarray:
+        rows = np.full((len(reqs), self.config.table_width), -1, np.int64)
+        for i, req in enumerate(reqs):
+            rows[i, : len(req.pages)] = req.pages
+        return rows
+
+    def _apply(self, tokens: np.ndarray, tables: np.ndarray,
+               lens: np.ndarray) -> np.ndarray:
+        caches = tuple(
+            PagedKV(self._k_pools[layer], self._v_pools[layer],
+                    jnp.asarray(tables, jnp.int32),
+                    jnp.asarray(lens, jnp.int32))
+            for layer in range(self.model.depth)
+        )
+        logits, new_caches = _paged_apply(
+            self.model, self.params, jnp.asarray(tokens, jnp.int32), caches
+        )
+        for layer, c in enumerate(new_caches):
+            self._k_pools[layer] = c.k_pool
+            self._v_pools[layer] = c.v_pool
+        return np.asarray(logits, np.float32)
+
+    def _run_decode(self, reqs: list[Request]) -> None:
+        d = self.config.max_decode_batch
+        tokens = np.zeros((d, 1), np.int32)
+        tables = np.full((d, self.config.table_width), -1, np.int64)
+        lens = np.full((d,), -1, np.int32)  # -1 = inactive pad row
+        for i, req in enumerate(reqs):
+            lens[i] = req.computed_tokens
+            tokens[i, 0] = req.feed_pending()
+            tables[i, : len(req.pages)] = req.pages
+        logits = self._apply(tokens, tables, lens)
+        for i, req in enumerate(reqs):
+            req.computed_tokens = len(req.tokens)
+            self._emit(req, self._sample(req, logits[i, 0]))
+
+    def _run_prefill(self, items: list[tuple[Request, int]]) -> None:
+        p = self.config.max_prefill_rows
+        s = self.config.prefill_chunk
+        tokens = np.zeros((p, s), np.int32)
+        tables = np.full((p, self.config.table_width), -1, np.int64)
+        lens = np.full((p,), -1, np.int32)
+        for i, (req, real) in enumerate(items):
+            c = req.computed_tokens
+            tokens[i, :real] = req.tokens[c : c + real]
+            tables[i, : len(req.pages)] = req.pages
+            lens[i] = c
+        logits = self._apply(tokens, tables, lens)
+        for i, (req, real) in enumerate(items):
+            req.computed_tokens += real
+            if req.computed_tokens < len(req.tokens):
+                continue  # more chunks to go
+            self._commit_prefix(req)
+            req.transition(RequestState.DECODING)
+            if req.output_tokens:
+                # resumed after preemption: the recomputed KV now covers
+                # every fed token; the pending token was already sampled
+                # and streamed — never resample it
+                continue
+            self._emit(req, self._sample(req, logits[i, real - 1]))
+
+    def _commit_prefix(self, req: Request) -> None:
+        full = req.num_prompt_tokens // self.config.page_size
+        if full:
+            self.allocator.commit_prefix(
+                req.prompt, req.pages[:full], now=self._step
+            )
+
+    # -- token emission ---------------------------------------------------
+
+    def _sample(self, req: Request, logits_row: np.ndarray) -> int:
+        if req.sampling.temperature == 0.0:
+            return int(np.argmax(logits_row))
+        from attention_tpu.models.decode import warp_logits
+
+        key = self._rng_keys.get(req.request_id)
+        if key is None:
+            key = jax.random.PRNGKey(req.sampling.seed)
+        key, sub = jax.random.split(key)
+        self._rng_keys[req.request_id] = key
+        warped = warp_logits(
+            jnp.asarray(logits_row)[None],
+            temperature=req.sampling.temperature,
+            top_k=req.sampling.top_k,
+            top_p=req.sampling.top_p,
+        )
+        return int(jax.random.categorical(sub, warped, axis=-1)[0])
+
+    def _emit(self, req: Request, token: int) -> None:
+        done = req.emit(token)
+        if req.first_token_step < 0:
+            req.first_token_step = self._step
+            self._wall[req.request_id]["first_token"] = time.perf_counter()
+        if self.on_token is not None:
+            self.on_token(req, token)
+        if done:
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        req.transition(RequestState.FINISHED)
+        req.finish_step = self._step
+        if req.pages:
+            self.allocator.free(req.pages)
+        req.pages = []
+        self.scheduler.remove_finished(req)
+        self._rng_keys.pop(req.request_id, None)
+        self._finished_in_step += 1
+        wall = self._wall.pop(req.request_id, {})
+        now = time.perf_counter()
+        self.metrics.record_request(RequestMetrics(
+            request_id=req.request_id,
+            arrival_step=req.arrival,
+            first_scheduled_step=req.first_scheduled_step,
+            first_token_step=req.first_token_step,
+            finish_step=req.finish_step,
+            prompt_tokens=req.num_prompt_tokens,
+            output_tokens=req.num_output_tokens,
+            prefix_cached_tokens=req.prefix_cached_tokens,
+            preemptions=req.preemptions,
+            ttft_s=now - wall.get("added", now)
+            if "first_token" not in wall
+            else wall["first_token"] - wall["added"],
+            finish_s=now - wall.get("added", now),
+        ))
+        if self.on_finish is not None:
+            self.on_finish(req)
+
+
+# re-exported for callers that only import the engine module
+__all__ = [
+    "EngineConfig",
+    "ServingEngine",
+    "Request",
+    "RequestState",
+    "SamplingParams",
+    "ScheduledStep",
+]

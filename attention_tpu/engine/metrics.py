@@ -1,0 +1,169 @@
+"""Serving metrics: per-step counters and per-request latency records.
+
+The observability layer the ROADMAP's "serve heavy traffic" goal needs:
+every engine step emits a `StepMetrics` row (batch composition, queue
+depth, page utilization, cumulative prefix-cache and preemption
+counters) and every finished request a `RequestMetrics` row (TTFT,
+TPOT, prefix reuse, preemption count).  Both are plain dataclasses with
+``to_dict``/JSON helpers; :meth:`EngineMetrics.to_run_record` folds the
+aggregate into a `utils.profiling.RunRecord` so engine runs land in the
+same JSONL streams (`profiling.append_jsonl`) as every kernel
+benchmark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any
+
+from attention_tpu.utils.profiling import RunRecord
+
+
+@dataclasses.dataclass
+class StepMetrics:
+    """One scheduler/engine step."""
+
+    step: int
+    wall_s: float = 0.0
+    num_decode_reqs: int = 0
+    num_prefill_reqs: int = 0
+    decode_tokens: int = 0
+    prefill_tokens: int = 0          # real prompt tokens (pads excluded)
+    queue_depth: int = 0             # waiting (incl. preempted) after step
+    running: int = 0
+    admitted: int = 0
+    preempted: int = 0
+    finished: int = 0
+    free_pages: int = 0
+    used_pages: int = 0
+    page_utilization: float = 0.0
+    prefix_hit_tokens_total: int = 0  # cumulative
+    preemptions_total: int = 0        # cumulative
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+@dataclasses.dataclass
+class RequestMetrics:
+    """One finished request.  Step-denominated latencies are exact and
+    deterministic (the unit of serving time is the engine step);
+    wall-clock figures ride along for throughput reporting."""
+
+    request_id: str
+    arrival_step: int
+    first_scheduled_step: int
+    first_token_step: int
+    finish_step: int
+    prompt_tokens: int
+    output_tokens: int
+    prefix_cached_tokens: int
+    preemptions: int
+    ttft_s: float
+    finish_s: float
+
+    @property
+    def ttft_steps(self) -> int:
+        return self.first_token_step - self.arrival_step
+
+    @property
+    def tpot_steps(self) -> float:
+        """Mean steps per output token after the first."""
+        if self.output_tokens <= 1:
+            return 0.0
+        return (self.finish_step - self.first_token_step) \
+            / (self.output_tokens - 1)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["ttft_steps"] = self.ttft_steps
+        d["tpot_steps"] = round(self.tpot_steps, 3)
+        return d
+
+
+class EngineMetrics:
+    """Collects step and request rows over an engine's lifetime."""
+
+    def __init__(self):
+        self.steps: list[StepMetrics] = []
+        self.requests: list[RequestMetrics] = []
+        self._t0 = time.perf_counter()
+
+    def record_step(self, m: StepMetrics) -> None:
+        self.steps.append(m)
+
+    def record_request(self, m: RequestMetrics) -> None:
+        self.requests.append(m)
+
+    def summary(self) -> dict[str, Any]:
+        wall = time.perf_counter() - self._t0
+        out_tokens = sum(r.output_tokens for r in self.requests)
+        prompt_tokens = sum(r.prompt_tokens for r in self.requests)
+        cached = sum(r.prefix_cached_tokens for r in self.requests)
+        ttfts = [r.ttft_steps for r in self.requests]
+        tpots = [r.tpot_steps for r in self.requests if r.output_tokens > 1]
+        busy = [s for s in self.steps if s.decode_tokens or s.prefill_tokens]
+        mixed = [s for s in busy if s.decode_tokens and s.prefill_tokens]
+        return {
+            "num_requests": len(self.requests),
+            "num_steps": len(self.steps),
+            "wall_s": round(wall, 4),
+            "prompt_tokens": prompt_tokens,
+            "output_tokens": out_tokens,
+            "tokens_per_s": round(out_tokens / wall, 2) if wall else 0.0,
+            "prefix_cached_tokens": cached,
+            "prefix_cache_hit_rate": round(
+                cached / prompt_tokens, 4) if prompt_tokens else 0.0,
+            "mean_ttft_steps": round(
+                sum(ttfts) / len(ttfts), 2) if ttfts else 0.0,
+            "max_ttft_steps": max(ttfts) if ttfts else 0,
+            "mean_tpot_steps": round(
+                sum(tpots) / len(tpots), 3) if tpots else 0.0,
+            "mixed_batch_steps": len(mixed),
+            "mean_batched_tokens_per_step": round(
+                sum(s.decode_tokens + s.prefill_tokens for s in busy)
+                / len(busy), 2) if busy else 0.0,
+            "peak_page_utilization": round(
+                max((s.page_utilization for s in self.steps), default=0.0),
+                4),
+            "preemptions": self.steps[-1].preemptions_total
+            if self.steps else 0,
+        }
+
+    def to_run_record(self, *, config: str = "engine-serve",
+                      backend: str = "engine",
+                      extra: dict[str, Any] | None = None) -> RunRecord:
+        """The aggregate as a `RunRecord` (the repo's uniform benchmark
+        row).  m/n carry prompt/output token totals; the serving-
+        specific detail rides in ``extra``."""
+        import jax
+
+        s = self.summary()
+        per_tok_us = (s["wall_s"] * 1e6 / s["output_tokens"]
+                      if s["output_tokens"] else 0.0)
+        try:
+            dev = jax.devices()[0]
+            device_kind, n_dev = dev.device_kind, jax.device_count()
+        except Exception:  # noqa: BLE001 - metrics must not need a device
+            device_kind, n_dev = "unknown", 0
+        return RunRecord(
+            config=config,
+            backend=backend,
+            m=s["prompt_tokens"],
+            n=s["output_tokens"],
+            dk=0,
+            dv=0,
+            dtype="",
+            best_us=round(per_tok_us, 2),
+            median_us=round(per_tok_us, 2),
+            gflops_per_chip=0.0,
+            utilization=0.0,
+            device_kind=device_kind,
+            n_devices=n_dev,
+            extra={**s, **(extra or {})},
+        )

@@ -14,9 +14,12 @@ from attention_tpu.ops.quant import (  # noqa: F401
     update_quantized_kv,
 )
 from attention_tpu.ops.paged import (  # noqa: F401
+    OutOfPagesError,
+    PageAccountingError,
     PagedKV,
     PagePool,
     paged_append,
+    paged_append_chunk,
     paged_flash_decode,
     paged_fork,
     paged_from_dense,

@@ -78,6 +78,27 @@ class PagedKV(NamedTuple):
         return self.page_table.shape[1] * self.page_size
 
 
+class OutOfPagesError(RuntimeError):
+    """`PagePool.alloc` asked for more pages than the free list holds.
+
+    The typed capacity-pressure signal shared by every pool consumer:
+    `generate_paged`'s up-front claim surfaces it directly, and the
+    serving engine (`attention_tpu.engine`) catches it to trigger
+    prefix-cache eviction / admission refusal / preemption-by-recompute
+    instead of crashing the step loop.  Subclasses RuntimeError so
+    pre-existing callers that caught the bare RuntimeError keep working.
+    """
+
+
+class PageAccountingError(ValueError):
+    """Refcount misuse on a `PagePool`: double free, freeing or
+    increfing a page that was never allocated, or an out-of-range page
+    id.  Always a caller bug — raised instead of silently corrupting
+    refcounts (a corrupted refcount recycles a page still referenced by
+    a live sequence, which reads as another request's KV).  Subclasses
+    ValueError for drop-in compatibility with pre-typed callers."""
+
+
 class PagePool:
     """Host-side refcounted free-list allocator over ``num_pages``
     physical pages.
@@ -98,9 +119,19 @@ class PagePool:
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        """Current reference count of one page (0 = free)."""
+        if not (0 <= page < self.num_pages):
+            raise PageAccountingError(f"bad page id {page}")
+        return self._refs[page]
+
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
-            raise RuntimeError(
+            raise OutOfPagesError(
                 f"page pool exhausted: want {n}, free {len(self._free)}"
             )
         out = [self._free.pop() for _ in range(n)]
@@ -112,16 +143,16 @@ class PagePool:
         """Add a reference to already-allocated pages (prefix sharing)."""
         for p in pages:
             if not (0 <= p < self.num_pages) or self._refs[p] == 0:
-                raise ValueError(f"incref of unallocated page {p}")
+                raise PageAccountingError(f"incref of unallocated page {p}")
             self._refs[p] += 1
 
     def free(self, pages) -> None:
         """Drop one reference per page; recycle at refcount zero."""
         for p in pages:
             if not (0 <= p < self.num_pages):
-                raise ValueError(f"bad page id {p}")
+                raise PageAccountingError(f"bad page id {p}")
             if self._refs[p] == 0:
-                raise ValueError(f"double free of page {p}")
+                raise PageAccountingError(f"double free of page {p}")
             self._refs[p] -= 1
             if self._refs[p] == 0:
                 self._free.append(p)

@@ -69,6 +69,7 @@ SMOKE_TESTS = {
     "test_decode": ["test_flash_decode_matches_oracle_ragged",
                     "test_flash_decode_chunk_equals_sequential_decode",
                     "test_cached_decode_matches_full_forward"],
+    "test_engine": ["test_engine_token_parity_prefix_and_mixed_batching"],
     "test_quant": ["test_quantized_decode_close_to_fp",
                    "test_quantized_chunk_equals_sequential_decode"],
     "test_paged": ["test_paged_decode_matches_dense",

@@ -251,6 +251,88 @@ def test_paged_fork_shares_prefix_and_isolates_appends(rng):
     assert pool.free_pages == 16
 
 
+def test_page_pool_typed_errors():
+    """Pool misuse raises the TYPED errors the serving engine keys its
+    recovery policy on — and they subclass the pre-typed RuntimeError/
+    ValueError so every older caller's except clause still fires."""
+    from attention_tpu.ops.paged import OutOfPagesError, PageAccountingError
+
+    pool = PagePool(2)
+    pages = pool.alloc(2)
+    with pytest.raises(OutOfPagesError, match="exhausted"):
+        pool.alloc(1)
+    assert issubclass(OutOfPagesError, RuntimeError)
+    pool.free(pages)
+    with pytest.raises(PageAccountingError, match="double free"):
+        pool.free([pages[0]])
+    with pytest.raises(PageAccountingError, match="bad page id"):
+        pool.free([99])
+    with pytest.raises(PageAccountingError, match="bad page id"):
+        pool.refcount(-1)
+    with pytest.raises(PageAccountingError, match="unallocated"):
+        pool.incref([pages[0]])
+    assert issubclass(PageAccountingError, ValueError)
+
+
+def test_generate_paged_pool_exhaustion_is_typed(rng):
+    """`generate_paged` with an undersized pool surfaces the typed
+    OutOfPagesError (the engine reuses the same signal), not a bare
+    RuntimeError/ValueError."""
+    from attention_tpu.ops.paged import OutOfPagesError
+
+    model = TinyDecoder(vocab=17, dim=32, depth=1, num_q_heads=2,
+                        num_kv_heads=1, impl="flash", dtype=jnp.float32)
+    prompt = jnp.asarray(rng.integers(1, 17, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(OutOfPagesError, match="exhausted"):
+        generate_paged(model, params, prompt,
+                       jnp.asarray([6, 6], jnp.int32), steps=4,
+                       num_pages=1)  # two sequences need >= 2 pages
+
+
+def test_paged_fork_partial_tail_copy_on_write_refcounts(rng):
+    """Regression for the fork copy-on-write edge case: forking a row
+    whose LAST page is partially filled must share the full pages by
+    reference and physically copy only the tail page — pinned by
+    refcount assertions before/after each free."""
+    from attention_tpu.ops.paged import paged_fork
+
+    hkv, d, page = 2, 32, 128
+    length = 2 * page + 37  # two full pages + a 37-row partial tail
+    kc = jnp.asarray(rng.standard_normal((1, hkv, 512, d)), jnp.float32)
+    pool = PagePool(num_pages=8)
+    base = paged_from_dense(kc, kc, jnp.asarray([length], jnp.int32),
+                            pool, num_pages=8)
+    row = [int(p) for p in np.asarray(base.page_table[0]) if int(p) >= 0]
+    full, tail = row[:2], row[2]
+    assert all(pool.refcount(p) == 1 for p in row)
+
+    forked = paged_fork(base, pool, 0, 2)
+    frow = np.asarray(forked.page_table)
+    # full pages shared: same ids in every fork, refcount 1 + 2 forks
+    assert all((frow[c, :2] == full).all() for c in range(2))
+    assert all(pool.refcount(p) == 3 for p in full)
+    # tail copied: each fork's third page is fresh and private
+    tails = {int(frow[c, 2]) for c in range(2)}
+    assert tail not in tails and len(tails) == 2
+    assert all(pool.refcount(p) == 1 for p in tails)
+    # and the copy is bit-identical to the source tail
+    for t in tails:
+        np.testing.assert_array_equal(np.asarray(forked.k_pool[t]),
+                                      np.asarray(forked.k_pool[tail]))
+
+    # freeing one fork drops one reference from the shared pages and
+    # recycles only its private tail
+    pool.free([int(p) for p in frow[0] if int(p) >= 0])
+    assert all(pool.refcount(p) == 2 for p in full)
+    assert pool.refcount(int(frow[0, 2])) == 0
+    # freeing the other fork + the source recycles everything
+    pool.free([int(p) for p in frow[1] if int(p) >= 0])
+    pool.free(row)
+    assert pool.free_pages == 8
+    assert all(pool.refcount(p) == 0 for p in row)
+
+
 def test_page_pool_incref_guards():
     pool = PagePool(4)
     pages = pool.alloc(2)
