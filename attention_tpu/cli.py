@@ -19,17 +19,47 @@ Usage:
       # cache (~/.cache/attention_tpu/) and future calls pick them up
   python -m attention_tpu.cli serve-sim [--trace trace.json]
       [--num-requests 8 --shared-prefix-len 129 --shared-count 4 ...]
+      [--obs --obs-out run_dir [--obs-profile]]
       # continuous-batching engine over a request trace; prints
-      # per-step (--per-step) and summary metrics JSON
+      # per-step (--per-step) and summary metrics JSON; --obs-out
+      # persists the telemetry dump for `cli obs`
+  python -m attention_tpu.cli obs report --run run_dir
+  python -m attention_tpu.cli obs export --run run_dir
+      --format chrome|prom|jsonl [--out timeline.json]
+      # unified telemetry (attention_tpu.obs): counters/spans summary,
+      # or export — chrome merges host spans with the XLA device lane
+
+Diagnostics (progress notes, warnings) go through the shared
+``attention_tpu`` stdlib logger, stderr at INFO — the frozen
+reference-contract lines (Correct!/Wrong!/Elapsed time) stay on
+stdout, exactly as `attention.c` printed them.
 """
 
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
 import time
 
 import numpy as np
+
+_logger = logging.getLogger("attention_tpu.cli")
+
+
+def _setup_logging(level: int = logging.INFO) -> None:
+    """Attach one stderr handler to the shared ``attention_tpu`` logger
+    (idempotent).  Library modules log under ``attention_tpu.*``; the
+    CLI is the place that decides those records are user-visible."""
+    root = logging.getLogger("attention_tpu")
+    if not root.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(name)s: %(message)s"))
+        root.addHandler(h)
+    # our handler is the single sink: without this, a root logger that
+    # jax/absl already configured would print every record twice
+    root.propagate = False
+    root.setLevel(level)
 
 
 def _cmd_run(args: argparse.Namespace) -> int:
@@ -152,6 +182,13 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         synthetic_trace,
     )
 
+    obs_on = args.obs or args.obs_out or args.obs_profile
+    if obs_on:
+        from attention_tpu import obs
+
+        obs.enable()
+        obs.reset()
+
     model, params = _build_sim_model(args)
     if args.trace:
         trace = load_trace(args.trace)
@@ -169,7 +206,7 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         from attention_tpu.engine import save_trace
 
         save_trace(args.trace_out, trace)
-        print(f"wrote trace: {args.trace_out}", file=sys.stderr)
+        _logger.info("wrote trace: %s", args.trace_out)
 
     config = EngineConfig(
         num_pages=args.num_pages, page_size=args.page_size,
@@ -181,7 +218,22 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         watermark_pages=args.watermark_pages,
     )
     engine = ServingEngine(model, params, config)
-    summary, outputs = replay(engine, trace, max_steps=args.max_steps)
+    import contextlib
+
+    profile_cm = contextlib.nullcontext()
+    if args.obs_profile:
+        import os
+
+        from attention_tpu.obs.export import DUMP_DEVICE
+        from attention_tpu.utils import profiling
+
+        if not args.obs_out:
+            print("--obs-profile requires --obs-out", file=sys.stderr)
+            return 2
+        profile_cm = profiling.trace(
+            os.path.join(args.obs_out, DUMP_DEVICE))
+    with profile_cm:
+        summary, outputs = replay(engine, trace, max_steps=args.max_steps)
     if args.per_step:
         for m in engine.metrics.steps:
             print(m.to_json())
@@ -196,6 +248,11 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
     out = {"summary": summary, "run_record": json.loads(record.to_json())}
     if args.outputs:
         out["outputs"] = outputs
+    if args.obs_out:
+        from attention_tpu import obs
+
+        obs.dump(args.obs_out)
+        _logger.info("wrote telemetry dump: %s", args.obs_out)
     print(json.dumps(out))
     return 0
 
@@ -239,6 +296,17 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--prefill-chunk", type=int, default=32)
     ss.add_argument("--token-budget", type=int, default=128)
     ss.add_argument("--watermark-pages", type=int, default=1)
+    # telemetry (attention_tpu.obs)
+    ss.add_argument("--obs", action="store_true",
+                    help="enable the unified telemetry subsystem for "
+                         "this run (default off, zero overhead)")
+    ss.add_argument("--obs-out", default=None,
+                    help="write the telemetry dump (metrics.json + "
+                         "events.jsonl) here; implies --obs")
+    ss.add_argument("--obs-profile", action="store_true",
+                    help="also capture a jax.profiler device trace "
+                         "under <obs-out>/device for the merged "
+                         "chrome timeline; implies --obs")
 
 
 def _cmd_tune(args: argparse.Namespace) -> int:
@@ -250,8 +318,8 @@ def _cmd_tune(args: argparse.Namespace) -> int:
                else [args.kernel])
     rc = 0
     for name in kernels:
-        print(f"tuning {name} (seq={args.seq}, dim={args.dim})...",
-              file=sys.stderr)
+        _logger.info("tuning %s (seq=%d, dim=%d)...",
+                     name, args.seq, args.dim)
         try:
             rec = tune(
                 CLI_KERNELS[name],
@@ -261,7 +329,7 @@ def _cmd_tune(args: argparse.Namespace) -> int:
                 window=args.window, sinks=args.sinks, stats=args.stats,
                 repeats=args.repeats, cache_path=args.cache,
                 write=not args.dry_run,
-                log=lambda s: print(s, file=sys.stderr),
+                log=_logger.info,
             )
         except Exception as e:  # noqa: BLE001 - report and keep sweeping
             print(json.dumps({"kernel": name,
@@ -271,6 +339,85 @@ def _cmd_tune(args: argparse.Namespace) -> int:
             continue
         print(json.dumps(rec))
     return rc
+
+
+def _obs_load(args: argparse.Namespace):
+    """(snapshot, events, device_dir) for an ``obs`` subcommand: from a
+    --run dump directory, else the live in-process state (useful when a
+    caller invokes cli.main() programmatically after a run)."""
+    from attention_tpu import obs
+
+    if args.run:
+        snapshot, events = obs.load_dump(args.run)
+        device = args.device_trace or obs.device_dir_of(args.run)
+    else:
+        snapshot, events = obs.REGISTRY.snapshot(), obs.events()
+        device = args.device_trace
+    return snapshot, events, device
+
+
+def _cmd_obs_report(args: argparse.Namespace) -> int:
+    """Human-oriented run picture: counters, gauges, histogram and span
+    aggregates, and per-module device seconds when a capture exists."""
+    snapshot, events, device = _obs_load(args)
+
+    def _lbl(labels):
+        return ("{" + ",".join(f"{k}={v}" for k, v in
+                               sorted(labels.items())) + "}"
+                if labels else "")
+
+    print("== counters ==")
+    for s in snapshot.get("counters", []):
+        print(f"  {s['name']}{_lbl(s['labels'])} = {s['value']:g}")
+    print("== gauges ==")
+    for s in snapshot.get("gauges", []):
+        print(f"  {s['name']}{_lbl(s['labels'])} = {s['value']:g}")
+    print("== histograms ==")
+    for s in snapshot.get("histograms", []):
+        mean = s["sum"] / s["count"] if s["count"] else 0.0
+        print(f"  {s['name']}{_lbl(s['labels'])}: count={s['count']} "
+              f"mean={mean:.3f} sum={s['sum']:.3f}")
+    print("== spans ==")
+    agg: dict[str, list[float]] = {}
+    for e in events:
+        agg.setdefault(e["name"], []).append(e["dur_us"])
+    for name in sorted(agg):
+        durs = agg[name]
+        print(f"  {name}: n={len(durs)} total_ms="
+              f"{sum(durs) / 1e3:.3f} mean_us={sum(durs) / len(durs):.1f}")
+    if device:
+        from attention_tpu.utils.profiling import device_module_seconds
+
+        mods = device_module_seconds(device)
+        print("== device modules ==")
+        if mods:
+            for name, sec in sorted(mods.items(), key=lambda kv: -kv[1]):
+                print(f"  {name}: {sec * 1e3:.3f} ms")
+        else:
+            print("  (no parsable device lane)")
+    return 0
+
+
+def _cmd_obs_export(args: argparse.Namespace) -> int:
+    import json
+
+    from attention_tpu import obs
+
+    snapshot, events, device = _obs_load(args)
+    if args.format == "prom":
+        text = obs.prom_text(snapshot)
+    elif args.format == "jsonl":
+        text = "\n".join(obs.jsonl_lines(events, snapshot))
+        text += "\n" if text else ""
+    else:  # chrome
+        text = json.dumps(obs.chrome_trace(events, device_dir=device))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        _logger.info("wrote %s export: %s", args.format, args.out)
+    else:
+        sys.stdout.write(text)
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -343,6 +490,31 @@ def main(argv: list[str] | None = None) -> int:
                     help="search and report but write nothing")
     tn.set_defaults(fn=_cmd_tune)
 
+    ob = sub.add_parser(
+        "obs",
+        help="unified telemetry (attention_tpu.obs): report / export a "
+             "run's counters, spans, and merged host/device timeline",
+    )
+    obsub = ob.add_subparsers(dest="obs_cmd", required=True)
+    for name, fn in (("report", _cmd_obs_report),
+                     ("export", _cmd_obs_export)):
+        sp = obsub.add_parser(name)
+        sp.add_argument("--run", default=None,
+                        help="telemetry dump directory written by "
+                             "`serve-sim --obs-out` (default: the live "
+                             "in-process registry)")
+        sp.add_argument("--device-trace", default=None,
+                        help="jax.profiler trace dir for the device "
+                             "lane (default: <run>/device if present)")
+        if name == "export":
+            sp.add_argument("--format",
+                            choices=["chrome", "prom", "jsonl"],
+                            default="chrome")
+            sp.add_argument("--out", default=None,
+                            help="write here instead of stdout")
+        sp.set_defaults(fn=fn)
+
+    _setup_logging()
     args = parser.parse_args(argv)
     return args.fn(args)
 

@@ -31,7 +31,19 @@ from __future__ import annotations
 
 import dataclasses
 
+from attention_tpu import obs
 from attention_tpu.ops.paged import OutOfPagesError, PagePool
+
+_ALLOC_PAGES = obs.counter("engine.allocator.pages_allocated",
+                           "pages handed out, by path")
+_OOM = obs.counter("engine.allocator.oom",
+                   "OutOfPagesError raises, by path")
+_WATERMARK = obs.counter("engine.allocator.watermark_trips",
+                         "admission allocations refused by the reserve")
+_PREFIX_HITS = obs.counter("engine.allocator.prefix_hits")
+_PREFIX_MISSES = obs.counter("engine.allocator.prefix_misses")
+_PREFIX_HIT_TOKENS = obs.counter("engine.allocator.prefix_hit_tokens")
+_PREFIX_EVICTIONS = obs.counter("engine.allocator.prefix_evictions")
 
 
 def pages_for_tokens(n_tokens: int, page_size: int) -> int:
@@ -95,6 +107,7 @@ class BlockAllocator:
             self._prefix[victim.parent].children.discard(victim.key)
         self.pool.free([victim.page])
         self.prefix_evictions += 1
+        _PREFIX_EVICTIONS.inc()
         return victim.page
 
     def allocate(self, n: int, *, for_decode: bool = False) -> list[int]:
@@ -107,18 +120,25 @@ class BlockAllocator:
         """
         if n == 0:
             return []
-        reserve = 0 if for_decode else self.watermark_pages
-        # evict until the allocation fits above the reserve; evicting a
-        # leaf can expose its parent, so the loop re-scans each round
-        while self.pool.free_pages < n + reserve:
-            if self.evict_lru() is None:
-                raise OutOfPagesError(
-                    f"allocation of {n} page(s) would breach the "
-                    f"{'decode floor' if for_decode else 'watermark'}: "
-                    f"free {self.pool.free_pages}, nothing evictable, "
-                    f"reserve {reserve}"
-                )
-        return self.pool.alloc(n)
+        path = "decode" if for_decode else "admit"
+        with obs.span("allocator.alloc"):
+            reserve = 0 if for_decode else self.watermark_pages
+            # evict until the allocation fits above the reserve;
+            # evicting a leaf can expose its parent, so the loop
+            # re-scans each round
+            while self.pool.free_pages < n + reserve:
+                if self.evict_lru() is None:
+                    _OOM.inc(path=path)
+                    if not for_decode:
+                        _WATERMARK.inc()
+                    raise OutOfPagesError(
+                        f"allocation of {n} page(s) would breach the "
+                        f"{'decode floor' if for_decode else 'watermark'}"
+                        f": free {self.pool.free_pages}, nothing "
+                        f"evictable, reserve {reserve}"
+                    )
+            _ALLOC_PAGES.inc(n, path=path)
+            return self.pool.alloc(n)
 
     def free(self, pages) -> None:
         """Drop the caller's reference on ``pages`` (cache references,
@@ -148,8 +168,11 @@ class BlockAllocator:
             self.pool.incref(pages)
             self.prefix_hits += 1
             self.prefix_hit_tokens += len(pages) * self.page_size
+            _PREFIX_HITS.inc()
+            _PREFIX_HIT_TOKENS.inc(len(pages) * self.page_size)
         else:
             self.prefix_misses += 1
+            _PREFIX_MISSES.inc()
         return pages
 
     def commit_prefix(self, tokens, pages, *, now: int) -> int:

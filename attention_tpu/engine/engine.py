@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from attention_tpu import obs
 from attention_tpu.engine.allocator import BlockAllocator
 from attention_tpu.engine.metrics import (
     EngineMetrics,
@@ -191,11 +192,14 @@ class ServingEngine:
         the paged kernels, stream out sampled tokens."""
         t0 = time.perf_counter()
         self._finished_in_step = 0
-        sched = self.scheduler.schedule(self._step)
-        if sched.decode:
-            self._run_decode(sched.decode)
-        if sched.prefill:
-            self._run_prefill(sched.prefill)
+        with obs.span("engine.step"):
+            sched = self.scheduler.schedule(self._step)
+            if sched.decode:
+                with obs.span("engine.step.decode"):
+                    self._run_decode(sched.decode)
+            if sched.prefill:
+                with obs.span("engine.step.prefill"):
+                    self._run_prefill(sched.prefill)
         m = StepMetrics(
             step=self._step,
             wall_s=time.perf_counter() - t0,

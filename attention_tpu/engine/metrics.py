@@ -9,6 +9,14 @@ TPOT, prefix reuse, preemption count).  Both are plain dataclasses with
 aggregate into a `utils.profiling.RunRecord` so engine runs land in the
 same JSONL streams (`profiling.append_jsonl`) as every kernel
 benchmark.
+
+These rows are also re-emitted through the unified telemetry registry
+(`attention_tpu.obs`): every recorded step updates the ``engine.*``
+counters/gauges/histograms and every RunRecord goes through
+``obs.record_run`` — so ``cli obs report``/``obs.prom_text()`` show
+engine state alongside op-dispatch and tuning counters.  Emission is
+no-op while telemetry is disabled (the default); these dataclasses
+stay the source of truth for the deterministic per-run JSON.
 """
 
 from __future__ import annotations
@@ -18,7 +26,28 @@ import json
 import time
 from typing import Any
 
+from attention_tpu import obs
 from attention_tpu.utils.profiling import RunRecord
+
+_STEPS = obs.counter("engine.steps.total", "engine steps recorded")
+_DECODE_TOKENS = obs.counter("engine.tokens.decode",
+                             "decode tokens scheduled")
+_PREFILL_TOKENS = obs.counter("engine.tokens.prefill",
+                              "real prefill tokens scheduled")
+_FINISHED = obs.counter("engine.requests.finished", "requests finished")
+_QUEUE = obs.gauge("engine.queue.depth", "waiting requests after step")
+_RUNNING = obs.gauge("engine.queue.running", "running requests after step")
+_PAGES_USED = obs.gauge("engine.pages.used", "pool pages in use")
+_PAGES_FREE = obs.gauge("engine.pages.free", "pool pages free")
+_STEP_WALL = obs.histogram("engine.step.wall_ms", "engine step wall ms",
+                           buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25,
+                                    50, 100, 250, 500, 1000))
+_TTFT = obs.histogram("engine.request.ttft_steps",
+                      "steps from arrival to first token",
+                      buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_TPOT = obs.histogram("engine.request.tpot_steps",
+                      "mean steps per output token after the first",
+                      buckets=(1, 1.5, 2, 3, 4, 8, 16, 32))
 
 
 @dataclasses.dataclass
@@ -96,9 +125,25 @@ class EngineMetrics:
 
     def record_step(self, m: StepMetrics) -> None:
         self.steps.append(m)
+        if obs.enabled():
+            _STEPS.inc()
+            if m.decode_tokens:
+                _DECODE_TOKENS.inc(m.decode_tokens)
+            if m.prefill_tokens:
+                _PREFILL_TOKENS.inc(m.prefill_tokens)
+            _QUEUE.set(m.queue_depth)
+            _RUNNING.set(m.running)
+            _PAGES_USED.set(m.used_pages)
+            _PAGES_FREE.set(m.free_pages)
+            _STEP_WALL.observe(m.wall_s * 1e3)
 
     def record_request(self, m: RequestMetrics) -> None:
         self.requests.append(m)
+        if obs.enabled():
+            _FINISHED.inc()
+            _TTFT.observe(m.ttft_steps)
+            if m.output_tokens > 1:
+                _TPOT.observe(m.tpot_steps)
 
     def summary(self) -> dict[str, Any]:
         wall = time.perf_counter() - self._t0
@@ -151,7 +196,7 @@ class EngineMetrics:
             device_kind, n_dev = dev.device_kind, jax.device_count()
         except Exception:  # noqa: BLE001 - metrics must not need a device
             device_kind, n_dev = "unknown", 0
-        return RunRecord(
+        record = RunRecord(
             config=config,
             backend=backend,
             m=s["prompt_tokens"],
@@ -167,3 +212,5 @@ class EngineMetrics:
             n_devices=n_dev,
             extra={**s, **(extra or {})},
         )
+        obs.record_run(record)
+        return record

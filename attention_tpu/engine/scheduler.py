@@ -25,9 +25,18 @@ from __future__ import annotations
 
 import dataclasses
 
+from attention_tpu import obs
 from attention_tpu.engine.allocator import BlockAllocator, pages_for_tokens
 from attention_tpu.engine.request import Request, RequestState
 from attention_tpu.ops.paged import OutOfPagesError
+
+_ADMITTED = obs.counter("engine.scheduler.admissions",
+                        "requests admitted into the running set")
+_PREEMPTED = obs.counter("engine.scheduler.preemptions",
+                         "preemption-by-recompute events")
+_ADMIT_WAITS = obs.counter(
+    "engine.scheduler.admit_waits",
+    "admissions deferred by the allocator watermark")
 
 
 @dataclasses.dataclass
@@ -106,6 +115,7 @@ class Scheduler:
         victim.preemptions += 1
         victim.transition(RequestState.PREEMPTED)
         self.num_preemptions += 1
+        _PREEMPTED.inc()
         sched.preempted.append(victim)
         self.waiting.append(victim)
         self.waiting.sort(key=self._fcfs)
@@ -196,39 +206,45 @@ class Scheduler:
                and self.waiting[0].arrival <= step
                and len(sched.prefill) < self.max_prefill_rows
                and budget >= 1):
-            req = self.waiting[0]
-            if req.pages:  # defensive: a queued request must hold nothing
-                self.allocator.free(req.pages)
-                req.pages = []
-            pages = self.allocator.lookup_prefix(req.tokens, now=step)
-            try:
-                req.pages = pages
-                req.computed_tokens = len(pages) * self.allocator.page_size
-                req.prefix_cached_tokens = req.computed_tokens
-                before = len(sched.prefill)
-                self._schedule_chunk(req, sched, budget)
-                if len(sched.prefill) == before:
-                    raise OutOfPagesError("admission chunk not scheduled")
-            except OutOfPagesError:
-                # watermark refusal: return the prefix references and
-                # wait — running requests drain the queue eventually
-                if pages:
-                    self.allocator.free(pages)
-                    self.allocator.prefix_hits -= 1
-                    self.allocator.prefix_hit_tokens -= (
-                        len(pages) * self.allocator.page_size
-                    )
-                req.pages = []
-                req.computed_tokens = 0
-                req.prefix_cached_tokens = 0
-                break
-            self.waiting.pop(0)
-            self.running.append(req)
-            req.transition(RequestState.PREFILLING)
-            if req.first_scheduled_step < 0:
-                req.first_scheduled_step = step
-            sched.admitted.append(req)
-            budget -= sched.prefill[-1][1]
+            with obs.span("scheduler.admit"):
+                req = self.waiting[0]
+                if req.pages:  # defensive: queued requests hold nothing
+                    self.allocator.free(req.pages)
+                    req.pages = []
+                pages = self.allocator.lookup_prefix(req.tokens, now=step)
+                try:
+                    req.pages = pages
+                    req.computed_tokens = (
+                        len(pages) * self.allocator.page_size)
+                    req.prefix_cached_tokens = req.computed_tokens
+                    before = len(sched.prefill)
+                    self._schedule_chunk(req, sched, budget)
+                    if len(sched.prefill) == before:
+                        raise OutOfPagesError(
+                            "admission chunk not scheduled")
+                except OutOfPagesError:
+                    # watermark refusal: return the prefix references
+                    # and wait — running requests drain the queue
+                    # eventually
+                    if pages:
+                        self.allocator.free(pages)
+                        self.allocator.prefix_hits -= 1
+                        self.allocator.prefix_hit_tokens -= (
+                            len(pages) * self.allocator.page_size
+                        )
+                    req.pages = []
+                    req.computed_tokens = 0
+                    req.prefix_cached_tokens = 0
+                    _ADMIT_WAITS.inc()
+                    break
+                self.waiting.pop(0)
+                self.running.append(req)
+                req.transition(RequestState.PREFILLING)
+                if req.first_scheduled_step < 0:
+                    req.first_scheduled_step = step
+                sched.admitted.append(req)
+                _ADMITTED.inc()
+                budget -= sched.prefill[-1][1]
 
         return sched
 

@@ -1,0 +1,119 @@
+"""Unified telemetry: typed instruments, host spans, merged timelines.
+
+The observability layer SURVEY §5 planned and the serving engine needs:
+the reference's entire story was a printf of wall time
+(`attention.c:186-188`); ours is three composable pieces sharing one
+process-wide state:
+
+* **Registry** (`obs.registry`) — counters / gauges / fixed-bucket
+  histograms with labeled series, ``snapshot()``/``reset()``;
+* **Spans** (`obs.spans`) — ``with obs.span("engine.step"):`` records a
+  host start/duration event into a bounded ring AND enters
+  ``profiling.annotate`` so the same name lands in HLO;
+* **Exporters** (`obs.export`) — Prometheus text (:func:`prom_text`),
+  JSONL, and a Chrome-trace timeline merging host spans with the XLA
+  device lane (``cli obs export --format chrome|prom|jsonl``).
+
+Telemetry is **disabled by default** and the disabled path is a single
+flag check (no allocation, no clock read — asserted by test).  Enable
+with :func:`enable` or ``ATTN_TPU_OBS=1``.  Instrument handles may be
+created at import time regardless of the flag::
+
+    from attention_tpu import obs
+
+    _CALLS = obs.counter("ops.flash.calls")
+
+    def f(q, ...):
+        _CALLS.inc(bucket=obs.shape_bucket(q.shape))
+        with obs.span("engine.step"):
+            ...
+
+Names follow ``layer.component.verb`` (`obs.naming`, linted tree-wide
+by ``scripts/check_obs_names.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from attention_tpu.obs.export import (  # noqa: F401
+    chrome_trace,
+    device_dir_of,
+    dump,
+    jsonl_lines,
+    load_dump,
+    prom_text,
+    write_jsonl,
+)
+from attention_tpu.obs.naming import check_name, require_name  # noqa: F401
+from attention_tpu.obs.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    disable,
+    enable,
+    gauge,
+    histogram,
+    is_enabled,
+)
+from attention_tpu.obs.spans import (  # noqa: F401
+    SPAN_RING_CAPACITY,
+    events,
+    record_event,
+    span,
+)
+from attention_tpu.obs import spans as _spans
+
+
+def enabled() -> bool:
+    """Alias of :func:`is_enabled` (reads better at call sites)."""
+    return is_enabled()
+
+
+def reset() -> None:
+    """Zero every metric series and drop every span event (instrument
+    registrations survive)."""
+    REGISTRY.reset()
+    _spans.clear()
+
+
+def shape_bucket(*dims: int) -> str:
+    """Power-of-two shape-bucket label, e.g. ``shape_bucket(3000, 128)
+    -> "4096x128"`` — the tuning cache's bucketing discipline reused as
+    a low-cardinality metric label."""
+    out = []
+    for d in dims:
+        d = int(d)
+        b = 1
+        while b < d:
+            b <<= 1
+        out.append(str(b))
+    return "x".join(out)
+
+
+_RUNS = counter("bench.runs.recorded",
+                "RunRecords re-emitted through the registry")
+_RUN_US = gauge("bench.run.best_us", "best-run µs by config/backend")
+_RUN_UTIL = gauge("bench.run.utilization",
+                  "fraction-of-peak by config/backend")
+
+
+def record_run(record: Any) -> None:
+    """Re-emit a `utils.profiling.RunRecord` (or its dict) through the
+    registry, so benchmark rows and engine summaries land in the same
+    scrape as live counters."""
+    if not is_enabled():
+        return
+    import dataclasses
+
+    d = (dataclasses.asdict(record)
+         if dataclasses.is_dataclass(record) else dict(record))
+    labels = {"config": str(d.get("config", "")),
+              "backend": str(d.get("backend", ""))}
+    _RUNS.inc(**labels)
+    _RUN_US.set(float(d.get("best_us", 0.0)), **labels)
+    _RUN_UTIL.set(float(d.get("utilization", 0.0)), **labels)

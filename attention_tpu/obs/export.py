@@ -1,0 +1,190 @@
+"""Exporters: Prometheus text, JSONL event log, merged Chrome trace.
+
+Three views of the same state:
+
+* :func:`prom_text` — Prometheus text exposition of the registry
+  snapshot (scrape-able; round-trip pinned by test);
+* :func:`jsonl_lines` / :func:`write_jsonl` — one JSON object per span
+  event plus one per metric series, the archival format
+  (`profiling.append_jsonl`'s discipline applied to telemetry);
+* :func:`chrome_trace` — ONE Chrome-trace/Perfetto JSON timeline
+  merging host spans (pid "host") with the device "XLA Modules" lane
+  (pid "device") parsed from a ``profiling.trace`` capture by
+  `profiling.device_module_slices`.  Host and device clocks have no
+  common epoch, so each lane is normalized to its own first event —
+  relative alignment within a lane is exact, cross-lane offset is
+  nominal (good enough to see an engine step next to its two kernel
+  calls; a shared-epoch clock needs device support we don't assume).
+
+:func:`dump` / :func:`load_dump` persist a run's telemetry
+(``metrics.json`` + ``events.jsonl`` [+ ``device/`` profiler capture])
+so ``cli obs report/export`` can work on finished runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator
+
+from attention_tpu.obs import spans
+from attention_tpu.obs.naming import prom_name
+from attention_tpu.obs.registry import REGISTRY
+
+#: file names inside a dump directory
+DUMP_METRICS = "metrics.json"
+DUMP_EVENTS = "events.jsonl"
+DUMP_DEVICE = "device"
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def prom_text(snapshot: dict[str, Any] | None = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of ``snapshot``
+    (default: the live registry)."""
+    snap = REGISTRY.snapshot() if snapshot is None else snapshot
+    lines: list[str] = []
+    seen_type: set[str] = set()
+
+    def _type_line(flat: str, kind: str) -> None:
+        if flat not in seen_type:
+            seen_type.add(flat)
+            lines.append(f"# TYPE {flat} {kind}")
+
+    for s in snap.get("counters", []):
+        flat = prom_name(s["name"], kind="counter")
+        _type_line(flat, "counter")
+        lines.append(
+            f"{flat}{_fmt_labels(s['labels'])} {_fmt_value(s['value'])}")
+    for s in snap.get("gauges", []):
+        flat = prom_name(s["name"])
+        _type_line(flat, "gauge")
+        lines.append(
+            f"{flat}{_fmt_labels(s['labels'])} {_fmt_value(s['value'])}")
+    for s in snap.get("histograms", []):
+        flat = prom_name(s["name"])
+        _type_line(flat, "histogram")
+        cum = 0
+        for b, c in zip(s["buckets"], s["counts"]):
+            cum += c
+            lab = dict(s["labels"], le=_fmt_value(b))
+            lines.append(f"{flat}_bucket{_fmt_labels(lab)} {cum}")
+        cum += s["counts"][len(s["buckets"])]
+        lab = dict(s["labels"], le="+Inf")
+        lines.append(f"{flat}_bucket{_fmt_labels(lab)} {cum}")
+        lines.append(
+            f"{flat}_sum{_fmt_labels(s['labels'])} {_fmt_value(s['sum'])}")
+        lines.append(
+            f"{flat}_count{_fmt_labels(s['labels'])} {s['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def jsonl_lines(span_events: list[dict] | None = None,
+                snapshot: dict[str, Any] | None = None) -> Iterator[str]:
+    """One JSON object per line: span events, then metric series."""
+    evs = spans.events() if span_events is None else span_events
+    snap = REGISTRY.snapshot() if snapshot is None else snapshot
+    for e in evs:
+        yield json.dumps({"type": "span", **e})
+    for kind in ("counters", "gauges", "histograms"):
+        for s in snap.get(kind, []):
+            yield json.dumps({"type": kind[:-1], **s})
+
+
+def write_jsonl(path: str, span_events: list[dict] | None = None,
+                snapshot: dict[str, Any] | None = None) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for line in jsonl_lines(span_events, snapshot):
+            f.write(line + "\n")
+
+
+def chrome_trace(span_events: list[dict] | None = None,
+                 device_dir: str | None = None) -> dict[str, Any]:
+    """The merged host/device timeline as a Chrome-trace dict.
+
+    ``device_dir`` is a ``profiling.trace`` log dir; absent/unparsable
+    captures degrade to a host-only timeline (never an error — the CPU
+    CI path has no device lane)."""
+    evs = spans.events() if span_events is None else span_events
+    trace_events: list[dict[str, Any]] = [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+         "args": {"name": "host"}},
+    ]
+    host_t0 = min((e["ts_us"] for e in evs), default=0.0)
+    tids = sorted({e["tid"] for e in evs})
+    tid_map = {t: i + 1 for i, t in enumerate(tids)}
+    for t, i in tid_map.items():
+        trace_events.append(
+            {"ph": "M", "pid": 1, "tid": i, "name": "thread_name",
+             "args": {"name": f"host spans (thread {t})"}})
+    for e in evs:
+        trace_events.append({
+            "ph": "X", "pid": 1, "tid": tid_map[e["tid"]],
+            "name": e["name"],
+            "ts": round(e["ts_us"] - host_t0, 3),
+            "dur": round(e["dur_us"], 3),
+        })
+
+    if device_dir is not None:
+        from attention_tpu.utils.profiling import device_module_slices
+
+        slices = device_module_slices(device_dir)
+        if slices:
+            trace_events.append(
+                {"ph": "M", "pid": 2, "tid": 0, "name": "process_name",
+                 "args": {"name": "device"}})
+            trace_events.append(
+                {"ph": "M", "pid": 2, "tid": 1, "name": "thread_name",
+                 "args": {"name": "XLA Modules"}})
+            dev_t0 = min(ts for _, ts, _ in slices)
+            for name, ts, dur in slices:
+                trace_events.append({
+                    "ph": "X", "pid": 2, "tid": 1, "name": name,
+                    "ts": round(ts - dev_t0, 3), "dur": round(dur, 3),
+                })
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def dump(out_dir: str) -> None:
+    """Persist the live telemetry state under ``out_dir``."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, DUMP_METRICS), "w") as f:
+        json.dump(REGISTRY.snapshot(), f, indent=1)
+        f.write("\n")
+    write_jsonl(os.path.join(out_dir, DUMP_EVENTS))
+
+
+def load_dump(run_dir: str) -> tuple[dict[str, Any], list[dict]]:
+    """(snapshot, span_events) from a :func:`dump` directory."""
+    with open(os.path.join(run_dir, DUMP_METRICS)) as f:
+        snapshot = json.load(f)
+    evs: list[dict] = []
+    events_path = os.path.join(run_dir, DUMP_EVENTS)
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                if row.get("type") == "span":
+                    row.pop("type")
+                    evs.append(row)
+    return snapshot, evs
+
+
+def device_dir_of(run_dir: str) -> str | None:
+    """The dump's device capture dir, if the run profiled one."""
+    d = os.path.join(run_dir, DUMP_DEVICE)
+    return d if os.path.isdir(d) else None

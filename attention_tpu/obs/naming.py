@@ -1,0 +1,46 @@
+"""Metric/span naming convention: ``layer.component.verb``.
+
+One flat, predictable namespace: lowercase dot-separated segments
+(``[a-z][a-z0-9_]*``), two to four of them — ``engine.step``,
+``engine.scheduler.admit``, ``ops.flash.calls``.  The registry rejects
+malformed names at creation time (so a typo dies at the first call
+site, not in a dashboard), and ``scripts/check_obs_names.py`` lints
+every literal name in the tree against the same predicate, the
+`check_shipped_table.py` discipline applied to telemetry.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SEGMENT = r"[a-z][a-z0-9_]*"
+NAME_RE = re.compile(rf"^{_SEGMENT}(\.{_SEGMENT}){{1,3}}$")
+
+#: label keys are single segments (no dots)
+LABEL_RE = re.compile(rf"^{_SEGMENT}$")
+
+
+def check_name(name: str) -> bool:
+    """True iff ``name`` follows the convention."""
+    return bool(NAME_RE.match(name))
+
+
+def require_name(name: str) -> str:
+    """``name``, or ValueError describing the convention."""
+    if not check_name(name):
+        raise ValueError(
+            f"telemetry name {name!r} violates the naming convention: "
+            "2-4 lowercase dot-separated segments matching "
+            "[a-z][a-z0-9_]* (layer.component.verb), e.g. 'engine.step' "
+            "or 'ops.flash.calls'"
+        )
+    return name
+
+
+def prom_name(name: str, *, kind: str = "") -> str:
+    """Prometheus spelling: dots become underscores; counters gain the
+    conventional ``_total`` suffix."""
+    flat = name.replace(".", "_")
+    if kind == "counter" and not flat.endswith("_total"):
+        flat += "_total"
+    return flat

@@ -1,0 +1,221 @@
+"""Process-wide registry of typed instruments: counters, gauges,
+fixed-bucket histograms.
+
+Every instrument is a named family of labeled series (vLLM/Prometheus
+style): ``counter("ops.flash.calls").inc(bucket="4096x128")`` keeps one
+float per distinct label set.  Creation is get-or-create and type-safe
+(re-registering ``engine.steps`` as a gauge when it exists as a counter
+raises), so hot modules can hold module-level instrument handles.
+
+The zero-overhead-when-disabled contract: telemetry is OFF by default
+(module flag, ``ATTN_TPU_OBS=1`` env or :func:`enable` turns it on) and
+every mutating method's first statement is the flag check — the
+disabled path is one global read and a return, asserted <5% loop
+overhead by ``tests/test_obs.py``.  Instrument *creation* is always
+allowed (it is cheap, happens at import time, and keeps call sites
+branch-free); only recording is gated.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Iterable
+
+from attention_tpu.obs.naming import require_name
+
+_enabled: bool = os.environ.get("ATTN_TPU_OBS", "") not in ("", "0")
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    """Shared series bookkeeping for one named instrument family."""
+
+    kind = ""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = require_name(name)
+        self.help = help
+        self._series: dict[tuple[tuple[str, str], ...], Any] = {}
+
+    def series(self) -> list[dict[str, Any]]:
+        return [
+            {"name": self.name, "labels": dict(k), "value": v}
+            for k, v in sorted(self._series.items())
+        ]
+
+    def reset(self) -> None:
+        self._series.clear()
+
+
+class Counter(_Instrument):
+    """Monotonic float counter."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels: str) -> None:
+        if not _enabled:
+            return
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot go down ({n})")
+        key = _label_key(labels)
+        self._series[key] = self._series.get(key, 0.0) + n
+
+    def value(self, **labels: str) -> float:
+        return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Instrument):
+    """Last-write-wins value."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels: str) -> None:
+        if not _enabled:
+            return
+        self._series[_label_key(labels)] = float(v)
+
+    def value(self, **labels: str) -> float:
+        return float(self._series.get(_label_key(labels), 0.0))
+
+
+#: default histogram buckets (upper bounds) — latency-shaped, unit-free
+DEFAULT_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0,
+)
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram (cumulative counts + sum, Prometheus
+    semantics).  Buckets are frozen at creation — observation is one
+    linear scan, no allocation."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"histogram {name} needs >= 1 bucket")
+        self.buckets = bs
+
+    def observe(self, v: float, **labels: str) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        s = self._series.get(key)
+        if s is None:
+            s = self._series[key] = {
+                "counts": [0] * (len(self.buckets) + 1),  # +Inf last
+                "sum": 0.0,
+                "count": 0,
+            }
+        i = 0
+        for b in self.buckets:
+            if v <= b:
+                break
+            i += 1
+        s["counts"][i] += 1
+        s["sum"] += float(v)
+        s["count"] += 1
+
+    def series(self) -> list[dict[str, Any]]:
+        return [
+            {"name": self.name, "labels": dict(k),
+             "buckets": list(self.buckets),
+             "counts": list(v["counts"]),
+             "sum": v["sum"], "count": v["count"]}
+            for k, v in sorted(self._series.items())
+        ]
+
+
+class Registry:
+    """Get-or-create home of every instrument family."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: dict[str, _Instrument] = {}
+
+    def _get(self, cls, name: str, help: str, **kw) -> _Instrument:
+        inst = self._instruments.get(name)
+        if inst is not None:
+            if not isinstance(inst, cls):
+                raise TypeError(
+                    f"{name!r} already registered as {inst.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return inst
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, help, **kw)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"{name!r} already registered as {inst.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Plain-data view of every series, the exporters' input."""
+        out: dict[str, Any] = {"counters": [], "gauges": [],
+                               "histograms": []}
+        for inst in sorted(self._instruments.values(),
+                           key=lambda i: i.name):
+            out[inst.kind + "s"].extend(inst.series())
+        return out
+
+    def reset(self) -> None:
+        """Zero every series (registrations survive — module-level
+        handles stay valid)."""
+        for inst in self._instruments.values():
+            inst.reset()
+
+
+#: the process-wide default registry — module-level instrument handles
+#: throughout the tree hang off this one.
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, buckets=buckets)

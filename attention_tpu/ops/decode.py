@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from attention_tpu import obs
 from attention_tpu.ops.flash import (
     _LOG2E,
     _STAT_LANES,
@@ -51,6 +52,11 @@ from attention_tpu.ops.flash import (
     _should_interpret,
     check_softcap,
 )
+
+# Op-dispatch telemetry (attention_tpu.obs, off by default): one tick
+# per host-side dispatch; calls inside an enclosing jit tick per trace.
+_DECODE_CALLS = obs.counter(
+    "ops.decode.calls", "flash_decode dispatches by cache shape bucket")
 
 
 def _decode_kernel(
@@ -221,7 +227,7 @@ def _default_block_k(batch: int, h: int, hkv: int, n: int, d: int,
     static_argnames=("scale", "block_k", "interpret", "softcap", "window",
                      "sinks"),
 )
-def flash_decode(
+def _flash_decode_jit(
     q: jax.Array,        # (B, H, d)
     k_cache: jax.Array,  # (B, Hkv, N, d)
     v_cache: jax.Array,  # (B, Hkv, N, dv)
@@ -324,12 +330,24 @@ def flash_decode(
     return out[:, :group].reshape(b, h, dv)
 
 
+def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                 lengths: jax.Array, **kwargs) -> jax.Array:
+    """One-token-per-sequence decode (telemetry shim; full docs on
+    :func:`_flash_decode_jit`)."""
+    if obs.is_enabled():
+        _DECODE_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[0], k_cache.shape[-2],
+                                    q.shape[-1]),
+            entry="decode")
+    return _flash_decode_jit(q, k_cache, v_cache, lengths, **kwargs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_k", "interpret", "softcap", "window",
                      "sinks"),
 )
-def flash_decode_chunk(
+def _flash_decode_chunk_jit(
     q: jax.Array,          # (B, H, S, d) — S new tokens per sequence
     k_cache: jax.Array,    # (B, Hkv, N, d), chunk rows ALREADY appended
     v_cache: jax.Array,    # (B, Hkv, N, dv)
@@ -439,3 +457,17 @@ def flash_decode_chunk(
 
     return out[:, :rows].reshape(b, hkv, group, s_chunk, dv).reshape(
         b, h, s_chunk, dv)
+
+
+def flash_decode_chunk(q: jax.Array, k_cache: jax.Array,
+                       v_cache: jax.Array, new_lengths: jax.Array,
+                       **kwargs) -> jax.Array:
+    """Chunked (speculative-verify) decode (telemetry shim; full docs
+    on :func:`_flash_decode_chunk_jit`)."""
+    if obs.is_enabled():
+        _DECODE_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[0], k_cache.shape[-2],
+                                    q.shape[-1]),
+            entry="chunk")
+    return _flash_decode_chunk_jit(q, k_cache, v_cache, new_lengths,
+                                   **kwargs)

@@ -29,12 +29,28 @@ Two entry points share one kernel:
 from __future__ import annotations
 
 import functools
+import logging
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu import obs
+
+_logger = logging.getLogger("attention_tpu.ops.flash")
+
+# Op-dispatch telemetry (attention_tpu.obs, off by default).  Call
+# counts tick per host-side dispatch; a call inside an enclosing jit
+# trace ticks once per TRACE, not per execution — Python cannot see
+# compiled re-executions.  `ops.flash.lowered` ticks at trace time in
+# `_flash_call` and records the bound->online static dispatch choice.
+_FLASH_CALLS = obs.counter("ops.flash.calls",
+                           "flash_attention dispatches by shape bucket")
+_FLASH_LOWERED = obs.counter(
+    "ops.flash.lowered",
+    "kernel lowerings by requested/resolved max mode")
 
 NEG_INF = float("-inf")
 _STAT_LANES = 128  # stats are carried lane-replicated: min f32 tile is (8, 128)
@@ -802,6 +818,11 @@ def _flash_call(
         # ways.  Grid work scales with h*m*n (halved causal), so the
         # dispatch uses score elements, mirroring the measurement.
         bound_mode = False
+    if obs.is_enabled():
+        # trace-time: one tick per lowering, recording whether a
+        # requested bound mode statically resolved to online
+        _FLASH_LOWERED.inc(requested=max_mode,
+                           lowered="bound" if bound_mode else "online")
     softcap2 = None if softcap is None else softcap * _LOG2E
     kernel_kwargs = dict(
         n_true=n,
@@ -989,11 +1010,9 @@ def _flash_call(
             # fleet-wide and be frozen into jit caches): runs the bound
             # kernel with no guard/cond — WRONG (all-zero rows) on
             # inputs whose overshoot leaves fp32 exp2 range.
-            import sys
-
-            print("attention_tpu: _UNSAFE_SKIP_GUARD is set — bound-"
-                  "mode overshoot guard DISABLED (triage only)",
-                  file=sys.stderr)
+            _logger.warning(
+                "_UNSAFE_SKIP_GUARD is set — bound-mode overshoot "
+                "guard DISABLED (triage only)")
             outs = _run(True)
         else:
             # The cond's STRUCTURE costs ~30-50 us per call on this
@@ -1108,7 +1127,7 @@ def _canon(q, k, v):
         "max_mode",
     ),
 )
-def flash_attention(
+def _flash_attention_jit(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
@@ -1186,12 +1205,28 @@ def flash_attention(
     return unbatch(out)
 
 
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    **kwargs) -> jax.Array:
+    """Fused single-device attention: softmax(q k^T * scale) v.
+
+    Thin dispatch shim over the jitted kernel (same signature — see
+    :func:`_flash_attention_jit` for the full parameter docs) that
+    ticks the op-dispatch telemetry when `attention_tpu.obs` is
+    enabled; disabled (the default) it is one flag check."""
+    if obs.is_enabled():
+        _FLASH_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[-2], q.shape[-1]),
+            mode=str(kwargs.get("max_mode", "online")),
+            entry="attention")
+    return _flash_attention_jit(q, k, v, **kwargs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "causal", "block_sizes", "interpret",
                      "window", "softcap", "sinks", "max_mode"),
 )
-def flash_attention_partials(
+def _flash_attention_partials_jit(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
@@ -1259,3 +1294,16 @@ def flash_attention_partials(
             row_sum.reshape(b, h, -1),
         )
     return out, row_max, row_sum
+
+
+def flash_attention_partials(
+    q: jax.Array, k: jax.Array, v: jax.Array, **kwargs
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Unnormalized attention over a local KV shard (telemetry shim;
+    see :func:`_flash_attention_partials_jit` for the full docs)."""
+    if obs.is_enabled():
+        _FLASH_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[-2], q.shape[-1]),
+            mode=str(kwargs.get("max_mode", "online")),
+            entry="partials")
+    return _flash_attention_partials_jit(q, k, v, **kwargs)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from attention_tpu import obs
 from attention_tpu.ops.decode import (
     banded_block_clamp,
     banded_live,
@@ -48,6 +49,12 @@ from attention_tpu.ops.flash import (
     _should_interpret,
     check_softcap,
 )
+
+# Op-dispatch telemetry (attention_tpu.obs, off by default): one tick
+# per host-side dispatch; calls inside an enclosing jit tick per trace.
+_PAGED_CALLS = obs.counter(
+    "ops.paged.calls",
+    "paged decode dispatches by (batch, capacity, dim) bucket")
 
 
 class PagedKV(NamedTuple):
@@ -276,7 +283,7 @@ def _paged_kernel(
     static_argnames=("scale", "interpret", "softcap", "window", "sinks",
                      "return_stats"),
 )
-def paged_flash_decode(
+def _paged_flash_decode_jit(
     q: jax.Array,       # (B, H, d)
     cache: PagedKV,
     *,
@@ -429,12 +436,24 @@ def paged_flash_decode(
                      out.astype(jnp.float32)).astype(out.dtype)
 
 
+def paged_flash_decode(q: jax.Array, cache: PagedKV,
+                       **kwargs) -> jax.Array:
+    """Paged decode (telemetry shim; full docs on
+    :func:`_paged_flash_decode_jit`)."""
+    if obs.is_enabled():
+        _PAGED_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[0], cache.max_tokens,
+                                    q.shape[-1]),
+            entry="chunk" if q.ndim == 4 else "decode")
+    return _paged_flash_decode_jit(q, cache, **kwargs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("window", "sinks", "theta", "scale", "softcap",
                      "interpret"),
 )
-def paged_sink_decode(
+def _paged_sink_decode_jit(
     q: jax.Array,       # (B, H, d)
     cache: PagedKV,
     *,
@@ -522,6 +541,19 @@ def paged_sink_decode(
            + out_b * c_b[..., None]) / l_safe[..., None]
     out = jnp.where(lens_raw[:, None, None] < 0, jnp.nan, out)
     return out.astype(cache.v_pool.dtype)
+
+
+def paged_sink_decode(q: jax.Array, cache: PagedKV, *, window: int,
+                      sinks: int, **kwargs) -> jax.Array:
+    """Windowed rope+sinks paged decode (telemetry shim; full docs on
+    :func:`_paged_sink_decode_jit`)."""
+    if obs.is_enabled():
+        _PAGED_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[0], cache.max_tokens,
+                                    q.shape[-1]),
+            entry="sink")
+    return _paged_sink_decode_jit(q, cache, window=window, sinks=sinks,
+                                  **kwargs)
 
 
 def paged_append(cache: PagedKV, k_new: jax.Array,

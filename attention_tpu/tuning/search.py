@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import time
 
+from attention_tpu import obs
 from attention_tpu.tuning import space
 from attention_tpu.tuning.cache import (
     default_cache_path,
@@ -26,6 +27,16 @@ from attention_tpu.tuning.cache import (
     make_key,
 )
 from attention_tpu.tuning.lookup import dtype_name, key_fields
+
+# Tuning-search progress telemetry (attention_tpu.obs, off by
+# default): candidates tried / skipped (compile failures et al.) per
+# kernel family, plus one tick per completed search.
+_CANDIDATES = obs.counter("tuning.search.candidates",
+                          "candidates timed, by kernel family")
+_SKIPPED = obs.counter("tuning.search.skipped",
+                       "candidates skipped, by kernel family and error")
+_SEARCHES = obs.counter("tuning.search.completed",
+                        "tune() calls that produced a winner")
 
 #: CLI spelling -> internal kernel family name.
 CLI_KERNELS = {
@@ -192,15 +203,19 @@ def tune(kernel: str, *, seq: int, dim: int, heads: int = 1,
             label = (f"{cand[0]}x{cand[1]}" if isinstance(cand, tuple)
                      else str(cand))
             try:
-                step, x, operands = _measure_factory(
-                    kernel, cand, heads=heads, kv_heads=kv_heads, seq=seq,
-                    dim=dim, batch=batch, dtype=dtype, causal=causal,
-                    window=window, sinks=sinks, stats=stats,
-                    max_mode=max_mode, interpret=interpret)
-                sec = float(timer(step, x, operands, repeats))
+                with obs.span("tuning.search.measure"):
+                    step, x, operands = _measure_factory(
+                        kernel, cand, heads=heads, kv_heads=kv_heads,
+                        seq=seq, dim=dim, batch=batch, dtype=dtype,
+                        causal=causal, window=window, sinks=sinks,
+                        stats=stats, max_mode=max_mode,
+                        interpret=interpret)
+                    sec = float(timer(step, x, operands, repeats))
+                _CANDIDATES.inc(kernel=kernel)
             except Exception as e:  # noqa: BLE001 - VMEM overflow et al.
                 results[label] = {"error": f"{type(e).__name__}: "
                                            f"{str(e)[:160]}"}
+                _SKIPPED.inc(kernel=kernel, error=type(e).__name__)
                 if log:
                     log(f"  {label}: SKIP ({type(e).__name__})")
                 continue
@@ -215,6 +230,7 @@ def tune(kernel: str, *, seq: int, dim: int, heads: int = 1,
     if best_cand is None:
         raise RuntimeError(
             f"every candidate failed for {kernel} at seq={seq}: {results}")
+    _SEARCHES.inc(kernel=kernel)
 
     if kernel == "decode":
         entry = {"block_k": int(best_cand)}

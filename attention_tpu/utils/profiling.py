@@ -68,38 +68,72 @@ def append_jsonl(path: str, record: RunRecord) -> None:
         f.write(record.to_json() + "\n")
 
 
-def device_module_seconds(log_dir: str) -> dict[str, float] | None:
-    """Per-module device seconds from a ``trace(log_dir)`` capture.
-
-    Parses the newest Chrome-trace export under ``log_dir`` and sums the
-    duration of each module on the device "XLA Modules" lane.  Returns
-    ``{module_name: seconds}``, or None when no trace/device lane exists
-    (e.g. CPU platforms) — the shared parser for every device-time clock
-    (`utils.timing.benchmark_traced`, `scripts/speculative_bench.py`).
-    """
+def _latest_capture(log_dir: str) -> str | None:
+    """Newest ``.trace.json.gz`` under a ``trace(log_dir)`` capture,
+    by mtime.  Capture directories are timestamp-named, but path sort
+    order is NOT capture order across a rollover boundary (e.g.
+    ``..._09_59`` sorts after ``..._10_01`` under some stamp formats),
+    so recency must come from the filesystem, not the name."""
     import glob
+
+    paths = glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz")
+    if not paths:
+        return None
+    return max(paths, key=os.path.getmtime)
+
+
+def device_module_slices(
+    log_dir: str,
+) -> list[tuple[str, float, float]] | None:
+    """Per-slice device events from a ``trace(log_dir)`` capture.
+
+    Parses the newest Chrome-trace export under ``log_dir`` and returns
+    every complete event on the device "XLA Modules" lane as
+    ``(module_name, ts_us, dur_us)`` tuples (trace-local clock), or
+    None when no trace/device lane exists (e.g. CPU platforms).  The
+    slice-level view feeds `obs.export.chrome_trace`'s merged timeline;
+    :func:`device_module_seconds` aggregates it.
+    """
     import gzip
     import json as _json
 
-    paths = sorted(glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz"))
-    if not paths:
+    path = _latest_capture(log_dir)
+    if path is None:
         return None
     try:
-        data = _json.load(gzip.open(paths[-1]))
+        data = _json.load(gzip.open(path))
         lanes = {}
         for e in data["traceEvents"]:
             if e.get("ph") == "M" and e.get("name") == "thread_name":
                 lanes[(e["pid"], e["tid"])] = e["args"]["name"]
-        per_module: dict[str, float] = {}
-        for e in data["traceEvents"]:
+        slices = [
+            (e["name"].split("(")[0], float(e["ts"]), float(e["dur"]))
+            for e in data["traceEvents"]
             if (e.get("ph") == "X"
-                    and lanes.get((e.get("pid"), e.get("tid")))
-                    == "XLA Modules"):
-                key = e["name"].split("(")[0]
-                per_module[key] = per_module.get(key, 0.0) + e["dur"] / 1e6
+                and lanes.get((e.get("pid"), e.get("tid")))
+                == "XLA Modules")
+        ]
     except (ValueError, KeyError, EOFError, OSError):
         # a truncated/partial capture (interrupted profiler) must read
         # as "no device lane" so benchmark_auto's slope fallback engages
         # rather than aborting the whole benchmark
         return None
+    return slices or None
+
+
+def device_module_seconds(log_dir: str) -> dict[str, float] | None:
+    """Per-module device seconds from a ``trace(log_dir)`` capture.
+
+    Sums the duration of each module on the device "XLA Modules" lane
+    of the newest capture.  Returns ``{module_name: seconds}``, or None
+    when no trace/device lane exists — the shared parser for every
+    device-time clock (`utils.timing.benchmark_traced`,
+    `scripts/speculative_bench.py`).
+    """
+    slices = device_module_slices(log_dir)
+    if slices is None:
+        return None
+    per_module: dict[str, float] = {}
+    for key, _, dur_us in slices:
+        per_module[key] = per_module.get(key, 0.0) + dur_us / 1e6
     return per_module or None

@@ -1,0 +1,99 @@
+"""Lint every literal telemetry name against the naming convention.
+
+The telemetry namespace (`attention_tpu.obs.naming`) is
+``layer.component.verb``: 2-4 lowercase dot-separated segments.  A
+dashboard full of ad-hoc spellings is how observability rots, so —
+`check_shipped_table.py`'s discipline applied to metrics — this script
+AST-walks the tree and validates the first string-literal argument of
+every ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` /
+``span(...)`` call (module functions, ``obs.``-qualified, or registry
+methods alike).  Non-literal names (variables, f-strings) are skipped:
+they are validated at runtime by ``require_name``.
+
+Exit 0 iff clean.  Run: python scripts/check_obs_names.py [root]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from attention_tpu.obs.naming import check_name  # noqa: E402
+
+#: call names whose first literal argument must be a telemetry name
+INSTRUMENT_CALLS = {"counter", "gauge", "histogram", "span",
+                    "record_event"}
+
+#: scanned sub-trees, relative to the repo root
+SCAN = ("attention_tpu", "scripts", "tests", "bench.py")
+
+
+def _call_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}: unparsable ({e})"]
+    errors = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node.func) not in INSTRUMENT_CALLS:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue  # runtime-validated
+        if not check_name(first.value):
+            errors.append(
+                f"{path}:{node.lineno}: telemetry name "
+                f"{first.value!r} violates layer.component.verb "
+                "(2-4 lowercase dot-separated [a-z][a-z0-9_]* segments)"
+            )
+    return errors
+
+
+def check_tree(root: str) -> list[str]:
+    errors: list[str] = []
+    for rel in SCAN:
+        top = os.path.join(root, rel)
+        if os.path.isfile(top):
+            errors.extend(check_file(top))
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    errors.extend(check_file(os.path.join(dirpath, fn)))
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    errors = check_tree(root)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if not errors:
+        print("obs names OK")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

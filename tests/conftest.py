@@ -106,6 +106,17 @@ SMOKE_TESTS = {
 }
 
 
+def pytest_configure(config):
+    # the telemetry tier (tests/test_obs.py): registered here beside
+    # the smoke plumbing so `pytest -m obs` selects it without warnings
+    config.addinivalue_line(
+        "markers",
+        "obs: unified telemetry subsystem (attention_tpu/obs/) — "
+        "registry, spans, exporters, merged timeline; CPU-only, "
+        "tier-1 fast",
+    )
+
+
 def pytest_collection_modifyitems(config, items):
     matched: dict[tuple[str, str], bool] = {}
     collected_mods = set()

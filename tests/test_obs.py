@@ -1,0 +1,478 @@
+"""Unified telemetry subsystem tests (attention_tpu/obs/).
+
+Pins the contracts ISSUE 3 promises: typed instruments with labeled
+series and snapshot/reset; the bounded span ring composing with
+`profiling.annotate`; Prometheus text that round-trips through a
+parser; the merged host/device Chrome timeline; the mtime-newest and
+truncated-capture behavior of the profiler parser; the
+zero-overhead-when-disabled contract (<5% on a tight loop, byte-
+identical engine outputs); and the `cli obs` report/export family.
+
+All CPU-safe, tiny shapes.
+"""
+
+import gzip
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from attention_tpu import obs
+from attention_tpu.obs import spans as obs_spans
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture
+def obs_state():
+    """Clean telemetry state; restores disabled-by-default after."""
+    was = obs.is_enabled()
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    (obs.enable if was else obs.disable)()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=43, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), probe)["params"]
+    return model, params
+
+
+def _engine_config():
+    from attention_tpu.engine import EngineConfig
+
+    return EngineConfig(num_pages=32, page_size=128, max_seq_len=256,
+                        max_decode_batch=4, max_prefill_rows=2,
+                        prefill_chunk=32, token_budget=64,
+                        watermark_pages=1)
+
+
+def _run_engine(tiny_model):
+    from attention_tpu.engine import ServingEngine, replay, synthetic_trace
+
+    model, params = tiny_model
+    trace = synthetic_trace(4, vocab=43, seed=3, prompt_len_min=4,
+                            prompt_len_max=12, max_tokens=3,
+                            shared_prefix_len=129, shared_count=2)
+    engine = ServingEngine(model, params, _engine_config())
+    _summary, outputs = replay(engine, trace)
+    return outputs
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_counter_gauge_histogram_labels(obs_state):
+    c = obs.counter("obs.test.widgets")
+    c.inc()
+    c.inc(2, flavor="a")
+    c.inc(flavor="b")
+    assert c.value() == 1
+    assert c.value(flavor="a") == 2
+    assert c.value(flavor="b") == 1
+    with pytest.raises(ValueError, match="cannot go down"):
+        c.inc(-1)
+
+    g = obs.gauge("obs.test.level")
+    g.set(3.5)
+    g.set(7, tank="x")
+    assert g.value() == 3.5
+    assert g.value(tank="x") == 7
+
+    h = obs.histogram("obs.test.sizes", buckets=(1, 10, 100))
+    for v in (0.5, 5, 50, 5000):
+        h.observe(v)
+    (series,) = h.series()
+    assert series["counts"] == [1, 1, 1, 1]  # one per bucket + overflow
+    assert series["count"] == 4
+    assert series["sum"] == pytest.approx(5055.5)
+
+
+def test_registry_type_conflict_and_bad_names(obs_state):
+    obs.counter("obs.test.conflict")
+    with pytest.raises(TypeError, match="already registered"):
+        obs.gauge("obs.test.conflict")
+    for bad in ("Bad.Name", "single", "has space.x", "a.b.c.d.e",
+                "eng..step"):
+        with pytest.raises(ValueError, match="naming convention"):
+            obs.counter(bad)
+    assert obs.check_name("engine.step")
+    assert obs.check_name("engine.scheduler.admissions")
+    assert not obs.check_name("engine")
+
+
+def test_snapshot_and_reset(obs_state):
+    obs.counter("obs.test.snap").inc(5)
+    obs.gauge("obs.test.gsnap").set(2)
+    snap = obs.REGISTRY.snapshot()
+    names = {s["name"] for s in snap["counters"]} \
+        | {s["name"] for s in snap["gauges"]}
+    assert {"obs.test.snap", "obs.test.gsnap"} <= names
+    obs.reset()
+    # registrations survive reset; values do not
+    assert obs.counter("obs.test.snap").value() == 0
+    snap = obs.REGISTRY.snapshot()
+    assert all(s["name"] != "obs.test.snap" or s["value"] == 0
+               for s in snap["counters"])
+
+
+def test_disabled_records_nothing():
+    assert not obs.is_enabled()  # suite default: telemetry off
+    c = obs.counter("obs.test.off")
+    c.inc(100)
+    assert c.value() == 0
+    with obs.span("obs.test.offspan"):
+        pass
+    assert obs.events() == []
+    # the disabled span is the shared no-op instance — no allocation
+    assert obs.span("obs.test.offspan") is obs.span("obs.test.other")
+
+
+# ------------------------------------------------------------ exporters
+
+
+def _parse_prom(text):
+    """Tiny Prometheus text parser: {metric: {label_tuple: value}}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        metric, value = line.rsplit(" ", 1)
+        if "{" in metric:
+            name, rest = metric.split("{", 1)
+            labels = tuple(sorted(
+                kv.split("=", 1)[0] + "=" + kv.split("=", 1)[1].strip('"')
+                for kv in rest.rstrip("}").split(",")
+            ))
+        else:
+            name, labels = metric, ()
+        out.setdefault(name, {})[labels] = float(value)
+    return out
+
+
+def test_prom_text_round_trips_through_parser(obs_state):
+    obs.counter("obs.test.requests").inc(3, route="a")
+    obs.counter("obs.test.requests").inc(1, route="b")
+    obs.gauge("obs.test.depth").set(2.5)
+    h = obs.histogram("obs.test.lat_ms", buckets=(1, 10))
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(500)
+
+    parsed = _parse_prom(obs.prom_text())
+    assert parsed["obs_test_requests_total"][("route=a",)] == 3
+    assert parsed["obs_test_requests_total"][("route=b",)] == 1
+    assert parsed["obs_test_depth"][()] == 2.5
+    # histogram: cumulative buckets, +Inf == count, sum preserved
+    assert parsed["obs_test_lat_ms_bucket"][("le=1",)] == 1
+    assert parsed["obs_test_lat_ms_bucket"][("le=10",)] == 2
+    assert parsed["obs_test_lat_ms_bucket"][("le=+Inf",)] == 3
+    assert parsed["obs_test_lat_ms_count"][()] == 3
+    assert parsed["obs_test_lat_ms_sum"][()] == pytest.approx(505.5)
+
+
+def test_span_ring_is_bounded(obs_state, monkeypatch):
+    monkeypatch.setattr(obs_spans, "SPAN_RING_CAPACITY", 8)
+    for i in range(20):
+        obs.record_event("obs.test.ring", float(i), 1.0, tid=1)
+    evs = obs.events()
+    assert len(evs) == 8
+    # oldest dropped, order preserved
+    assert [e["ts_us"] for e in evs] == [float(i) for i in range(12, 20)]
+
+
+def test_span_records_and_nests(obs_state):
+    with obs.span("obs.test.outer"):
+        with obs.span("obs.test.inner"):
+            time.sleep(0.001)
+    evs = obs.events()
+    names = [e["name"] for e in evs]
+    # inner exits (and records) first
+    assert names == ["obs.test.inner", "obs.test.outer"]
+    inner, outer = evs
+    assert outer["dur_us"] >= inner["dur_us"] > 500
+    assert outer["ts_us"] <= inner["ts_us"]
+
+
+def test_jsonl_export_and_dump_roundtrip(obs_state, tmp_path):
+    obs.counter("obs.test.rows").inc(2)
+    with obs.span("obs.test.work"):
+        pass
+    run = tmp_path / "run"
+    obs.dump(str(run))
+    snapshot, events = obs.load_dump(str(run))
+    assert any(s["name"] == "obs.test.rows" and s["value"] == 2
+               for s in snapshot["counters"])
+    assert [e["name"] for e in events] == ["obs.test.work"]
+    lines = (run / "events.jsonl").read_text().splitlines()
+    assert all(json.loads(ln) for ln in lines)
+
+
+# ------------------------------------------- profiler capture parsing
+
+
+def _write_capture(log_dir, run_name, modules, *, mtime=None,
+                   payload=None, raw=None):
+    d = os.path.join(str(log_dir), "plugins", "profile", run_name)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "host.trace.json.gz")
+    if raw is not None:
+        with open(path, "wb") as f:
+            f.write(raw)
+    else:
+        if payload is None:
+            payload = {"traceEvents": [
+                {"ph": "M", "name": "thread_name", "pid": 7, "tid": 3,
+                 "args": {"name": "XLA Modules"}},
+                *[{"ph": "X", "pid": 7, "tid": 3, "name": f"{m}(tag)",
+                   "ts": 100.0 * i, "dur": 40.0}
+                  for i, m in enumerate(modules)],
+            ]}
+        with gzip.open(path, "wt") as f:
+            json.dump(payload, f)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+def test_device_module_seconds_picks_mtime_newest(tmp_path):
+    """Regression: lexicographic sorted(...)[-1] picked the wrong
+    capture when run timestamps roll over a path-sort boundary."""
+    from attention_tpu.utils.profiling import device_module_seconds
+
+    now = time.time()
+    # "run_2" sorts AFTER "run_10" lexicographically, but is older
+    _write_capture(tmp_path, "run_2", ["stale_module"], mtime=now - 100)
+    _write_capture(tmp_path, "run_10", ["fresh_module"], mtime=now)
+    mods = device_module_seconds(str(tmp_path))
+    assert mods == {"fresh_module": pytest.approx(40.0 / 1e6)}
+
+
+def test_device_module_slices_gives_timeline(tmp_path):
+    from attention_tpu.utils.profiling import device_module_slices
+
+    _write_capture(tmp_path, "run_1", ["mod_a", "mod_b"])
+    slices = device_module_slices(str(tmp_path))
+    assert slices == [("mod_a", 0.0, 40.0), ("mod_b", 100.0, 40.0)]
+
+
+def test_truncated_captures_read_as_no_device_lane(tmp_path):
+    """The silent-except fallback, pinned: corrupt gzip, missing lane,
+    empty events, and missing schema all read as None."""
+    from attention_tpu.utils.profiling import (
+        device_module_seconds,
+        device_module_slices,
+    )
+
+    assert device_module_seconds(str(tmp_path / "nonexistent")) is None
+
+    _write_capture(tmp_path / "corrupt", "r", [],
+                   raw=b"not a gzip stream at all")
+    assert device_module_seconds(str(tmp_path / "corrupt")) is None
+    assert device_module_slices(str(tmp_path / "corrupt")) is None
+
+    _write_capture(tmp_path / "nolane", "r", [], payload={
+        "traceEvents": [{"ph": "X", "pid": 1, "tid": 1,
+                         "name": "m", "ts": 0.0, "dur": 1.0}]})
+    assert device_module_seconds(str(tmp_path / "nolane")) is None
+
+    _write_capture(tmp_path / "empty", "r", [], payload={"traceEvents": []})
+    assert device_module_seconds(str(tmp_path / "empty")) is None
+
+    _write_capture(tmp_path / "noschema", "r", [], payload={"other": 1})
+    assert device_module_seconds(str(tmp_path / "noschema")) is None
+
+
+def test_chrome_trace_merges_host_and_device_lanes(obs_state, tmp_path):
+    with obs.span("engine.step"):
+        pass
+    _write_capture(tmp_path, "run_1", ["jit_paged_apply"])
+    doc = obs.chrome_trace(device_dir=str(tmp_path))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    pids = {e["pid"] for e in xs}
+    assert pids == {1, 2}  # host AND device slices in ONE timeline
+    names = {e["name"] for e in xs}
+    assert {"engine.step", "jit_paged_apply"} <= names
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "thread_name"}
+    assert any("XLA Modules" in x for x in lanes)
+    # unparsable device dir degrades to host-only, never raises
+    doc = obs.chrome_trace(device_dir=str(tmp_path / "missing"))
+    assert {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"} == {1}
+
+
+# -------------------------------------------------- overhead contracts
+
+
+def test_disabled_overhead_under_5_percent():
+    """The no-op span/counter path on a tight loop: <5% wall overhead.
+    The loop body is a small real matmul so the ratio reflects an
+    instrumented hot loop, not an empty one."""
+    assert not obs.is_enabled()
+    c = obs.counter("obs.test.hotloop")
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((128, 128))
+    b = rng.standard_normal((128, 128))
+    n = 200
+
+    def plain():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            a @ b
+        return time.perf_counter() - t0
+
+    def instrumented():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.span("obs.test.hotloop"):
+                a @ b
+            c.inc()
+        return time.perf_counter() - t0
+
+    plain()  # warm the BLAS path
+    base = min(plain() for _ in range(5))
+    inst = min(instrumented() for _ in range(5))
+    assert inst <= base * 1.05, (
+        f"disabled telemetry overhead {inst / base - 1:.1%} "
+        f"(base {base * 1e3:.2f} ms, instrumented {inst * 1e3:.2f} ms)"
+    )
+    assert c.value() == 0
+    assert obs.events() == []
+
+
+def test_engine_outputs_byte_identical_with_obs_on(tiny_model):
+    """Instrumentation must not perturb engine semantics: same trace,
+    telemetry off vs on, token-for-token identical outputs."""
+    import jax
+
+    assert not obs.is_enabled()
+    out_off = _run_engine(tiny_model)
+    obs.enable()
+    obs.reset()
+    try:
+        jax.clear_caches()  # force retracing so trace-time counters tick
+        out_on = _run_engine(tiny_model)
+        snap = obs.REGISTRY.snapshot()
+        counters = {s["name"]: s for s in snap["counters"]
+                    if not s["labels"]}
+        assert counters["engine.steps.total"]["value"] > 0
+        assert counters["engine.scheduler.admissions"]["value"] == 4
+        assert counters["engine.requests.finished"]["value"] == 4
+        assert any(s["name"] == "ops.paged.calls"
+                   for s in snap["counters"])
+        span_names = {e["name"] for e in obs.events()}
+        assert {"engine.step", "scheduler.admit",
+                "allocator.alloc"} <= span_names
+    finally:
+        obs.reset()
+        obs.disable()
+    assert out_on == out_off
+
+
+def test_tuning_search_counters(obs_state, tmp_path):
+    from attention_tpu.tuning.search import tune
+
+    calls = {"n": 0}
+
+    def timer(step, x, operands, repeats):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("synthetic compile failure")
+        return 0.001 * calls["n"]
+
+    tune("flash_fwd", seq=1024, dim=64, heads=2, repeats=1, timer=timer,
+         cache_path=str(tmp_path / "cache.json"))
+    snap = obs.REGISTRY.snapshot()
+    tried = sum(s["value"] for s in snap["counters"]
+                if s["name"] == "tuning.search.candidates")
+    skipped = sum(s["value"] for s in snap["counters"]
+                  if s["name"] == "tuning.search.skipped")
+    done = sum(s["value"] for s in snap["counters"]
+               if s["name"] == "tuning.search.completed")
+    assert tried == calls["n"] - 1
+    assert skipped == 1
+    assert done == 1
+
+
+# --------------------------------------------------------- CLI + lint
+
+
+def test_cli_serve_sim_obs_dump_report_and_export(tmp_path, capsys):
+    from attention_tpu.cli import main
+
+    run = tmp_path / "run"
+    was = obs.is_enabled()
+    try:
+        rc = main(["serve-sim", "--num-requests", "2", "--max-tokens",
+                   "2", "--prompt-len-max", "8", "--obs-out", str(run)])
+        assert rc == 0
+        capsys.readouterr()
+
+        assert main(["obs", "report", "--run", str(run)]) == 0
+        report = capsys.readouterr().out
+        assert "engine.steps.total" in report
+        assert "engine.step" in report  # span aggregate
+
+        assert main(["obs", "export", "--run", str(run), "--format",
+                     "prom"]) == 0
+        parsed = _parse_prom(capsys.readouterr().out)
+        assert parsed["engine_steps_total"][()] > 0
+
+        # a device capture inside the dump joins the chrome timeline
+        _write_capture(run / "device", "r", ["jit_paged_apply"])
+        out_file = tmp_path / "timeline.json"
+        assert main(["obs", "export", "--run", str(run), "--format",
+                     "chrome", "--out", str(out_file)]) == 0
+        doc = json.loads(out_file.read_text())
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in xs} == {1, 2}
+        names = {e["name"] for e in xs}
+        assert "engine.step" in names and "jit_paged_apply" in names
+
+        assert main(["obs", "export", "--run", str(run), "--format",
+                     "jsonl"]) == 0
+        lines = capsys.readouterr().out.splitlines()
+        kinds = {json.loads(ln)["type"] for ln in lines if ln}
+        assert {"span", "counter"} <= kinds
+    finally:
+        obs.reset()
+        (obs.enable if was else obs.disable)()
+
+
+def test_obs_name_lint_tree_is_clean_and_catches_violations(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_obs_names",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_obs_names.py"),
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert lint.check_tree(repo) == []
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from attention_tpu import obs\n"
+        'obs.counter("EngineSteps")\n'
+        'obs.span("just_one_segment")\n'
+        'obs.gauge(dynamic_name)\n'  # non-literal: runtime-checked
+    )
+    errors = lint.check_file(str(bad))
+    assert len(errors) == 2
+    assert all("naming convention" in e or "violates" in e
+               for e in errors)
