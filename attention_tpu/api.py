@@ -128,6 +128,18 @@ def _ensure_registered() -> None:
     _BACKENDS["ulysses"] = _ulysses
     _BACKENDS["auto"] = _auto
 
+    def _chaos_broken(q, k, v, **kw):
+        # The chaos subsystem's known-bad backend: the oracle plus the
+        # fuzzer's synthetic defect (one element pushed past every
+        # tolerance budget).  Exists so a shrunk `.bin` repro replays
+        # to the same Wrong! verdict through the frozen `cli run`
+        # harness — the fuzz->shrink->replay pipeline's ground truth.
+        from attention_tpu.chaos.fuzzer import synthetic_defect
+
+        return synthetic_defect(attention_oracle(q, k, v, **kw))
+
+    _BACKENDS["chaos-broken"] = _chaos_broken
+
 
 def attention(
     q,

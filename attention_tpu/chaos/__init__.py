@@ -1,0 +1,62 @@
+"""Chaos: differential fuzzing + fault injection, as a subsystem.
+
+The reference's correctness machinery is a frozen one-shot verifier
+(`attention.c:123-162`, PARITY C17).  This package turns that contract
+into a standing correctness-and-robustness machine with two arms:
+
+* **Differential fuzzing** (`configs`/`fuzzer`/`budgets`/`shrink`) —
+  seeded sampling of kernel family × shape × dtype × feature flags,
+  each case run against the fp64 oracle and judged by the per-family
+  tolerance ledger; failures shrink to a minimal repro and, when the
+  minimal config is plain, to the reference's ``.bin`` testcase format
+  that ``cli run`` (and the upstream C binary) replays.
+
+* **Fault injection** (`faults`/`invariants`) — seeded fault plans
+  (OOM windows, preemption storms, cancellations, NaN page payloads,
+  watermark flapping) driven through the serving engine, with checkers
+  for the four engine invariants: page/refcount conservation, token
+  parity for uninjected requests, termination, typed errors.
+
+CLI surface: ``python -m attention_tpu.cli chaos fuzz|replay|shrink|faults``.
+Observable through `attention_tpu.obs` (``chaos.fuzz.cases``,
+``chaos.faults.injected``, ``chaos.invariant.violations``).
+"""
+
+from attention_tpu.chaos.budgets import (  # noqa: F401
+    CONTRACT_TOL,
+    FAMILY_BUDGETS,
+    tolerance_for,
+)
+from attention_tpu.chaos.configs import (  # noqa: F401
+    FAMILIES,
+    FuzzConfig,
+    sample_campaign,
+    sample_config,
+)
+from attention_tpu.chaos.faults import (  # noqa: F401
+    FAULT_KINDS,
+    FaultCampaignReport,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    PlanReport,
+    random_plan,
+    run_plan,
+)
+from attention_tpu.chaos.faults import run_campaign as run_fault_campaign  # noqa: F401
+from attention_tpu.chaos.fuzzer import (  # noqa: F401
+    CampaignReport,
+    CaseResult,
+    DEFECT_AMPLITUDE,
+    oracle_masked,
+    run_case,
+    synthetic_defect,
+)
+from attention_tpu.chaos.fuzzer import run_campaign as run_fuzz_campaign  # noqa: F401
+from attention_tpu.chaos.shrink import (  # noqa: F401
+    ShrinkResult,
+    read_repro_json,
+    shrink,
+    write_repro_bin,
+    write_repro_json,
+)

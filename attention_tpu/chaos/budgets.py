@@ -1,0 +1,68 @@
+"""The tolerance ledger: per-family error budgets, encoded ONCE.
+
+Every numeric claim the fuzzer enforces lives here.  The bf16/fp32
+kernel families are held to the reference's frozen ±0.02 elementwise
+contract (`attention.c:143` — `core.testcase.VERIFY_THRESHOLD`); the
+quantized caches are held to their MEASURED budgets (tests/test_quant.py,
+RESULTS.md round 5): int8 sits comfortably inside the contract, int4 is
+an opt-in bytes/quality trade whose budget is ~4x the contract (and ~2x
+again under a sliding window, where fewer softmax terms average less of
+the nibble noise out).
+
+PARITY.md's "Tolerance ledger" table is a human-readable mirror of
+:data:`FAMILY_BUDGETS`; ``scripts/check_tolerances.py`` lints the two
+against each other (the `check_shipped_table.py` discipline), so a
+budget can never drift in only one place.
+"""
+
+from __future__ import annotations
+
+from attention_tpu.core.testcase import VERIFY_THRESHOLD
+
+#: the reference harness contract (attention.c:143)
+CONTRACT_TOL = VERIFY_THRESHOLD  # 0.02
+
+#: max-abs-error budget per fuzz family (unit-normal inputs, fp64
+#: oracle).  Keys are fuzz family names plus the ``int4_short``
+#: variant: int4's nibble noise averages out over the softmax band, so
+#: the budget is conditioned on how many KV rows a query attends —
+#: a sliding window or a short ragged prefix (< INT4_FULL_BAND rows)
+#: gets the wider short-band budget.  Both int4 values are the chaos
+#: fuzzer's own 40-seed worst-case measurement at d=64 (full band
+#: ~0.20, 8-row band ~0.29, plus margin) — WIDER than test_quant's
+#: few-seed typical figure of ~4-8e-2, which sits near the center of
+#: the distribution, not its tail.
+FAMILY_BUDGETS: dict[str, float] = {
+    "flash": CONTRACT_TOL,   # fused Pallas forward (fp32/bf16)
+    "decode": CONTRACT_TOL,  # dense-cache flash decode
+    "paged": CONTRACT_TOL,   # page-table decode
+    "int8": CONTRACT_TOL,    # int8 KV cache: measured ~2e-3, held to
+                             # the contract (it is contract-grade)
+    "int4": 0.25,            # full-band worst case (~0.20 measured)
+    "int4_short": 0.35,      # windowed / short-band (~0.29 measured)
+}
+
+#: minimum attended-band width (KV rows) for int4's full-band budget
+INT4_FULL_BAND = 64
+
+
+def tolerance_for(family: str, *, window: int | None = None,
+                  min_band: int | None = None) -> float:
+    """The ledger's budget for one sampled config.
+
+    ``min_band`` is the narrowest softmax band any query in the case
+    attends (min over sequences of ``min(length, window)``); int4's
+    budget widens below :data:`INT4_FULL_BAND` rows.
+    """
+    if family == "int4" and (
+        window is not None
+        or (min_band is not None and min_band < INT4_FULL_BAND)
+    ):
+        return FAMILY_BUDGETS["int4_short"]
+    try:
+        return FAMILY_BUDGETS[family]
+    except KeyError:
+        raise ValueError(
+            f"no tolerance budget for family {family!r}; known: "
+            f"{sorted(FAMILY_BUDGETS)}"
+        ) from None

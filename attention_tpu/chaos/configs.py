@@ -1,0 +1,172 @@
+"""Fuzz configs: the sampled point in kernel-config space.
+
+A :class:`FuzzConfig` names everything that selects a kernel code path:
+family (which kernel), shape, dtype, and the feature flags (causal,
+window, sinks, softcap, GQA grouping, ragged lengths).  The sampler
+draws configs deterministically from a seed within TIER-1-SAFE bounds —
+shapes small enough that the whole smoke campaign runs in interpret
+mode on CPU in seconds, drawn from a coarse grid so cases share jit
+signatures (each distinct static shape compiles once, then later cases
+reuse it).
+
+Configs are plain JSON-able dataclasses: a failing config round-trips
+through ``repro.json`` (`cli chaos replay`) and, once the shrinker has
+reduced it to the plain single-head subset, through the reference's
+frozen ``.bin`` testcase format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Sequence
+
+import numpy as np
+
+#: kernel families the fuzzer knows how to drive
+FAMILIES = ("flash", "decode", "paged", "int8", "int4")
+
+#: the paged kernels' page granule (ops.paged)
+PAGE_SIZE = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class FuzzConfig:
+    """One sampled kernel configuration.
+
+    ``m`` is query rows for the flash family and batch size for the
+    cache-decode families; ``n`` is KV rows / cache capacity.  ``seed``
+    keys the input generator, so a config IS its repro.
+    """
+
+    family: str
+    m: int
+    n: int
+    heads: int
+    kv_heads: int
+    head_dim: int
+    dtype: str = "float32"          # "float32" | "bfloat16"
+    causal: bool = False
+    window: int | None = None
+    sinks: int | None = None
+    softcap: float | None = None
+    ragged: bool = False            # decode families: varied lengths
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown family {self.family!r}")
+        if self.heads % self.kv_heads:
+            raise ValueError(
+                f"heads {self.heads} not a multiple of kv_heads "
+                f"{self.kv_heads}"
+            )
+        if self.sinks is not None and self.window is None:
+            raise ValueError("sinks require window")
+        if self.window is not None and self.family == "flash" \
+                and not self.causal:
+            raise ValueError("flash window requires causal")
+        if self.family != "flash" and self.n % PAGE_SIZE:
+            raise ValueError(
+                f"cache capacity {self.n} must be a {PAGE_SIZE}-multiple"
+            )
+        if self.family == "int4" and self.head_dim % 2:
+            raise ValueError("int4 packing needs an even head_dim")
+
+    @property
+    def is_plain(self) -> bool:
+        """True iff the config is expressible in the reference's frozen
+        ``.bin`` harness: single-head plain attention, no flags (the
+        harness has no head dimension and verifies un-masked softmax)."""
+        return (
+            self.family == "flash"
+            and self.heads == 1
+            and self.kv_heads == 1
+            and not self.causal
+            and self.window is None
+            and self.sinks is None
+            and self.softcap is None
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FuzzConfig":
+        data = json.loads(text)
+        cfg = cls(**{k: data[k] for k in data
+                     if k in {f.name for f in dataclasses.fields(cls)}})
+        cfg.validate()
+        return cfg
+
+
+def _choice(rng: np.random.Generator, seq: Sequence[Any]) -> Any:
+    return seq[int(rng.integers(len(seq)))]
+
+
+# Tier-1-safe sampling grids.  Deliberately COARSE: the point of the
+# fuzzer is flag/shape-combination coverage, not shape diversity — a
+# small grid keeps the jit-signature count (and the CPU interpret-mode
+# compile bill) bounded while still crossing every feature pair over a
+# campaign.
+_HEAD_GRID = ((1, 1), (2, 1), (4, 2))
+_FLASH_MN = (64, 128)
+_FLASH_D = (16, 32)
+_CACHE_N = (128, 256)
+_CACHE_D = (16, 32)
+_QUANT_D = (32, 64)
+_INT4_D = (64,)
+_SOFTCAP = (None, 15.0)
+_DTYPES = ("float32", "bfloat16")
+
+
+def sample_config(rng: np.random.Generator, *,
+                  families: Sequence[str] = FAMILIES) -> FuzzConfig:
+    """Draw one config.  Consumes a deterministic number of rng draws
+    per family, so a campaign is reproducible from its seed alone."""
+    family = _choice(rng, list(families))
+    heads, kv_heads = _choice(rng, _HEAD_GRID)
+    softcap = _choice(rng, _SOFTCAP)
+    seed = int(rng.integers(2**31 - 1))
+
+    if family == "flash":
+        m = n = _choice(rng, _FLASH_MN)
+        d = _choice(rng, _FLASH_D)
+        dtype = _choice(rng, _DTYPES)
+        causal = bool(rng.integers(2))
+        window = _choice(rng, (None, 16, 48)) if causal else None
+        sinks = _choice(rng, (None, 4)) if window is not None else None
+        return FuzzConfig(family=family, m=m, n=n, heads=heads,
+                          kv_heads=kv_heads, head_dim=d, dtype=dtype,
+                          causal=causal, window=window, sinks=sinks,
+                          softcap=softcap, seed=seed)
+
+    batch = int(rng.integers(1, 3))
+    n = _choice(rng, _CACHE_N)
+    if family in ("int8", "int4"):
+        d = _choice(rng, _INT4_D if family == "int4" else _QUANT_D)
+        dtype = "float32"  # the quantizers define the cache layout
+    else:
+        d = _choice(rng, _CACHE_D)
+        dtype = _choice(rng, _DTYPES)
+    window = _choice(rng, (None, 24))
+    sinks = _choice(rng, (None, 4)) if window is not None else None
+    ragged = bool(rng.integers(2))
+    return FuzzConfig(family=family, m=batch, n=n, heads=heads,
+                      kv_heads=kv_heads, head_dim=d, dtype=dtype,
+                      window=window, sinks=sinks, softcap=softcap,
+                      ragged=ragged, seed=seed)
+
+
+def sample_campaign(seed: int, cases: int, *,
+                    families: Sequence[str] = FAMILIES
+                    ) -> list[FuzzConfig]:
+    """The deterministic case list for one fuzz campaign: same seed →
+    byte-identical configs, independent of which cases later fail."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(cases):
+        cfg = sample_config(rng, families=families)
+        cfg.validate()
+        out.append(cfg)
+    return out

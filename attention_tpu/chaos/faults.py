@@ -1,0 +1,357 @@
+"""Seeded fault plans injected into the serving engine's step loop.
+
+The fuzzer (`chaos.fuzzer`) attacks the KERNELS; this module attacks
+the ENGINE — the allocator state you never reached and the scheduling
+interleavings you never tested.  A :class:`FaultPlan` is a seeded,
+JSON-able list of events fired between engine steps:
+
+* ``oom``       — the next N admission-path page allocations raise
+                  `OutOfPagesError` (capacity pressure without needing
+                  a giant trace);
+* ``preempt``   — preemption-by-recompute storm: forcibly preempt the
+                  N youngest running requests;
+* ``cancel``    — a client abandons the target request mid-flight
+                  (`ServingEngine.cancel`);
+* ``corrupt``   — NaN-poison one of the target's unshared KV pages
+                  (device-memory rot; must stay contained to the
+                  target);
+* ``watermark`` — flap the allocator's admission reserve.
+
+`run_plan` replays a trace through an engine with the plan attached
+and checks the four invariants (`chaos.invariants`); `run_campaign`
+does that for many seeded plans against one fault-free baseline.
+Everything is deterministic from the seeds, so a violating plan is
+its own repro.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from attention_tpu import obs
+from attention_tpu.chaos import invariants as inv
+from attention_tpu.engine.engine import EngineConfig, ServingEngine
+from attention_tpu.engine.scheduler import ScheduledStep
+from attention_tpu.engine.sim import replay, synthetic_trace
+from attention_tpu.ops.paged import OutOfPagesError
+
+_INJECTED = obs.counter("chaos.faults.injected",
+                        "fault events actually applied, by kind")
+
+FAULT_KINDS = ("oom", "preempt", "cancel", "corrupt", "watermark")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    step: int
+    kind: str
+    arg: int = 1                 # count (oom/preempt) or value (watermark)
+    target: str | None = None    # request id (cancel/corrupt)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    seed: int
+    events: tuple[FaultEvent, ...]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "events": [dataclasses.asdict(e) for e in self.events],
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        data = json.loads(text)
+        return cls(seed=int(data["seed"]),
+                   events=tuple(FaultEvent(**e) for e in data["events"]))
+
+
+def random_plan(seed: int, request_ids: Sequence[str], *,
+                num_events: int = 4, max_step: int = 20,
+                kinds: Sequence[str] = FAULT_KINDS) -> FaultPlan:
+    """Sample one seeded plan.  Watermark values deliberately include
+    the boundary cases (0 and a value near the pool's reserve) — the
+    off-by-one class the allocator's watermark test pins."""
+    rng = np.random.default_rng(seed)
+    events = []
+    for _ in range(num_events):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        step = int(rng.integers(1, max_step))
+        arg, target = 1, None
+        if kind in ("oom", "preempt"):
+            arg = int(rng.integers(1, 3))
+        elif kind == "watermark":
+            arg = int(rng.integers(0, 4))
+        elif kind in ("cancel", "corrupt"):
+            target = request_ids[int(rng.integers(len(request_ids)))]
+        events.append(FaultEvent(step=step, kind=kind, arg=arg,
+                                 target=target))
+    events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
+class FaultInjector:
+    """Attaches a plan to one engine instance: wraps the allocator's
+    ``allocate`` (injected OOM windows) and the engine's ``step``
+    (between-step event firing).  Bookkeeps what was ACTUALLY applied
+    — the invariant checkers exclude corrupted/cancelled targets from
+    token parity."""
+
+    def __init__(self, engine: ServingEngine, plan: FaultPlan):
+        self.engine = engine
+        self.plan = plan
+        self.injected = 0
+        self.corrupted: list[str] = []
+        self.cancelled: list[str] = []
+        self.skipped: list[str] = []
+        self._oom_admit = 0
+        self._orig_allocate = engine.allocator.allocate
+        self._orig_step = engine.step
+        engine.allocator.allocate = self._allocate
+        engine.step = self._step
+
+    # -- hook points ------------------------------------------------------
+
+    def _allocate(self, n: int, *, for_decode: bool = False):
+        if not for_decode and self._oom_admit > 0:
+            self._oom_admit -= 1
+            self._mark("oom")
+            raise OutOfPagesError(
+                "chaos: injected admission-path OutOfPagesError"
+            )
+        return self._orig_allocate(n, for_decode=for_decode)
+
+    def _step(self):
+        for ev in self.plan.events:
+            if ev.step == self.engine.current_step:
+                self._fire(ev)
+        return self._orig_step()
+
+    # -- event application ------------------------------------------------
+
+    def _mark(self, kind: str) -> None:
+        self.injected += 1
+        _INJECTED.inc(kind=kind)
+
+    def _fire(self, ev: FaultEvent) -> None:
+        if ev.kind == "oom":
+            self._oom_admit += ev.arg
+            # marked when the allocation actually raises
+        elif ev.kind == "preempt":
+            self._preempt_storm(ev.arg)
+        elif ev.kind == "cancel":
+            if self.engine.cancel(ev.target):
+                self.cancelled.append(ev.target)
+                self._mark("cancel")
+            else:
+                self.skipped.append(f"cancel:{ev.target}")
+        elif ev.kind == "corrupt":
+            if self._corrupt(ev.target):
+                self.corrupted.append(ev.target)
+                self._mark("corrupt")
+            else:
+                self.skipped.append(f"corrupt:{ev.target}")
+        elif ev.kind == "watermark":
+            alloc = self.engine.allocator
+            alloc.watermark_pages = max(
+                0, min(ev.arg, alloc.pool.num_pages - 1))
+            self._mark("watermark")
+        else:
+            raise ValueError(f"unknown fault kind {ev.kind!r}")
+
+    def _preempt_storm(self, count: int) -> None:
+        """Forcibly preempt the ``count`` youngest running requests —
+        the allocator-pressure path without needing real pressure."""
+        sched = self.engine.scheduler
+        for _ in range(count):
+            if not sched.running:
+                return
+            victim = max(sched.running, key=sched._fcfs)
+            sched._preempt(victim, ScheduledStep(
+                step=self.engine.current_step))
+            self._mark("preempt")
+
+    def _corrupt(self, target: str) -> bool:
+        """NaN-poison one page the target holds EXCLUSIVELY (shared
+        prefix-cache pages would leak the fault into other requests —
+        the harness injects contained faults; containment is what the
+        parity invariant then proves)."""
+        import jax.numpy as jnp
+
+        engine = self.engine
+        req = next((r for r in engine.scheduler.running
+                    if r.request_id == target and r.pages), None)
+        if req is None:
+            return False
+        cached = {e.page for e in engine.allocator._prefix.values()}
+        page = next((p for p in reversed(req.pages)
+                     if p not in cached
+                     and engine.pool.refcount(p) == 1), None)
+        if page is None:
+            return False
+        for layer in range(len(engine._k_pools)):
+            engine._k_pools[layer] = \
+                engine._k_pools[layer].at[page].set(jnp.nan)
+            engine._v_pools[layer] = \
+                engine._v_pools[layer].at[page].set(jnp.nan)
+        return True
+
+
+# ------------------------------------------------------------- plan runs
+
+
+@dataclasses.dataclass
+class PlanReport:
+    plan: FaultPlan
+    injected: int
+    corrupted: list[str]
+    cancelled: list[str]
+    skipped: list[str]
+    outputs: dict[str, list[int]]
+    violations: list[str]
+    surfaced_error: str | None
+    drained: bool
+    preemptions: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["plan"] = json.loads(self.plan.to_json())
+        return d
+
+
+def default_engine_config(**overrides) -> EngineConfig:
+    """Campaign engine geometry: small enough that injected pressure
+    means something, large enough to hold the default trace."""
+    kw: dict[str, Any] = dict(
+        num_pages=16, page_size=128, max_seq_len=192,
+        max_decode_batch=4, max_prefill_rows=2, prefill_chunk=16,
+        token_budget=32, watermark_pages=1,
+    )
+    kw.update(overrides)
+    return EngineConfig(**kw)
+
+
+def build_sim_model(*, vocab: int = 43, dim: int = 32, depth: int = 1,
+                    q_heads: int = 4, kv_heads: int = 2, seed: int = 0):
+    """Deterministic tiny decoder (the `cli serve-sim` discipline:
+    params from PRNGKey(seed), so every run is bit-identical)."""
+    import jax
+    import jax.numpy as jnp
+
+    from attention_tpu.models import TinyDecoder
+
+    model = TinyDecoder(vocab=vocab, dim=dim, depth=depth,
+                        num_q_heads=q_heads, num_kv_heads=kv_heads,
+                        impl="flash", dtype=jnp.float32)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), probe)["params"]
+    return model, params
+
+
+def run_plan(model, params, config: EngineConfig,
+             trace: list[dict[str, Any]], plan: FaultPlan, *,
+             baseline: dict[str, list[int]] | None = None,
+             max_steps: int = 500) -> PlanReport:
+    """Replay ``trace`` through a fresh engine with ``plan`` attached;
+    check every invariant that applies.  ``baseline`` (a fault-free
+    run's outputs) enables the token-parity check."""
+    engine = ServingEngine(model, params, config)
+    injector = FaultInjector(engine, plan)
+    error: BaseException | None = None
+    outputs: dict[str, list[int]] = {}
+    try:
+        _, outputs = replay(engine, trace, max_steps=max_steps)
+    except Exception as e:  # noqa: BLE001 - the typed-error invariant
+        error = e           # decides what may land here
+    drained = error is None and not engine.scheduler.has_work()
+
+    violations = []
+    violations += inv.pool_accounting_violations(engine.pool)
+    if drained:
+        violations += inv.engine_quiescence_violations(engine)
+        if baseline is not None:
+            untouched_baseline = dict(baseline)
+            violations += inv.token_parity_violations(
+                untouched_baseline, outputs,
+                exclude=set(injector.corrupted) | set(injector.cancelled),
+            )
+    violations += inv.termination_violations(drained, error,
+                                             max_steps=max_steps)
+    violations += inv.typed_error_violations(error)
+    return PlanReport(
+        plan=plan, injected=injector.injected,
+        corrupted=injector.corrupted, cancelled=injector.cancelled,
+        skipped=injector.skipped, outputs=outputs,
+        violations=violations,
+        surfaced_error=None if error is None else type(error).__name__,
+        drained=drained,
+        preemptions=engine.scheduler.num_preemptions,
+    )
+
+
+@dataclasses.dataclass
+class FaultCampaignReport:
+    seed: int
+    baseline_outputs: dict[str, list[int]]
+    reports: list[PlanReport]
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.reports)
+
+    @property
+    def total_injected(self) -> int:
+        return sum(r.injected for r in self.reports)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "plans": len(self.reports),
+            "injected": self.total_injected,
+            "violations": sum(len(r.violations) for r in self.reports),
+            "reports": [r.to_dict() for r in self.reports],
+        }
+
+
+def run_campaign(seed: int, *, num_plans: int = 5,
+                 num_requests: int = 5, temperature: float = 0.0,
+                 events_per_plan: int = 4,
+                 config: EngineConfig | None = None,
+                 model=None, params=None,
+                 log: Callable[[str], None] | None = None
+                 ) -> FaultCampaignReport:
+    """One seeded fault campaign: a fault-free baseline run, then
+    ``num_plans`` seeded plans against the SAME trace, each checked
+    for all four invariants."""
+    if model is None or params is None:
+        model, params = build_sim_model()
+    config = config or default_engine_config()
+    trace = synthetic_trace(
+        num_requests, vocab=model.vocab, seed=seed, max_tokens=6,
+        temperature=temperature,
+    )
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    ids = [t["id"] for t in trace]
+    reports = []
+    for i in range(num_plans):
+        plan = random_plan(seed * 1009 + i, ids,
+                           num_events=events_per_plan)
+        r = run_plan(model, params, config, trace, plan,
+                     baseline=baseline)
+        if log is not None:
+            log(f"plan {i} (seed {plan.seed}): injected={r.injected} "
+                f"violations={len(r.violations)} "
+                f"error={r.surfaced_error or 'none'}")
+        reports.append(r)
+    return FaultCampaignReport(seed=seed, baseline_outputs=baseline,
+                               reports=reports)

@@ -1,0 +1,306 @@
+"""Differential config fuzzer: sampled kernel configs vs the fp64 oracle.
+
+The reference's correctness story is one frozen verifier over
+hand-picked testcases (`attention.c:123-162`).  This module makes that
+verifier a STANDING machine: every sampled :class:`FuzzConfig` builds
+seeded inputs, runs the real kernel path (flash forward, dense/paged
+decode, int8/int4 quantized decode — window, sinks, softcap, GQA and
+ragged lengths included), computes the exact fp64 answer with the same
+masking, and checks the full-scan error statistics against the
+tolerance ledger (`chaos.budgets`).
+
+Everything is deterministic from the campaign seed: same seed → same
+configs → same inputs → same ledger rows.  A failing case carries its
+config (the repro) for `chaos.shrink` to minimize.
+
+The ``defect`` hook perturbs the kernel output before comparison; it
+exists so the whole fuzz→shrink→replay pipeline can be exercised (and
+tested) against a known synthetic failure without waiting for a real
+kernel bug.  The same perturbation is registered as the ``chaos-broken``
+backend in `attention_tpu.api`, so a shrunk ``.bin`` repro replays to
+the same Wrong! verdict through the frozen ``cli run`` harness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from attention_tpu import obs
+from attention_tpu.chaos.budgets import tolerance_for
+from attention_tpu.chaos.configs import (
+    FAMILIES,
+    PAGE_SIZE,
+    FuzzConfig,
+    sample_campaign,
+)
+from attention_tpu.core.testcase import verify_scan
+
+_CASES = obs.counter("chaos.fuzz.cases",
+                     "fuzz cases executed, by family/result")
+
+#: synthetic-defect amplitude: above every ledger budget (max 0.35)
+DEFECT_AMPLITUDE = 0.5
+
+
+def synthetic_defect(out: np.ndarray) -> np.ndarray:
+    """The injected failure: one element pushed past every budget.
+    Deterministic and shape-independent, so it survives shrinking all
+    the way down to the plain single-head ``.bin`` subset."""
+    out = np.array(out, dtype=np.float64, copy=True)
+    out.flat[0] += DEFECT_AMPLITUDE
+    return out
+
+
+# --------------------------------------------------------------- oracle
+
+
+def _round_to(x: np.ndarray, dtype: str) -> np.ndarray:
+    """Input rounding is part of the INPUT, not kernel error: the
+    oracle must see the same bf16-rounded values the kernel reads."""
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        # bf16 -> f32 is exact; the f64 hop happens in NumPy (x64 may
+        # be disabled in jax)
+        return np.asarray(
+            jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32)
+        ).astype(np.float64)
+    return x.astype(np.float64)
+
+
+def oracle_masked(
+    q: np.ndarray,  # (hq, m, d) float64
+    k: np.ndarray,  # (hkv, n, d) float64
+    v: np.ndarray,  # (hkv, n, dv) float64
+    *,
+    causal: bool = False,
+    window: int | None = None,
+    sinks: int | None = None,
+    softcap: float | None = None,
+    q_positions: np.ndarray | None = None,
+    n_valid: np.ndarray | int | None = None,
+) -> np.ndarray:
+    """fp64 attention with the kernels' full masking surface.
+
+    ``q_positions`` gives each query row's sequence position (default
+    ``arange(m)``, the aligned self-attention case); ``n_valid`` caps
+    the attendable KV prefix.  The window band for a query at position
+    p keeps columns ``[p - window + 1, p]`` plus the first ``sinks``
+    columns — exactly `flash_attention`/`flash_decode` semantics.
+    """
+    hq, m, d = q.shape
+    hkv, n, _ = k.shape
+    group = hq // hkv
+    kx = np.repeat(k, group, axis=0)
+    vx = np.repeat(v, group, axis=0)
+    scores = np.einsum("hmd,hnd->hmn", q, kx) / np.sqrt(float(d))
+    if softcap is not None:
+        scores = softcap * np.tanh(scores / softcap)
+    pos = (np.arange(m) if q_positions is None
+           else np.asarray(q_positions))[None, :, None]
+    col = np.arange(n)[None, None, :]
+    mask = np.ones((1, m, n), dtype=bool)
+    if n_valid is not None:
+        mask &= col < np.asarray(n_valid).reshape(1, -1, 1)
+    if causal:
+        mask &= col <= pos
+    if window is not None:
+        in_band = col >= pos - (window - 1)
+        if sinks:
+            in_band |= col < sinks
+        mask &= in_band
+    scores = np.where(mask, scores, -np.inf)
+    scores -= scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("hmn,hnd->hmd", p, vx)
+
+
+# ---------------------------------------------------------- case runner
+
+
+@dataclasses.dataclass
+class CaseResult:
+    config: FuzzConfig
+    ok: bool
+    tolerance: float
+    max_abs_err: float
+    mismatches: int
+    total: int
+    message: str
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["config"] = dataclasses.asdict(self.config)
+        return d
+
+
+def _case_inputs(config: FuzzConfig):
+    """Seeded unit-normal inputs for one config (fp64 masters)."""
+    rng = np.random.default_rng(config.seed)
+    hq, hkv, d = config.heads, config.kv_heads, config.head_dim
+    if config.family == "flash":
+        q = rng.standard_normal((hq, config.m, d))
+        k = rng.standard_normal((hkv, config.n, d))
+        v = rng.standard_normal((hkv, config.n, d))
+        return q, k, v, None
+    b, n = config.m, config.n
+    q = rng.standard_normal((b, hq, d))
+    k = rng.standard_normal((b, hkv, n, d))
+    v = rng.standard_normal((b, hkv, n, d))
+    lo = 8 + (config.sinks or 0)
+    if config.ragged:
+        lengths = rng.integers(lo, n + 1, size=b)
+    else:
+        lengths = np.full((b,), n)
+    return q, k, v, lengths.astype(np.int32)
+
+
+def _decode_oracle(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
+    """Per-sequence fp64 decode reference: each query sits at position
+    ``len - 1`` of its own sequence."""
+    b, hq, d = q.shape
+    out = np.empty((b, hq, v.shape[-1]))
+    for bi in range(b):
+        ln = int(lengths[bi])
+        out[bi] = oracle_masked(
+            q[bi][:, None, :], k[bi, :, :ln], v[bi, :, :ln],
+            window=config.window, sinks=config.sinks,
+            softcap=config.softcap,
+            q_positions=np.asarray([ln - 1]),
+        )[:, 0]
+    return out
+
+
+def _run_kernel(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
+    """Lower one config onto the real kernel path it names."""
+    import jax.numpy as jnp
+
+    dt = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    kw: dict[str, Any] = dict(softcap=config.softcap,
+                              window=config.window, sinks=config.sinks)
+    if config.family == "flash":
+        from attention_tpu.ops.flash import flash_attention
+
+        out = flash_attention(
+            jnp.asarray(q, dt), jnp.asarray(k, dt), jnp.asarray(v, dt),
+            causal=config.causal, **kw,
+        )
+        return np.asarray(out, np.float64)
+
+    lens = jnp.asarray(lengths, jnp.int32)
+    if config.family == "decode":
+        from attention_tpu.ops.decode import flash_decode
+
+        out = flash_decode(jnp.asarray(q, dt), jnp.asarray(k, dt),
+                           jnp.asarray(v, dt), lens, **kw)
+    elif config.family == "paged":
+        from attention_tpu.ops.paged import PagePool, paged_from_dense, \
+            paged_flash_decode
+
+        num_pages = config.m * (config.n // PAGE_SIZE)
+        pool = PagePool(num_pages)
+        cache = paged_from_dense(jnp.asarray(k, dt), jnp.asarray(v, dt),
+                                 lens, pool, num_pages=num_pages,
+                                 page_size=PAGE_SIZE)
+        out = paged_flash_decode(jnp.asarray(q, dt), cache, **kw)
+    elif config.family == "int8":
+        from attention_tpu.ops.quant import flash_decode_quantized, \
+            quantize_kv
+
+        cache = quantize_kv(jnp.asarray(k, jnp.float32),
+                            jnp.asarray(v, jnp.float32))
+        out = flash_decode_quantized(jnp.asarray(q, jnp.float32), cache,
+                                     lens, **kw)
+    elif config.family == "int4":
+        from attention_tpu.ops.quant import flash_decode_int4, \
+            quantize_kv_int4
+
+        cache = quantize_kv_int4(jnp.asarray(k, jnp.float32),
+                                 jnp.asarray(v, jnp.float32))
+        out = flash_decode_int4(jnp.asarray(q, jnp.float32), cache,
+                                lens, **kw)
+    else:
+        raise ValueError(f"unknown family {config.family!r}")
+    return np.asarray(out, np.float64)
+
+
+def run_case(config: FuzzConfig, *,
+             defect: Callable[[np.ndarray], np.ndarray] | None = None
+             ) -> CaseResult:
+    """Run one config against the oracle and the tolerance ledger."""
+    config.validate()
+    q, k, v, lengths = _case_inputs(config)
+    # the kernel reads rounded inputs; so must the oracle
+    qr = _round_to(q, config.dtype)
+    kr = _round_to(k, config.dtype)
+    vr = _round_to(v, config.dtype)
+    got = _run_kernel(config, q, k, v, lengths)
+    if defect is not None:
+        got = defect(got)
+    if config.family == "flash":
+        want = oracle_masked(qr, kr, vr, causal=config.causal,
+                             window=config.window, sinks=config.sinks,
+                             softcap=config.softcap)
+        min_band = None
+    else:
+        want = _decode_oracle(config, qr, kr, vr, lengths)
+        min_band = int(np.min(lengths))
+    tol = tolerance_for(config.family, window=config.window,
+                        min_band=min_band)
+    stats = verify_scan(want, got, threshold=tol)
+    result = CaseResult(
+        config=config, ok=stats.ok, tolerance=tol,
+        max_abs_err=stats.max_abs_err, mismatches=stats.mismatches,
+        total=stats.total, message=stats.message,
+    )
+    _CASES.inc(family=config.family,
+               result="pass" if result.ok else "fail")
+    return result
+
+
+# ------------------------------------------------------------- campaign
+
+
+@dataclasses.dataclass
+class CampaignReport:
+    seed: int
+    results: list[CaseResult]
+
+    @property
+    def failures(self) -> list[CaseResult]:
+        return [r for r in self.results if not r.ok]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "cases": len(self.results),
+            "failures": len(self.failures),
+            "results": [r.to_dict() for r in self.results],
+        }
+
+
+def run_campaign(seed: int, cases: int, *,
+                 families: Sequence[str] = FAMILIES,
+                 defect: Callable[[np.ndarray], np.ndarray] | None = None,
+                 log: Callable[[str], None] | None = None
+                 ) -> CampaignReport:
+    """Sample and run ``cases`` configs; fully deterministic in
+    ``seed`` (the case list is fixed before any case runs)."""
+    results = []
+    for i, config in enumerate(sample_campaign(seed, cases,
+                                               families=families)):
+        r = run_case(config, defect=defect)
+        if log is not None:
+            log(f"case {i}: {config.family} "
+                f"{'ok' if r.ok else 'FAIL'} "
+                f"max_abs_err={r.max_abs_err:.2e} tol={r.tolerance:g}")
+        results.append(r)
+    return CampaignReport(seed=seed, results=results)

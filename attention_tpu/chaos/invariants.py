@@ -1,0 +1,138 @@
+"""Engine invariant checkers: what must hold no matter what faults fly.
+
+Each checker returns a list of human-readable violation strings (empty
+= invariant holds) and ticks the shared ``chaos.invariant.violations``
+counter, so a fault campaign's verdict is observable through the obs
+registry like every other subsystem.
+
+The four invariants the fault harness pins (ISSUE 4):
+
+1. **Page/refcount conservation** — the `PagePool` free list and
+   refcounts stay mutually consistent, and a drained engine holds
+   pages ONLY through its prefix cache (each cached page at refcount
+   exactly 1: the cache's own reference).
+2. **Token parity** — requests a fault plan did not touch produce
+   byte-identical token streams to a fault-free run of the same trace
+   (faults are isolated: preemption storms and a neighbor's corrupted
+   pages must not leak into anyone else's sampling).
+3. **Termination** — the engine drains every trace within a step
+   bound; no fault plan may wedge the step loop.
+4. **Typed errors** — anything that does escape the step loop is one
+   of the typed capacity/accounting errors (`OutOfPagesError`,
+   `PageAccountingError`), never a bare RuntimeError three layers down.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+from attention_tpu import obs
+from attention_tpu.ops.paged import OutOfPagesError, PageAccountingError
+
+_VIOLATIONS = obs.counter("chaos.invariant.violations",
+                          "invariant-checker violations, by invariant")
+
+
+def _report(invariant: str, problems: list[str]) -> list[str]:
+    for _ in problems:
+        _VIOLATIONS.inc(invariant=invariant)
+    return [f"{invariant}: {p}" for p in problems]
+
+
+def pool_accounting_violations(pool) -> list[str]:
+    """Free-list/refcount consistency of one `PagePool`: every page is
+    either free (refcount 0, on the free list exactly once) or held
+    (refcount > 0, not on the free list)."""
+    problems = []
+    free = pool._free
+    refs = pool._refs
+    if len(set(free)) != len(free):
+        problems.append("free list holds duplicate page ids")
+    free_set = set(free)
+    for page, r in enumerate(refs):
+        if r < 0:
+            problems.append(f"page {page} refcount {r} < 0")
+        if r == 0 and page not in free_set:
+            problems.append(f"page {page} refcount 0 but not free")
+        if r > 0 and page in free_set:
+            problems.append(f"page {page} refcount {r} but on free list")
+    if pool.free_pages + sum(1 for r in refs if r > 0) != pool.num_pages:
+        problems.append(
+            f"free {pool.free_pages} + held "
+            f"{sum(1 for r in refs if r > 0)} != {pool.num_pages}"
+        )
+    return _report("page_conservation", problems)
+
+
+def engine_quiescence_violations(engine) -> list[str]:
+    """A drained engine (run() returned) must hold pages only through
+    its prefix cache — one cache reference each, nothing leaked by a
+    finished, preempted, or cancelled request."""
+    problems = []
+    if engine.scheduler.waiting:
+        problems.append(
+            f"{len(engine.scheduler.waiting)} request(s) still waiting")
+    if engine.scheduler.running:
+        problems.append(
+            f"{len(engine.scheduler.running)} request(s) still running")
+    alloc = engine.allocator
+    cached = {e.page for e in alloc._prefix.values()}
+    if len(cached) != len(alloc._prefix):
+        problems.append("prefix cache entries share a physical page")
+    for page in range(engine.pool.num_pages):
+        r = engine.pool.refcount(page)
+        if r == 0:
+            continue
+        if page not in cached:
+            problems.append(f"page {page} held (refcount {r}) but not "
+                            "in the prefix cache: leaked")
+        elif r != 1:
+            problems.append(f"cached page {page} refcount {r} != 1 "
+                            "after drain")
+    return _report("page_conservation", problems)
+
+
+def token_parity_violations(
+    baseline: Mapping[str, list[int]],
+    observed: Mapping[str, list[int]],
+    *,
+    exclude: Iterable[str] = (),
+) -> list[str]:
+    """Uninjected requests must match the fault-free run exactly."""
+    excluded = set(exclude)
+    problems = []
+    for rid, want in baseline.items():
+        if rid in excluded:
+            continue
+        got = observed.get(rid)
+        if got != want:
+            problems.append(
+                f"request {rid}: tokens diverged from the fault-free "
+                f"run (got {got}, want {want})"
+            )
+    return _report("token_parity", problems)
+
+
+def termination_violations(finished: bool, error: BaseException | None,
+                           *, max_steps: int) -> list[str]:
+    """The run must drain (or fail TYPED) within the step bound."""
+    problems = []
+    if not finished and error is None:
+        problems.append(f"engine did not drain within {max_steps} steps")
+    if isinstance(error, RuntimeError) and not isinstance(
+            error, OutOfPagesError):
+        # engine.run's max_steps guard surfaces as RuntimeError: a wedge
+        problems.append(f"step loop wedged: {error}")
+    return _report("termination", problems)
+
+
+def typed_error_violations(error: BaseException | None) -> list[str]:
+    """Anything surfacing out of the step loop must be a typed
+    capacity/accounting error."""
+    if error is None or isinstance(error, (OutOfPagesError,
+                                           PageAccountingError)):
+        return []
+    return _report(
+        "typed_errors",
+        [f"untyped {type(error).__name__} escaped the engine: {error}"],
+    )

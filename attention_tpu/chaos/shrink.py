@@ -1,0 +1,154 @@
+"""Shrinker: minimize a failing fuzz config, serialize the repro.
+
+Greedy delta-debugging over :class:`FuzzConfig`: from a failing config,
+repeatedly try simplifying moves (drop a feature flag, collapse GQA,
+halve a dimension, drop bf16) and keep any move after which the case
+STILL fails.  The fixpoint is the minimal repro — the config a human
+debugs instead of the 5-flag monster the fuzzer happened to sample.
+
+Serialization is two-tier, mirroring how much of the config the
+reference's frozen harness can express:
+
+* every minimal config round-trips as ``repro.json``
+  (`cli chaos replay`);
+* a config shrunk into the PLAIN subset (single-head flash, no flags —
+  `FuzzConfig.is_plain`) additionally serializes to the reference's
+  binary ``.bin`` testcase format via `core.testcase.write_testcase`,
+  with the fp64 oracle output appended — so ``cli run`` and even the
+  upstream C binaries replay the exact failing inputs under the frozen
+  ±0.02 contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Iterator
+
+import numpy as np
+
+from attention_tpu.chaos.configs import PAGE_SIZE, FuzzConfig
+from attention_tpu.chaos.fuzzer import CaseResult, _case_inputs, run_case
+from attention_tpu.core.oracle import attention_oracle
+from attention_tpu.core.testcase import TestCase, write_testcase
+
+#: shape floors: small enough to read, large enough that every kernel
+#: family still accepts the shape
+_MIN_MN_FLASH = 16
+_MIN_D = 8
+
+
+def _replace(cfg: FuzzConfig, **kw) -> FuzzConfig:
+    return dataclasses.replace(cfg, **kw)
+
+
+def _moves(cfg: FuzzConfig) -> Iterator[FuzzConfig]:
+    """Candidate simplifications, most-semantic first (drop flags before
+    shrinking shapes, so the minimal repro is plain when possible)."""
+    if cfg.sinks is not None:
+        yield _replace(cfg, sinks=None)
+    if cfg.window is not None:
+        yield _replace(cfg, window=None, sinks=None)
+    if cfg.softcap is not None:
+        yield _replace(cfg, softcap=None)
+    if cfg.causal:
+        yield _replace(cfg, causal=False, window=None, sinks=None)
+    if cfg.ragged:
+        yield _replace(cfg, ragged=False)
+    if (cfg.heads, cfg.kv_heads) != (1, 1):
+        yield _replace(cfg, heads=1, kv_heads=1)
+    if cfg.dtype != "float32":
+        yield _replace(cfg, dtype="float32")
+    if cfg.family == "flash":
+        if cfg.m > _MIN_MN_FLASH:
+            yield _replace(cfg, m=max(cfg.m // 2, _MIN_MN_FLASH))
+        if cfg.n > _MIN_MN_FLASH:
+            yield _replace(cfg, n=max(cfg.n // 2, _MIN_MN_FLASH))
+    else:
+        if cfg.m > 1:
+            yield _replace(cfg, m=1)
+        if cfg.n > PAGE_SIZE:
+            yield _replace(cfg, n=max(cfg.n // 2, PAGE_SIZE))
+    d_floor = max(_MIN_D, 2 if cfg.family == "int4" else 1)
+    if cfg.head_dim > d_floor:
+        yield _replace(cfg, head_dim=max(cfg.head_dim // 2, d_floor))
+
+
+@dataclasses.dataclass
+class ShrinkResult:
+    original: FuzzConfig
+    minimal: FuzzConfig
+    final: CaseResult       # the minimal config's (still failing) run
+    steps: int              # accepted moves
+    attempts: int           # total candidate runs
+
+
+def shrink(config: FuzzConfig, *,
+           defect: Callable[[np.ndarray], np.ndarray] | None = None,
+           max_attempts: int = 64,
+           log: Callable[[str], None] | None = None) -> ShrinkResult:
+    """Minimize ``config`` while it keeps failing its ledger budget.
+
+    Raises ValueError if ``config`` does not fail to begin with (a
+    shrinker that "minimizes" a passing case would manufacture repros
+    out of thin air).
+    """
+    current = run_case(config, defect=defect)
+    if current.ok:
+        raise ValueError(
+            f"config passes its budget (max_abs_err="
+            f"{current.max_abs_err:.3g} <= {current.tolerance:g}); "
+            "nothing to shrink"
+        )
+    steps = attempts = 0
+    progress = True
+    while progress and attempts < max_attempts:
+        progress = False
+        for cand in _moves(current.config):
+            attempts += 1
+            if attempts >= max_attempts:
+                break
+            try:
+                cand.validate()
+                r = run_case(cand, defect=defect)
+            except Exception:  # noqa: BLE001 - an invalid candidate is
+                continue       # just a rejected move, not a failure
+            if not r.ok:
+                if log is not None:
+                    log(f"shrink: kept {cand.to_json()}")
+                current = r
+                steps += 1
+                progress = True
+                break  # restart the move list from the simplified config
+    return ShrinkResult(original=config, minimal=current.config,
+                        final=current, steps=steps, attempts=attempts)
+
+
+# ---------------------------------------------------------- repro files
+
+
+def write_repro_json(path: str | os.PathLike, config: FuzzConfig) -> None:
+    with open(path, "w") as f:
+        f.write(config.to_json())
+        f.write("\n")
+
+
+def read_repro_json(path: str | os.PathLike) -> FuzzConfig:
+    with open(path) as f:
+        return FuzzConfig.from_json(f.read())
+
+
+def write_repro_bin(path: str | os.PathLike, config: FuzzConfig) -> None:
+    """Serialize a PLAIN minimal config to the reference's frozen
+    ``.bin`` format: the exact seeded inputs, with the fp64 oracle
+    output appended — replayable by ``cli run`` (any backend) and the
+    upstream C binaries under the ±0.02 contract."""
+    if not config.is_plain:
+        raise ValueError(
+            "only plain configs (single-head flash, no flags) fit the "
+            f"reference .bin harness; got {config.to_json()}"
+        )
+    q, k, v, _ = _case_inputs(config)
+    q, k, v = q[0], k[0], v[0]  # single head: (m, d)/(n, d)
+    expected = attention_oracle(q, k, v)
+    write_testcase(path, TestCase(q=q, k=k, v=v, expected=expected))

@@ -28,6 +28,17 @@ Usage:
       --format chrome|prom|jsonl [--out timeline.json]
       # unified telemetry (attention_tpu.obs): counters/spans summary,
       # or export — chrome merges host spans with the XLA device lane
+  python -m attention_tpu.cli chaos fuzz --seed 0 --cases 16
+      [--families flash,decode,...] [--inject-failure] [--repro-dir DIR]
+  python -m attention_tpu.cli chaos replay <repro.json|repro.bin>
+  python -m attention_tpu.cli chaos shrink repro.json [--bin repro.bin]
+  python -m attention_tpu.cli chaos faults --seed 0 --plans 5
+      # differential fuzzing + engine fault injection
+      # (attention_tpu.chaos): sampled kernel configs vs the fp64
+      # oracle under the tolerance ledger; failing configs shrink to
+      # minimal repros (plain ones to the reference .bin format `run`
+      # replays); seeded fault plans storm the serving engine under
+      # invariant checkers
 
 Diagnostics (progress notes, warnings) go through the shared
 ``attention_tpu`` stdlib logger, stderr at INFO — the frozen
@@ -114,6 +125,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
     # Exact frozen output contract (attention.c:150-151,184-189): success
     # is "Correct!" + elapsed; failure is the first-mismatch diagnostic on
     # stdout then ONLY "Wrong!", and the exit status is 0 either way.
+    # --stats appends one opt-in full-scan line AFTER the frozen lines
+    # (max-abs-error / mismatch count — `core.testcase.verify_scan`).
     ok, msg = verify(case.expected, result)
     if ok:
         print("Correct!")
@@ -121,6 +134,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
     else:
         print(msg)
         print("Wrong!")
+    if args.stats:
+        from attention_tpu.core.testcase import verify_scan
+
+        print(verify_scan(case.expected, result).stats_line())
     return 0
 
 
@@ -341,6 +358,143 @@ def _cmd_tune(args: argparse.Namespace) -> int:
     return rc
 
 
+def _chaos_defect(args: argparse.Namespace):
+    """The synthetic-failure hook shared by the chaos subcommands."""
+    if not getattr(args, "inject_failure", False):
+        return None
+    from attention_tpu.chaos.fuzzer import synthetic_defect
+
+    return synthetic_defect
+
+
+def _cmd_chaos_fuzz(args: argparse.Namespace) -> int:
+    """Seeded differential fuzz campaign: sampled kernel configs vs the
+    fp64 oracle, judged by the tolerance ledger.  Deterministic: same
+    seed -> same cases -> same report."""
+    import json
+
+    from attention_tpu.chaos.configs import FAMILIES
+    from attention_tpu.chaos.fuzzer import run_campaign
+    from attention_tpu.chaos.shrink import write_repro_json
+
+    families = (args.families.split(",") if args.families
+                else list(FAMILIES))
+    for fam in families:
+        if fam not in FAMILIES:
+            print(f"unknown family {fam!r}; known: {list(FAMILIES)}",
+                  file=sys.stderr)
+            return 2
+    report = run_campaign(args.seed, args.cases, families=families,
+                          defect=_chaos_defect(args), log=_logger.info)
+    if args.repro_dir and report.failures:
+        import os
+
+        os.makedirs(args.repro_dir, exist_ok=True)
+        for i, r in enumerate(report.failures):
+            path = os.path.join(args.repro_dir, f"repro-{i}.json")
+            write_repro_json(path, r.config)
+            _logger.info("wrote failing-config repro: %s", path)
+    print(json.dumps(report.to_dict(), sort_keys=True))
+    return 0 if report.ok else 1
+
+
+def _cmd_chaos_replay(args: argparse.Namespace) -> int:
+    """Re-run one repro: a `.bin` replays through the frozen run
+    harness semantics (backend result vs embedded expected), a `.json`
+    re-runs the exact fuzz case.  Exit 0 iff the case passes."""
+    import json
+
+    if args.repro.endswith(".bin"):
+        from attention_tpu import attention
+        from attention_tpu.core.testcase import read_testcase, verify_scan
+
+        case = read_testcase(args.repro)
+        if case.expected is None:
+            print(f"no expected output in {args.repro}", file=sys.stderr)
+            return 2
+        result = np.asarray(
+            attention(case.q, case.k, case.v, backend=args.backend),
+            dtype=np.float64,
+        )
+        scan = verify_scan(case.expected, result)
+        print("Correct!" if scan.ok else f"{scan.message}\nWrong!")
+        print(scan.stats_line())
+        return 0 if scan.ok else 1
+    from attention_tpu.chaos.fuzzer import run_case
+    from attention_tpu.chaos.shrink import read_repro_json
+
+    result = run_case(read_repro_json(args.repro),
+                      defect=_chaos_defect(args))
+    print(json.dumps(result.to_dict(), sort_keys=True))
+    return 0 if result.ok else 1
+
+
+def _cmd_chaos_shrink(args: argparse.Namespace) -> int:
+    """Minimize a failing repro config; write the minimal `.json` and,
+    when the minimum is plain single-head attention, the reference
+    `.bin` testcase that `cli run` replays."""
+    import json
+
+    from attention_tpu.chaos.shrink import (
+        read_repro_json,
+        shrink,
+        write_repro_bin,
+        write_repro_json,
+    )
+
+    config = read_repro_json(args.repro)
+    try:
+        res = shrink(config, defect=_chaos_defect(args),
+                     log=_logger.info)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.out:
+        write_repro_json(args.out, res.minimal)
+        _logger.info("wrote minimal repro: %s", args.out)
+    wrote_bin = None
+    if args.bin:
+        if res.minimal.is_plain:
+            write_repro_bin(args.bin, res.minimal)
+            wrote_bin = args.bin
+            _logger.info("wrote .bin repro: %s", args.bin)
+        else:
+            _logger.info(
+                ".bin skipped: minimal config is not plain (%s)",
+                res.minimal.to_json())
+    print(json.dumps({
+        "original": json.loads(res.original.to_json()),
+        "minimal": json.loads(res.minimal.to_json()),
+        "steps": res.steps,
+        "attempts": res.attempts,
+        "max_abs_err": res.final.max_abs_err,
+        "tolerance": res.final.tolerance,
+        "bin": wrote_bin,
+    }, sort_keys=True))
+    return 0
+
+
+def _cmd_chaos_faults(args: argparse.Namespace) -> int:
+    """Seeded fault-injection campaign against the serving engine:
+    every plan must hold all four invariants (page conservation, token
+    parity, termination, typed errors).  Exit 0 iff no violations."""
+    import json
+
+    from attention_tpu.chaos.faults import run_campaign
+
+    report = run_campaign(
+        args.seed, num_plans=args.plans, num_requests=args.requests,
+        temperature=args.temperature, events_per_plan=args.events,
+        log=_logger.info,
+    )
+    out = report.to_dict()
+    if not args.outputs:
+        for r in out["reports"]:
+            r.pop("outputs", None)
+    print(json.dumps(out, sort_keys=True))
+    return 0 if report.ok else 1
+
+
 def _obs_load(args: argparse.Namespace):
     """(snapshot, events, device_dir) for an ``obs`` subcommand: from a
     --run dump directory, else the live in-process state (useful when a
@@ -431,6 +585,10 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--repeats", type=int, default=1,
                      help="min-over-repeats timing (reference methodology)")
     run.add_argument("--no-verify", action="store_true")
+    run.add_argument("--stats", action="store_true",
+                     help="append a full-scan statistics line "
+                          "(max-abs-error, mismatch count) after the "
+                          "frozen verdict lines")
     run.set_defaults(fn=_cmd_run)
 
     gen = sub.add_parser("generate", help="write a random testcase + oracle output")
@@ -489,6 +647,64 @@ def main(argv: list[str] | None = None) -> int:
     tn.add_argument("--dry-run", action="store_true",
                     help="search and report but write nothing")
     tn.set_defaults(fn=_cmd_tune)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="differential fuzzing + fault injection "
+             "(attention_tpu.chaos): fuzz kernel configs against the "
+             "fp64 oracle, shrink failures to .bin repros, storm the "
+             "serving engine with seeded fault plans",
+    )
+    chsub = ch.add_subparsers(dest="chaos_cmd", required=True)
+
+    cf = chsub.add_parser("fuzz", help="seeded differential fuzz "
+                                       "campaign vs the tolerance ledger")
+    cf.add_argument("--seed", type=int, default=0)
+    cf.add_argument("--cases", type=int, default=16)
+    cf.add_argument("--families", default=None,
+                    help="comma-separated subset of "
+                         "flash,decode,paged,int8,int4 (default: all)")
+    cf.add_argument("--inject-failure", action="store_true",
+                    help="apply the synthetic defect to every kernel "
+                         "output (pipeline self-test: forces failures)")
+    cf.add_argument("--repro-dir", default=None,
+                    help="write each failing config here as "
+                         "repro-<i>.json")
+    cf.set_defaults(fn=_cmd_chaos_fuzz)
+
+    cr = chsub.add_parser("replay", help="re-run one repro "
+                                         "(.json fuzz config or .bin "
+                                         "testcase)")
+    cr.add_argument("repro")
+    cr.add_argument("--backend", default="flash",
+                    help=".bin replay backend (any `cli backends` "
+                         "name, e.g. chaos-broken)")
+    cr.add_argument("--inject-failure", action="store_true")
+    cr.set_defaults(fn=_cmd_chaos_replay)
+
+    cs = chsub.add_parser("shrink", help="minimize a failing fuzz "
+                                         "config; emit .json/.bin repro")
+    cs.add_argument("repro", help="failing-config repro.json")
+    cs.add_argument("--out", default=None,
+                    help="write the minimal config JSON here")
+    cs.add_argument("--bin", default=None,
+                    help="write a .bin testcase here when the minimal "
+                         "config is plain single-head attention")
+    cs.add_argument("--inject-failure", action="store_true")
+    cs.set_defaults(fn=_cmd_chaos_shrink)
+
+    cfa = chsub.add_parser("faults", help="seeded fault-injection "
+                                          "campaign against the "
+                                          "serving engine")
+    cfa.add_argument("--seed", type=int, default=0)
+    cfa.add_argument("--plans", type=int, default=5)
+    cfa.add_argument("--requests", type=int, default=5)
+    cfa.add_argument("--events", type=int, default=4)
+    cfa.add_argument("--temperature", type=float, default=0.0)
+    cfa.add_argument("--outputs", action="store_true",
+                     help="include per-request token streams in the "
+                          "report JSON")
+    cfa.set_defaults(fn=_cmd_chaos_faults)
 
     ob = sub.add_parser(
         "obs",

@@ -96,11 +96,73 @@ def read_testcase(path: str | os.PathLike, *, with_expected: bool = True) -> Tes
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class VerifyScan:
+    """Full-scan verification statistics (the opt-in mode the chaos
+    fuzzer's tolerance ledger consumes, also surfaced by
+    ``cli run --stats``): not just the first mismatch, but how wrong
+    and how widespread."""
+
+    ok: bool
+    threshold: float
+    max_abs_err: float   # over finite elements (0.0 if none compared)
+    mismatches: int      # elements over threshold OR non-finite
+    nonfinite: int       # NaN/Inf result elements
+    total: int
+    message: str         # the classic first-mismatch diagnostic
+
+    def stats_line(self) -> str:
+        return (f"stats: max_abs_err={self.max_abs_err:.6g} "
+                f"mismatches={self.mismatches}/{self.total} "
+                f"nonfinite={self.nonfinite} "
+                f"threshold={self.threshold:g}")
+
+
+def verify_scan(
+    expected: np.ndarray,
+    result: np.ndarray,
+    *,
+    threshold: float = VERIFY_THRESHOLD,
+) -> VerifyScan:
+    """Full-scan variant of :func:`verify`: same pass/fail semantics,
+    plus max-abs-error and mismatch/non-finite counts over EVERY
+    element.  A shape mismatch reports every element as mismatched."""
+    expected = np.asarray(expected, dtype=np.float64)
+    result = np.asarray(result, dtype=np.float64)
+    if expected.shape != result.shape:
+        return VerifyScan(
+            ok=False, threshold=threshold, max_abs_err=float("inf"),
+            mismatches=max(expected.size, result.size), nonfinite=0,
+            total=max(expected.size, result.size),
+            message=(f"shape mismatch: expected {expected.shape}, "
+                     f"got {result.shape}"),
+        )
+    finite = np.isfinite(result)
+    err = np.abs(result - expected)
+    bad = ~finite | (err > threshold)
+    max_err = float(err[finite].max()) if finite.any() else 0.0
+    if not bad.any():
+        return VerifyScan(ok=True, threshold=threshold,
+                          max_abs_err=max_err, mismatches=0,
+                          nonfinite=0, total=result.size,
+                          message="Correct!")
+    idx = np.unravel_index(np.argmax(bad), bad.shape)
+    loc = "][".join(str(i) for i in idx)
+    return VerifyScan(
+        ok=False, threshold=threshold, max_abs_err=max_err,
+        mismatches=int(bad.sum()), nonfinite=int((~finite).sum()),
+        total=result.size,
+        message=(f"Expect result[{loc}] to be {expected[idx]:f}, "
+                 f"but it is {result[idx]:f}"),
+    )
+
+
 def verify(
     expected: np.ndarray,
     result: np.ndarray,
     *,
     threshold: float = VERIFY_THRESHOLD,
+    full_scan: bool = False,
 ) -> tuple[bool, str]:
     """Elementwise tolerance check, mirroring `verify` (`attention.c:123-162`).
 
@@ -109,7 +171,16 @@ def verify(
     print (`attention.c:151`).  Unlike the reference, every element is
     NaN-checked (the reference only checks column 1 of each row,
     `attention.c:150` — a known quirk we fix).
+
+    ``full_scan=True`` appends max-abs-error / mismatch-count statistics
+    to the failure message (see :func:`verify_scan` for the structured
+    form); the default message stays byte-identical to the reference's.
     """
+    if full_scan:
+        scan = verify_scan(expected, result, threshold=threshold)
+        msg = scan.message if scan.ok \
+            else f"{scan.message} [{scan.stats_line()}]"
+        return scan.ok, msg
     expected = np.asarray(expected, dtype=np.float64)
     result = np.asarray(result, dtype=np.float64)
     if expected.shape != result.shape:

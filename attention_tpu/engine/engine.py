@@ -48,6 +48,9 @@ from attention_tpu.engine.request import Request, RequestState, SamplingParams
 from attention_tpu.engine.scheduler import ScheduledStep, Scheduler
 from attention_tpu.ops.paged import OutOfPagesError, PagedKV, PagePool
 
+_CANCELLED = obs.counter("engine.requests.cancelled",
+                         "requests cancelled mid-flight")
+
 
 @functools.partial(jax.jit, static_argnames=("model",))
 def _paged_apply(model, params, tokens, caches):
@@ -184,6 +187,30 @@ class ServingEngine:
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
         return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a request anywhere in its lifecycle (client gone).
+
+        Frees its pages (prefix-cache references, if any, survive — a
+        cancelled prompt's committed pages stay reusable), drops its
+        RNG chain, removes it from the queue or the running set, and
+        transitions it to the terminal CANCELLED state.  Safe to call
+        between steps only (the scheduler's contract); returns False
+        when no live request has that id."""
+        for queue in (self.scheduler.waiting, self.scheduler.running):
+            for req in queue:
+                if req.request_id != request_id:
+                    continue
+                queue.remove(req)
+                _CANCELLED.inc()
+                if req.pages:
+                    self.allocator.free(req.pages)
+                req.pages = []
+                req.transition(RequestState.CANCELLED)
+                self._rng_keys.pop(req.request_id, None)
+                self._wall.pop(req.request_id, None)
+                return True
+        return False
 
     # -- step loop --------------------------------------------------------
 

@@ -28,22 +28,31 @@ class RequestState(enum.Enum):
     DECODING = "decoding"
     FINISHED = "finished"
     PREEMPTED = "preempted"
+    CANCELLED = "cancelled"
 
 
 # legal lifecycle edges; PREFILLING -> FINISHED covers max_tokens == 1
 # (the first token is sampled at prefill completion and already ends
-# the request)
+# the request).  CANCELLED is reachable from every non-terminal state
+# (`ServingEngine.cancel` — a client abandoning the request), and is
+# terminal like FINISHED.
 _TRANSITIONS: dict[RequestState, frozenset[RequestState]] = {
-    RequestState.WAITING: frozenset({RequestState.PREFILLING}),
+    RequestState.WAITING: frozenset(
+        {RequestState.PREFILLING, RequestState.CANCELLED}
+    ),
     RequestState.PREFILLING: frozenset(
         {RequestState.DECODING, RequestState.FINISHED,
-         RequestState.PREEMPTED}
+         RequestState.PREEMPTED, RequestState.CANCELLED}
     ),
     RequestState.DECODING: frozenset(
-        {RequestState.FINISHED, RequestState.PREEMPTED}
+        {RequestState.FINISHED, RequestState.PREEMPTED,
+         RequestState.CANCELLED}
     ),
-    RequestState.PREEMPTED: frozenset({RequestState.PREFILLING}),
+    RequestState.PREEMPTED: frozenset(
+        {RequestState.PREFILLING, RequestState.CANCELLED}
+    ),
     RequestState.FINISHED: frozenset(),
+    RequestState.CANCELLED: frozenset(),
 }
 
 

@@ -115,6 +115,19 @@ def pytest_configure(config):
         "registry, spans, exporters, merged timeline; CPU-only, "
         "tier-1 fast",
     )
+    # the chaos tier (tests/test_chaos.py): fuzz smoke campaigns stay
+    # tier-1 (<=~30 s CPU); long campaigns also carry `slow` and are
+    # excluded by tier-1's `-m 'not slow'`
+    config.addinivalue_line(
+        "markers",
+        "chaos: differential fuzzing + fault injection "
+        "(attention_tpu/chaos/) — seeded fuzz/fault campaigns, "
+        "shrinker, invariant checkers; CPU-only",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running campaigns/sweeps excluded from tier-1",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

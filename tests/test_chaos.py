@@ -1,0 +1,425 @@
+"""Chaos subsystem tests (attention_tpu/chaos/).
+
+Two arms, like the subsystem: (1) the differential fuzzer — seeded
+determinism, the tolerance ledger, and the full fuzz→shrink→`.bin`→
+`cli run` repro pipeline exercised against a synthetic injected
+failure; (2) the fault-injection harness — five seeded plans against
+the serving engine pinning all four invariants (page conservation,
+token parity, termination, typed errors), plus the targeted regression
+scenarios: RNG chains byte-identically restored across forced
+preemption, corruption contained to its target, admission starvation
+surfacing as a TYPED error.
+
+Everything rides tier-1 (smoke-sized campaigns); the broad campaign at
+the bottom carries `slow`.
+"""
+
+import dataclasses
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from attention_tpu import obs
+from attention_tpu.chaos import (
+    DEFECT_AMPLITUDE,
+    FAMILIES,
+    FaultEvent,
+    FaultPlan,
+    FuzzConfig,
+    oracle_masked,
+    random_plan,
+    run_case,
+    run_fault_campaign,
+    run_fuzz_campaign,
+    run_plan,
+    sample_campaign,
+    shrink,
+    synthetic_defect,
+    tolerance_for,
+    write_repro_bin,
+    write_repro_json,
+)
+from attention_tpu.chaos.budgets import CONTRACT_TOL, FAMILY_BUDGETS
+from attention_tpu.chaos.faults import build_sim_model, default_engine_config
+from attention_tpu.core.oracle import attention_oracle
+from attention_tpu.core.testcase import verify, verify_scan
+from attention_tpu.engine.engine import ServingEngine
+from attention_tpu.engine.request import RequestState, SamplingParams
+from attention_tpu.engine.sim import replay, synthetic_trace
+from attention_tpu.ops.paged import OutOfPagesError, PagePool
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ tolerance
+# ledger + verify full-scan
+
+
+def test_budget_ledger_values():
+    # the contract families sit exactly on the frozen ±0.02 threshold
+    for fam in ("flash", "decode", "paged", "int8"):
+        assert tolerance_for(fam) == CONTRACT_TOL == 0.02
+    # int4 is measured, wider, and widens again when the attended band
+    # is narrow (a window, or a short ragged prefix)
+    assert tolerance_for("int4") == FAMILY_BUDGETS["int4"] > CONTRACT_TOL
+    short = FAMILY_BUDGETS["int4_short"]
+    assert short > FAMILY_BUDGETS["int4"]
+    assert tolerance_for("int4", window=24) == short
+    assert tolerance_for("int4", min_band=8) == short
+    assert tolerance_for("int4", min_band=128) == FAMILY_BUDGETS["int4"]
+    with pytest.raises(ValueError, match="no tolerance budget"):
+        tolerance_for("fp8")
+
+
+def test_tolerance_lint_passes_and_catches_drift(tmp_path):
+    lint = _load_script("check_tolerances")
+    assert lint.check(os.path.join(_REPO, "PARITY.md")) == []
+    # a drifted copy must be caught
+    with open(os.path.join(_REPO, "PARITY.md")) as f:
+        text = f.read()
+    drifted = tmp_path / "PARITY.md"
+    drifted.write_text(text.replace("| `int4` | 0.25 |",
+                                    "| `int4` | 0.04 |"))
+    problems = lint.check(str(drifted))
+    assert any("int4" in p for p in problems)
+
+
+def test_verify_scan_reports_full_statistics():
+    want = np.zeros((4, 4))
+    got = np.zeros((4, 4))
+    got[0, 0] = 0.5        # over threshold
+    got[1, 1] = 0.019      # inside threshold
+    got[2, 2] = np.nan     # non-finite
+    scan = verify_scan(want, got, threshold=0.02)
+    assert not scan.ok
+    assert scan.mismatches == 2 and scan.nonfinite == 1
+    assert scan.total == 16
+    assert scan.max_abs_err == pytest.approx(0.5)
+    assert "max_abs_err=0.5" in scan.stats_line()
+    # the frozen first-mismatch diagnostic survives unchanged...
+    ok, msg = verify(want, got)
+    assert not ok and msg.startswith("Expect result[0][0]")
+    # ...and full_scan appends the statistics to the same message
+    ok, full = verify(want, got, full_scan=True)
+    assert not ok and full.startswith(msg) and "mismatches=2/16" in full
+    ok, msg = verify(want, want, full_scan=True)
+    assert ok and msg == "Correct!"
+
+
+# --------------------------------------------------------------- fuzzer
+
+
+def test_campaign_sampling_is_deterministic_and_valid():
+    a = sample_campaign(123, 32)
+    b = sample_campaign(123, 32)
+    assert [c.to_json() for c in a] == [c.to_json() for c in b]
+    assert sample_campaign(124, 8) != sample_campaign(123, 8)
+    assert {c.family for c in a} == set(FAMILIES)  # 32 draws cover all
+    for c in a:
+        c.validate()
+
+
+def test_oracle_masked_plain_matches_serial_oracle(rng):
+    q = rng.standard_normal((1, 24, 16))
+    k = rng.standard_normal((1, 32, 16))
+    v = rng.standard_normal((1, 32, 16))
+    got = oracle_masked(q, k, v)
+    want = attention_oracle(q[0], k[0], v[0])
+    np.testing.assert_allclose(got[0], want, atol=1e-12)
+
+
+def test_fuzz_smoke_campaign_green_and_deterministic():
+    """The tier-1 fuzz gate: a small seeded campaign across every
+    family runs green against the ledger, and reruns byte-identically
+    (same seed -> same cases -> same report)."""
+    rep1 = run_fuzz_campaign(7, 6)
+    assert rep1.ok, [r.message for r in rep1.failures]
+    rep2 = run_fuzz_campaign(7, 6)
+    assert rep1.to_dict() == rep2.to_dict()
+    assert {r.config.family for r in rep1.results} <= set(FAMILIES)
+
+
+def test_injected_failure_shrinks_to_bin_replayed_by_cli_run(tmp_path,
+                                                            capsys):
+    """The repro pipeline, end to end: a synthetic defect on a
+    many-flag config fails its budget, shrinks to a PLAIN minimal
+    config, serializes to the reference `.bin` format, and `cli run`
+    replays it to the same Wrong! verdict through the frozen harness
+    (while a correct backend replays Correct!)."""
+    from attention_tpu.cli import main as cli_main
+
+    config = FuzzConfig(family="flash", m=64, n=64, heads=4, kv_heads=2,
+                        head_dim=16, dtype="bfloat16", causal=True,
+                        window=16, sinks=4, softcap=15.0, seed=41)
+    failing = run_case(config, defect=synthetic_defect)
+    assert not failing.ok
+    assert failing.max_abs_err == pytest.approx(DEFECT_AMPLITUDE, rel=0.2)
+
+    res = shrink(config, defect=synthetic_defect)
+    assert not res.final.ok and res.steps > 0
+    # every flag dropped, GQA collapsed, shape floored: plain
+    assert res.minimal.is_plain
+    assert res.minimal.m <= 16 and res.minimal.head_dim <= 8
+
+    bin_path = tmp_path / "repro.bin"
+    write_repro_bin(bin_path, res.minimal)
+
+    rc = cli_main(["run", str(bin_path), "--backend", "chaos-broken",
+                   "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0  # frozen contract: exit 0 either verdict
+    assert "Wrong!" in out and "Correct!" not in out
+    assert "mismatches=1/" in out  # the full-scan stats line
+
+    rc = cli_main(["run", str(bin_path), "--backend", "oracle"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Correct!" in out
+
+
+def test_shrink_refuses_passing_config():
+    ok_config = FuzzConfig(family="flash", m=16, n=16, heads=1,
+                           kv_heads=1, head_dim=8, seed=3)
+    with pytest.raises(ValueError, match="nothing to shrink"):
+        shrink(ok_config)
+
+
+def test_repro_json_roundtrip(tmp_path):
+    from attention_tpu.chaos import read_repro_json
+
+    config = FuzzConfig(family="int4", m=2, n=256, heads=2, kv_heads=1,
+                        head_dim=64, window=24, sinks=4, ragged=True,
+                        seed=9)
+    path = tmp_path / "repro.json"
+    write_repro_json(path, config)
+    assert read_repro_json(path) == config
+
+
+def test_fuzz_counters_tick_when_obs_enabled():
+    was = obs.is_enabled()
+    obs.enable()
+    obs.reset()
+    try:
+        run_case(FuzzConfig(family="flash", m=16, n=16, heads=1,
+                            kv_heads=1, head_dim=8, seed=3))
+        snap = obs.REGISTRY.snapshot()
+        cases = [s for s in snap["counters"]
+                 if s["name"] == "chaos.fuzz.cases"]
+        assert cases and cases[0]["labels"]["result"] == "pass"
+    finally:
+        obs.reset()
+        (obs.enable if was else obs.disable)()
+
+
+def test_cli_chaos_fuzz_deterministic(capsys):
+    """Acceptance: `cli chaos fuzz --seed S` is fully deterministic —
+    same seed, same cases, same ledger report, byte for byte."""
+    from attention_tpu.cli import main as cli_main
+
+    argv = ["chaos", "fuzz", "--seed", "5", "--cases", "3",
+            "--families", "flash"]
+    assert cli_main(argv) == 0
+    first = capsys.readouterr().out
+    assert cli_main(argv) == 0
+    assert capsys.readouterr().out == first
+    assert cli_main(["chaos", "fuzz", "--seed", "6", "--cases", "3",
+                     "--families", "flash"]) == 0
+    assert capsys.readouterr().out != first
+
+
+# ---------------------------------------------------------------- faults
+
+
+@pytest.fixture(scope="module")
+def sim_model():
+    return build_sim_model()
+
+
+@pytest.fixture(scope="module")
+def fault_fixture(sim_model):
+    """Shared trace + fault-free baseline for the plan-level tests."""
+    model, params = sim_model
+    config = default_engine_config()
+    trace = synthetic_trace(5, vocab=model.vocab, seed=11, max_tokens=6,
+                            temperature=0.7)
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    return model, params, config, trace, baseline
+
+
+def test_fault_campaign_five_seeded_plans_hold_invariants(sim_model):
+    """Acceptance: >= 5 distinct seeded fault plans, all four
+    invariants checked on every one (run_plan wires page conservation,
+    token parity vs the baseline, termination, and typed errors into
+    `violations`)."""
+    model, params = sim_model
+    rep = run_fault_campaign(3, num_plans=5, model=model, params=params)
+    assert len(rep.reports) == 5
+    assert rep.total_injected > 0
+    seeds = {r.plan.seed for r in rep.reports}
+    assert len(seeds) == 5
+    for r in rep.reports:
+        assert r.violations == [], r.violations
+
+
+def test_rng_chains_restored_after_forced_preemption(fault_fixture):
+    """Regression (ISSUE 4 satellite): a preemption storm mid-decode
+    must not disturb any request's seeded RNG chain — sampled streams
+    (temperature 0.7) are byte-identical to the fault-free run."""
+    model, params, config, trace, baseline = fault_fixture
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=4, kind="preempt", arg=2),
+        FaultEvent(step=7, kind="preempt", arg=1),
+    ))
+    r = run_plan(model, params, config, trace, plan, baseline=baseline)
+    assert r.preemptions >= 3  # the storms actually fired
+    assert r.violations == [], r.violations  # parity included
+    assert r.outputs == baseline  # byte-identical streams
+
+
+def test_corruption_contained_to_target(fault_fixture):
+    model, params, config, trace, baseline = fault_fixture
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=5, kind="corrupt", target="req-1"),
+    ))
+    r = run_plan(model, params, config, trace, plan, baseline=baseline)
+    assert r.corrupted == ["req-1"]
+    assert r.violations == [], r.violations
+    # the NaN payload really changed the target's stream...
+    assert r.outputs["req-1"] != baseline["req-1"]
+    # ...and nobody else's (parity already asserts this; restate the
+    # point explicitly)
+    for rid, toks in baseline.items():
+        if rid != "req-1":
+            assert r.outputs[rid] == toks
+
+
+def test_cancellation_and_watermark_flap(fault_fixture):
+    model, params, config, trace, baseline = fault_fixture
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=3, kind="watermark", arg=3),
+        FaultEvent(step=5, kind="cancel", target="req-3"),
+        FaultEvent(step=6, kind="watermark", arg=0),
+    ))
+    r = run_plan(model, params, config, trace, plan, baseline=baseline)
+    assert r.cancelled == ["req-3"]
+    assert r.violations == [], r.violations
+    # cancelled mid-flight: a partial (possibly empty) stream
+    assert len(r.outputs.get("req-3", [])) <= len(baseline["req-3"])
+
+
+def test_admission_starvation_surfaces_typed_error(fault_fixture):
+    """An unbounded admission-path OOM window can never admit anyone:
+    the engine must fail FAST and TYPED (OutOfPagesError from the
+    stall detector), not wedge — and page accounting must survive."""
+    model, params, config, trace, _ = fault_fixture
+    plan = FaultPlan(seed=1, events=(
+        FaultEvent(step=0, kind="oom", arg=10_000),
+    ))
+    r = run_plan(model, params, config, trace, plan)
+    assert r.surfaced_error == "OutOfPagesError"
+    assert not r.drained
+    assert r.violations == [], r.violations
+
+
+def test_fault_plan_json_roundtrip_and_determinism():
+    ids = [f"req-{i}" for i in range(5)]
+    p1 = random_plan(77, ids)
+    p2 = random_plan(77, ids)
+    assert p1 == p2
+    assert random_plan(78, ids) != p1
+    assert FaultPlan.from_json(p1.to_json()) == p1
+    kinds = {e.kind for e in p1.events}
+    assert kinds  # events sampled from the documented kind set
+    from attention_tpu.chaos import FAULT_KINDS
+
+    assert kinds <= set(FAULT_KINDS)
+
+
+def test_engine_cancel_lifecycle(sim_model):
+    model, params = sim_model
+    engine = ServingEngine(model, params, default_engine_config())
+    waiting = engine.add_request([1, 2, 3], SamplingParams(max_tokens=2))
+    running = engine.add_request([4, 5, 6], SamplingParams(max_tokens=4))
+    engine.step()  # admits/prefills in arrival order
+    assert engine.cancel(waiting.request_id)
+    assert waiting.state is RequestState.CANCELLED
+    assert not engine.cancel("no-such-request")
+    engine.run()
+    assert running.state in (RequestState.FINISHED,
+                             RequestState.CANCELLED)
+    # cancelled requests leak nothing
+    from attention_tpu.chaos.invariants import (
+        engine_quiescence_violations,
+        pool_accounting_violations,
+    )
+
+    assert pool_accounting_violations(engine.pool) == []
+    assert engine_quiescence_violations(engine) == []
+
+
+def test_invariant_checkers_catch_seeded_violations():
+    from attention_tpu.chaos.invariants import (
+        pool_accounting_violations,
+        token_parity_violations,
+    )
+
+    pool = PagePool(4)
+    pool.alloc(2)
+    assert pool_accounting_violations(pool) == []
+    pool._refs[3] = 5  # page 3 still on the free list: corruption
+    problems = pool_accounting_violations(pool)
+    assert any("page 3" in p for p in problems)
+
+    base = {"a": [1, 2], "b": [3]}
+    assert token_parity_violations(base, {"a": [1, 2], "b": [9]},
+                                   exclude=["b"]) == []
+    bad = token_parity_violations(base, {"a": [1, 2], "b": [9]})
+    assert len(bad) == 1 and "b" in bad[0]
+
+
+def test_faults_counters_tick_when_obs_enabled(fault_fixture):
+    model, params, config, trace, _ = fault_fixture
+    was = obs.is_enabled()
+    obs.enable()
+    obs.reset()
+    try:
+        plan = FaultPlan(seed=0, events=(
+            FaultEvent(step=4, kind="preempt", arg=1),
+        ))
+        run_plan(model, params, config, trace, plan)
+        snap = obs.REGISTRY.snapshot()
+        names = {s["name"] for s in snap["counters"]}
+        assert "chaos.faults.injected" in names
+    finally:
+        obs.reset()
+        (obs.enable if was else obs.disable)()
+
+
+# ----------------------------------------------------- long campaigns
+
+
+@pytest.mark.slow
+def test_broad_fuzz_campaign_all_families():
+    """The long arm: a wider seeded sweep across every family.  Not
+    tier-1 (`-m slow`); the smoke campaign above is the gate."""
+    rep = run_fuzz_campaign(2024, 48)
+    assert rep.ok, [r.to_dict() for r in rep.failures]
+
+
+@pytest.mark.slow
+def test_broad_fault_campaign():
+    rep = run_fault_campaign(2024, num_plans=12, num_requests=6,
+                             temperature=0.7)
+    assert rep.ok, [r.violations for r in rep.reports if not r.ok]

@@ -124,6 +124,38 @@ def test_allocator_watermark_and_fragmentation():
     assert sorted(alloc.allocate(8, for_decode=True)) == list(range(8))
 
 
+def test_allocator_exact_watermark_boundary():
+    """Regression (ISSUE 4 satellite): one allocation landing EXACTLY
+    on the watermark boundary must succeed — ``free - n == watermark``
+    is legal, ``free - n == watermark - 1`` is not (the off-by-one
+    class the chaos fuzzer's watermark-flap plans also cover), and the
+    decode path may drain to exactly zero."""
+    pool = PagePool(8)
+    alloc = BlockAllocator(pool, 128, watermark_pages=2)
+    got = alloc.allocate(6)              # 8 - 6 == 2 == watermark: OK
+    assert pool.free_pages == 2
+    with pytest.raises(OutOfPagesError, match="watermark"):
+        alloc.allocate(1)                # 2 - 1 < watermark
+    alloc.free([got.pop()])
+    assert pool.free_pages == 3
+    got += alloc.allocate(1)             # back ON the boundary: OK
+    assert pool.free_pages == 2
+    # decode may consume the entire reserve, to exactly zero free
+    got += alloc.allocate(2, for_decode=True)
+    assert pool.free_pages == 0
+    with pytest.raises(OutOfPagesError):
+        alloc.allocate(1, for_decode=True)
+    # an evictable cached page exactly covering the shortfall counts:
+    # eviction runs until the boundary holds, then allocation succeeds
+    alloc.free([got.pop()])
+    page = alloc.allocate(1, for_decode=True)
+    alloc.commit_prefix(list(range(128)), page, now=0)
+    alloc.free(page)                     # cache holds the only ref
+    assert pool.free_pages == 0 and alloc.cached_pages == 1
+    got += alloc.allocate(1, for_decode=True)  # evicts, then fits
+    assert alloc.cached_pages == 0 and pool.free_pages == 0
+
+
 def test_allocator_prefix_cache_hit_miss_eviction():
     pool = PagePool(6)
     alloc = BlockAllocator(pool, 4, watermark_pages=0)  # tiny pages
