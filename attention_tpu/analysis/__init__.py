@@ -1,0 +1,47 @@
+"""attention_tpu.analysis — AST-based static analysis for this tree.
+
+The static half of the correctness story (the runtime half is
+``attention_tpu.obs`` + ``attention_tpu.chaos``): JAX/Pallas-aware
+passes that flag, before anything traces or compiles,
+
+- trace-purity violations (ATP1xx, `purity`),
+- Pallas block/grid/out_shape contract breaks (ATP2xx, `pallas`),
+- silent low-precision accumulation (ATP3xx, `precision`),
+- error-taxonomy drift (ATP4xx, `errors`),
+- tree conventions — the absorbed ``scripts/check_*`` lints and the
+  source-only guard (ATP5xx/ATP601, `conventions`).
+
+Entry points: ``cli analyze`` (text/JSON/SARIF, ``--changed``),
+``scripts/check_all.py`` (the tier-1 gate), and `core.analyze` as a
+library.  Inline suppression: ``# atp: disable=ATP###``.  Accepted
+legacy findings: ``analysis/baseline.json`` (every entry justified).
+
+Importing this package registers every pass (the submodule imports
+below are the registration mechanism, not conveniences).
+"""
+
+from attention_tpu.analysis.core import (  # noqa: F401
+    CODES,
+    PASSES,
+    Finding,
+    Severity,
+    analyze,
+    analyze_file,
+    iter_source_files,
+    repo_root,
+)
+from attention_tpu.analysis import (  # noqa: F401  (pass registration)
+    conventions,
+    errors,
+    pallas,
+    precision,
+    purity,
+)
+from attention_tpu.analysis.report import (  # noqa: F401
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    render_json,
+    render_sarif,
+    render_text,
+)

@@ -1,0 +1,305 @@
+"""Convention passes: the absorbed ``scripts/check_*`` lints + guards.
+
+The three ad-hoc tree lints that predate this framework —
+``check_obs_names.py`` (telemetry naming), ``check_shipped_table.py``
+(tuning-table schema), ``check_tolerances.py`` (PARITY.md ledger vs
+``chaos/budgets.py``) — are registered here as first-class passes with
+stable codes, so one ``cli analyze`` run is the whole gate.  The
+scripts survive as thin wrappers over the same functions with their
+original stdout/exit-code contracts (CI and muscle memory keep
+working); the logic lives here, once.
+
+ATP601 is the guard that keeps the tree source-only by construction:
+a committed ``.pyc`` under ``attention_tpu/`` once matched a source
+grep during triage — build droppings in the *index* (gitignore only
+shields the worktree) now fail the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import subprocess
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    file_pass,
+    project_pass,
+    register_code,
+)
+
+ATP501 = register_code(
+    "ATP501", "obs-naming", Severity.ERROR,
+    "literal telemetry name violates layer.component.verb "
+    "(absorbed scripts/check_obs_names.py)")
+ATP502 = register_code(
+    "ATP502", "shipped-table-schema", Severity.ERROR,
+    "committed tuning table fails schema/key/tile validation "
+    "(absorbed scripts/check_shipped_table.py)")
+ATP503 = register_code(
+    "ATP503", "tolerance-ledger-drift", Severity.ERROR,
+    "PARITY.md tolerance ledger disagrees with chaos/budgets.py "
+    "(absorbed scripts/check_tolerances.py)")
+ATP601 = register_code(
+    "ATP601", "non-source-tracked-file", Severity.ERROR,
+    "a git-tracked file under attention_tpu/ or tests/ is a build "
+    "dropping (.pyc/.so/__pycache__)")
+
+
+# -- ATP501: telemetry naming ---------------------------------------------
+
+#: call names whose first literal argument must be a telemetry name
+INSTRUMENT_CALLS = {"counter", "gauge", "histogram", "span",
+                    "record_event"}
+
+_OBS_MSG = ("telemetry name {name!r} violates layer.component.verb "
+            "(2-4 lowercase dot-separated [a-z][a-z0-9_]* segments)")
+
+
+def obs_name_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
+    """(line, col, name) for every malformed literal telemetry name."""
+    from attention_tpu.obs.naming import check_name
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None)
+        if name not in INSTRUMENT_CALLS or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue  # non-literal names are runtime-validated
+        if not check_name(first.value):
+            out.append((node.lineno, node.col_offset, first.value))
+    return out
+
+
+@file_pass("obs-naming", [ATP501])
+def check_obs_names(path: str, tree: ast.Module, src: str):
+    """Literal counter/gauge/histogram/span names follow the scheme."""
+    return [Finding(ATP501, _OBS_MSG.format(name=name), path, line, col)
+            for line, col, name in obs_name_violations(tree)]
+
+
+def legacy_obs_check_file(path: str) -> list[str]:
+    """`scripts/check_obs_names.py check_file`: original strings."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}: unparsable ({e})"]
+    return [f"{path}:{line}: " + _OBS_MSG.format(name=name)
+            for line, _col, name in obs_name_violations(tree)]
+
+
+# -- ATP502: shipped tuning table -----------------------------------------
+
+# which tile fields each family's lookup adapter actually reads
+FAMILY_FIELDS = {
+    "flash_fwd": {"block_q", "block_k"},
+    "flash_bwd": {"block_q", "block_k"},
+    "flash_bwd_fused": {"block_q", "block_k"},
+    "decode": {"block_k"},
+    "paged": {"page_size"},
+}
+
+META_FIELDS = {"ms", "source", "recorded"}
+
+
+def _load_no_duplicates(path: str):
+    """json.load that REJECTS duplicate keys instead of last-wins."""
+
+    def hook(pairs):
+        seen = set()
+        for k, _ in pairs:
+            if k in seen:
+                raise ValueError(f"duplicate key {k!r}")
+            seen.add(k)
+        return dict(pairs)
+
+    with open(path) as f:
+        return json.load(f, object_pairs_hook=hook)
+
+
+def shipped_table_problems(path: str) -> list[str]:
+    """Schema/key/tile problems in a tuning table (legacy strings)."""
+    from attention_tpu.tuning.cache import (
+        SCHEMA_VERSION,
+        parse_key,
+        validate_entry,
+    )
+
+    problems = []
+    try:
+        data = _load_no_duplicates(path)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable ({e})"]
+    if data.get("version") != SCHEMA_VERSION:
+        problems.append(
+            f"version {data.get('version')!r} != {SCHEMA_VERSION}")
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        problems.append("'entries' missing or not an object")
+        return problems
+    for key, entry in entries.items():
+        try:
+            fields = parse_key(key)
+            validate_entry(entry)
+        except ValueError as e:
+            problems.append(str(e))
+            continue
+        allowed = FAMILY_FIELDS[fields["kernel"]] | META_FIELDS
+        extra = set(entry) - allowed
+        missing = FAMILY_FIELDS[fields["kernel"]] - set(entry)
+        if extra:
+            problems.append(f"{key}: unknown fields {sorted(extra)}")
+        if missing:
+            problems.append(f"{key}: missing tile fields "
+                            f"{sorted(missing)}")
+    return problems
+
+
+@project_pass("shipped-table", [ATP502])
+def check_shipped_table(root: str):
+    """The committed shipped tuning table passes schema validation."""
+    rel = "attention_tpu/tuning/shipped_table.json"
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return [Finding(ATP502, f"{rel} is missing", rel)]
+    return [Finding(ATP502, p, rel) for p in shipped_table_problems(path)]
+
+
+# -- ATP503: tolerance ledger ---------------------------------------------
+
+LEDGER_SECTION = "## Tolerance ledger"
+#: | `family` | number | basis |
+_ROW_RE = re.compile(
+    r"^\|\s*`(?P<family>[a-z0-9_]+)`\s*\|\s*(?P<tol>[0-9.eE+-]+)\s*\|"
+)
+
+
+def parse_ledger_table(path: str) -> dict[str, float]:
+    """The family -> tolerance rows of PARITY.md's ledger section."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    if LEDGER_SECTION not in text:
+        raise ValueError(f"{path}: no '{LEDGER_SECTION}' section")
+    body = text.split(LEDGER_SECTION, 1)[1]
+    # the section ends at the next heading
+    body = re.split(r"^## ", body, maxsplit=1, flags=re.MULTILINE)[0]
+    out: dict[str, float] = {}
+    for line in body.splitlines():
+        m = _ROW_RE.match(line.strip())
+        if not m:
+            continue
+        family = m.group("family")
+        if family in out:
+            raise ValueError(f"{path}: duplicate ledger row {family!r}")
+        out[family] = float(m.group("tol"))
+    if not out:
+        raise ValueError(f"{path}: ledger section holds no parsable rows")
+    return out
+
+
+def _family_budgets() -> dict[str, float]:
+    """``chaos.budgets.FAMILY_BUDGETS`` without importing the chaos
+    package: its ``__init__`` pulls the engine (and so jax), which
+    would cost the analyzer its seconds-not-minutes contract.  The
+    already-imported module is reused when something else paid for it;
+    otherwise budgets.py (pure data + numpy) loads by file path."""
+    import importlib.util
+    import sys
+
+    mod = sys.modules.get("attention_tpu.chaos.budgets")
+    if mod is None:
+        from attention_tpu.analysis.core import repo_root
+
+        spec = importlib.util.spec_from_file_location(
+            "attention_tpu.chaos.budgets",
+            os.path.join(repo_root(), "attention_tpu", "chaos",
+                         "budgets.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.FAMILY_BUDGETS
+
+
+def tolerance_problems(path: str) -> list[str]:
+    """Ledger-vs-budgets drift problems (legacy strings)."""
+    FAMILY_BUDGETS = _family_budgets()
+
+    try:
+        documented = parse_ledger_table(path)
+    except (OSError, ValueError) as e:
+        return [str(e)]
+    problems = []
+    for family, tol in sorted(FAMILY_BUDGETS.items()):
+        if family not in documented:
+            problems.append(
+                f"budget {family!r} ({tol:g}) missing from {path}")
+        elif documented[family] != tol:
+            problems.append(
+                f"{family!r}: {path} says {documented[family]:g}, "
+                f"chaos/budgets.py says {tol:g}")
+    for family in sorted(set(documented) - set(FAMILY_BUDGETS)):
+        problems.append(
+            f"{path} documents unknown budget {family!r} "
+            f"({documented[family]:g})")
+    return problems
+
+
+@project_pass("tolerance-ledger", [ATP503])
+def check_tolerances(root: str):
+    """PARITY.md's tolerance ledger matches chaos/budgets.py exactly."""
+    path = os.path.join(root, "PARITY.md")
+    if not os.path.isfile(path):
+        return [Finding(ATP503, "PARITY.md is missing", "PARITY.md")]
+    return [Finding(ATP503, p, "PARITY.md")
+            for p in tolerance_problems(path)]
+
+
+# -- ATP601: source-only tree guard ---------------------------------------
+
+#: extensions/components that mark a tracked file as a build dropping
+_NON_SOURCE_SUFFIXES = (".pyc", ".pyo", ".so", ".o", ".a", ".dylib",
+                        ".dll", ".egg")
+_NON_SOURCE_PARTS = {"__pycache__", ".DS_Store", ".egg-info"}
+
+
+def non_source_findings(paths) -> list[Finding]:
+    """Findings for tracked paths that are not source artifacts."""
+    out = []
+    for p in paths:
+        parts = p.replace(os.sep, "/").split("/")
+        if (p.endswith(_NON_SOURCE_SUFFIXES)
+                or any(part in _NON_SOURCE_PARTS for part in parts)):
+            out.append(Finding(
+                ATP601,
+                "tracked build dropping — .gitignore only shields the "
+                "worktree; remove it from the index (git rm --cached)",
+                p))
+    return out
+
+
+@project_pass("source-only-tree", [ATP601])
+def check_source_only(root: str):
+    """No committed .pyc/.so/__pycache__ under attention_tpu/ or tests/."""
+    try:
+        raw = subprocess.run(
+            ["git", "-C", root, "ls-files", "-z", "--",
+             "attention_tpu", "tests"],
+            capture_output=True, timeout=30, check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return []  # not a checkout (e.g. installed wheel): nothing to guard
+    paths = [p.decode("utf-8", "replace")
+             for p in raw.split(b"\0") if p]
+    return non_source_findings(paths)

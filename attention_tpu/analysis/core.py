@@ -1,0 +1,284 @@
+"""Pass registry, visitor plumbing, and the finding model.
+
+The runtime half of the correctness story (obs counters, chaos
+campaigns) catches defects after a kernel traces and produces a wrong
+number; this package is the static half — hazards that are decidable
+from source (impure Python under trace, Pallas contract violations,
+silent low-precision matmuls, error-taxonomy drift) are flagged before
+anything compiles.  Every rule carries a stable ``ATP###`` code:
+
+- findings can be suppressed inline with ``# atp: disable=ATP###``
+  (same physical line; bare ``# atp: disable`` suppresses every code);
+- accepted legacy findings live in ``analysis/baseline.json`` — every
+  entry carries a human justification (see `report.load_baseline`);
+- codes never get renumbered, only retired.
+
+Two pass shapes cover everything:
+
+- **file passes** run per Python file on its parsed AST
+  (``fn(path, tree, src) -> Iterable[Finding]``);
+- **project passes** run once per tree (``fn(root) -> ...``) — the
+  absorbed ``scripts/check_*`` lints and the tracked-file guard.
+
+Deliberately jax-free: the analyzer imports nothing that imports jax,
+so a tree-wide run is parse + walk, seconds not minutes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+#: sub-trees (and single files) scanned by default, repo-root-relative —
+#: the same surface scripts/check_obs_names.py always linted
+SCAN = ("attention_tpu", "scripts", "tests", "bench.py")
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Code:
+    """One stable rule id: ``ATP###`` + title + default severity."""
+
+    code: str
+    title: str
+    severity: Severity
+    summary: str
+
+    _RE = re.compile(r"^ATP\d{3}$")
+
+    def __post_init__(self):
+        if not self._RE.match(self.code):
+            raise ValueError(f"rule id {self.code!r} is not ATP###")
+
+
+#: code -> Code, insertion-ordered (the README table is generated
+#: from this registry so docs cannot drift from the enforcing set)
+CODES: dict[str, Code] = {}
+
+
+def register_code(code: str, title: str, severity: Severity,
+                  summary: str) -> str:
+    if code in CODES:
+        raise ValueError(f"duplicate rule id {code}")
+    CODES[code] = Code(code, title, severity, summary)
+    return code
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location.
+
+    ``line`` is 1-based; 0 means the finding is about the whole file
+    (or, for project passes, about a non-Python artifact).
+    """
+
+    code: str
+    message: str
+    path: str
+    line: int = 0
+    col: int = 0
+
+    @property
+    def severity(self) -> Severity:
+        return CODES[self.code].severity
+
+    def location(self) -> str:
+        if self.line:
+            return f"{self.path}:{self.line}:{self.col}"
+        return self.path
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity.value,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class Pass:
+    name: str
+    codes: tuple[str, ...]
+    scope: str  # "file" | "project"
+    fn: Callable
+    doc: str
+
+
+#: pass name -> Pass, insertion-ordered
+PASSES: dict[str, Pass] = {}
+
+
+def _register(name: str, codes: Iterable[str], scope: str, fn: Callable):
+    if name in PASSES:
+        raise ValueError(f"duplicate pass {name!r}")
+    codes = tuple(codes)
+    for c in codes:
+        if c not in CODES:
+            raise ValueError(f"pass {name!r} emits unregistered code {c}")
+    PASSES[name] = Pass(name, codes, scope, fn,
+                        (fn.__doc__ or "").strip().splitlines()[0]
+                        if fn.__doc__ else "")
+    return fn
+
+
+def file_pass(name: str, codes: Iterable[str]):
+    """Register ``fn(path, tree, src) -> Iterable[Finding]`` to run on
+    every scanned Python file (``path`` is repo-root-relative)."""
+
+    def deco(fn):
+        return _register(name, codes, "file", fn)
+
+    return deco
+
+
+def project_pass(name: str, codes: Iterable[str]):
+    """Register ``fn(root) -> Iterable[Finding]`` to run once per tree."""
+
+    def deco(fn):
+        return _register(name, codes, "project", fn)
+
+    return deco
+
+
+# -- shared AST helpers ---------------------------------------------------
+
+def dotted_name(node: ast.expr) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def iter_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree but do NOT descend into nested
+    function/class scopes (their bodies are separate scopes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+# -- suppression ----------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*atp:\s*disable(?:=(?P<codes>[A-Z0-9_,\s]+?))?\s*(?:#|$)"
+)
+
+
+def suppressions(line_text: str) -> set[str] | None:
+    """The codes an ``# atp: disable[=...]`` comment on this physical
+    line suppresses: None when there is no directive, an empty set for
+    a bare ``disable`` (suppress everything), else the listed codes."""
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip() for c in codes.split(",") if c.strip()}
+
+
+def is_suppressed(finding: Finding, src_lines: list[str]) -> bool:
+    if not finding.line or finding.line > len(src_lines):
+        return False
+    sup = suppressions(src_lines[finding.line - 1])
+    if sup is None:
+        return False
+    return not sup or finding.code in sup
+
+
+# -- file discovery + the runner ------------------------------------------
+
+ATP001 = register_code(
+    "ATP001", "unparsable-source", Severity.ERROR,
+    "a scanned .py file fails to parse (syntax error)")
+
+
+def repo_root() -> str:
+    """The checkout root: the directory holding ``attention_tpu/``."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_source_files(root: str) -> Iterator[str]:
+    """Repo-root-relative paths of every scanned ``.py`` file."""
+    for rel in SCAN:
+        top = os.path.join(root, rel)
+        if os.path.isfile(top):
+            yield rel
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.relpath(
+                        os.path.join(dirpath, fn), root
+                    ).replace(os.sep, "/")
+
+
+def analyze_file(root: str, rel: str,
+                 passes: Iterable[Pass] | None = None) -> list[Finding]:
+    """Run the file passes on one file; suppressions already applied."""
+    passes = [p for p in (passes or PASSES.values()) if p.scope == "file"]
+    with open(os.path.join(root, rel), encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Finding(ATP001, f"syntax error: {e.msg}", rel,
+                        e.lineno or 0, (e.offset or 1) - 1)]
+    findings: list[Finding] = []
+    for p in passes:
+        findings.extend(p.fn(rel, tree, src))
+    lines = src.splitlines()
+    return [f for f in findings if not is_suppressed(f, lines)]
+
+
+def analyze(root: str | None = None,
+            rel_paths: Iterable[str] | None = None,
+            passes: Iterable[str] | None = None,
+            include_project: bool = True) -> list[Finding]:
+    """Run registered passes over the tree (or just ``rel_paths``).
+
+    Project passes always see the whole tree — they check committed
+    artifacts (tables, ledgers, the git index), not individual files —
+    so a ``--changed`` run still enforces them.
+    """
+    root = root or repo_root()
+    selected = ([PASSES[name] for name in passes] if passes
+                else list(PASSES.values()))
+    if rel_paths is None:
+        rel_paths = list(iter_source_files(root))
+    findings: list[Finding] = []
+    file_passes = [p for p in selected if p.scope == "file"]
+    for rel in rel_paths:
+        if not rel.endswith(".py"):
+            continue
+        if not os.path.isfile(os.path.join(root, rel)):
+            continue  # e.g. --changed listing a deleted file
+        findings.extend(analyze_file(root, rel, file_passes))
+    if include_project:
+        for p in selected:
+            if p.scope == "project":
+                findings.extend(p.fn(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings

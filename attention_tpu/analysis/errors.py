@@ -1,0 +1,81 @@
+"""Error-taxonomy pass: generic raises where typed errors exist.
+
+PR 2 introduced typed capacity/accounting errors
+(``attention_tpu.ops.paged.OutOfPagesError`` / ``PageAccountingError``)
+precisely so the engine's callers — and the chaos invariant checkers —
+can tell "pool exhausted, schedule around it" from "accounting bug,
+stop the world".  A bare ``RuntimeError`` three layers down erases
+that distinction, so inside the ``engine/`` and ``chaos/`` trees:
+
+- ATP401 (error): ``raise RuntimeError/Exception/AssertionError`` —
+  runtime-path failures must be a typed subclass;
+- ATP402 (warning): ``raise ValueError`` — usually constructor/argument
+  validation at the public API boundary, which is legitimate; the
+  existing ones are pinned per-file (with counts) in
+  ``analysis/baseline.json`` so a *new* one forces a conscious choice
+  between a typed error and a justified baseline bump.
+
+Raising a *name that ends in Error but is locally defined or imported
+from this package* is the blessed pattern and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    register_code,
+)
+
+ATP401 = register_code(
+    "ATP401", "generic-runtime-raise-in-typed-path", Severity.ERROR,
+    "raise RuntimeError/Exception/AssertionError under engine/ or "
+    "chaos/ — use a typed error (OutOfPagesError lineage)")
+ATP402 = register_code(
+    "ATP402", "generic-value-raise-in-typed-path", Severity.WARNING,
+    "raise ValueError under engine/ or chaos/ — argument validation "
+    "is baselined per file; new ones need a typed error or a "
+    "justified baseline entry")
+
+#: trees where the typed taxonomy is the contract
+_TYPED_PATHS = ("attention_tpu/engine/", "attention_tpu/chaos/")
+_GENERIC = {"RuntimeError", "Exception", "AssertionError"}
+
+
+def _raised_name(node: ast.Raise) -> str | None:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    d = dotted_name(exc) if exc is not None else None
+    return d.split(".")[-1] if d else None
+
+
+@file_pass("errors", [ATP401, ATP402])
+def check_errors(path: str, tree: ast.Module, src: str):
+    """Generic RuntimeError/ValueError raises in typed-error trees."""
+    if not any(path.startswith(p) for p in _TYPED_PATHS):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise):
+            continue
+        name = _raised_name(node)
+        if name in _GENERIC:
+            findings.append(Finding(
+                ATP401,
+                f"raise {name} in a typed-error path — subclass a "
+                "typed error (see attention_tpu.ops.paged."
+                "OutOfPagesError / PageAccountingError)",
+                path, node.lineno, node.col_offset))
+        elif name == "ValueError":
+            findings.append(Finding(
+                ATP402,
+                "raise ValueError in a typed-error path — if this is "
+                "API-boundary validation, baseline it with a "
+                "justification; otherwise use a typed error",
+                path, node.lineno, node.col_offset))
+    return findings

@@ -1,0 +1,260 @@
+"""Pallas contract passes: block/grid/out_shape self-consistency.
+
+``pl.pallas_call`` is a contract with the compiler — the grid rank,
+each ``BlockSpec``'s block shape, its ``index_map`` arity and return
+arity, and the ``out_shape`` dtype all have to agree — but Pallas
+reports violations at trace/lowering time with errors that point
+nowhere near the offending spec.  The decidable subset is checked here
+lexically, with literal-only matching: any component that is a
+variable (computed grids, shared block-size names) is skipped rather
+than guessed at.
+
+Checked (all on one ``pallas_call`` call site):
+
+- ATP201 — ``index_map`` lambda arity != literal ``grid`` rank;
+- ATP202 — ``BlockSpec`` literal block-shape rank != the index_map's
+  literal return-tuple arity (one coordinate per block dimension);
+- ATP203 — kernel's final store into an output ref casts to a literal
+  dtype that differs from the matching ``out_shape``
+  ``ShapeDtypeStruct`` literal dtype (a silent re-cast on store);
+- ATP204 — literal block shapes that break TPU tiling: last dim not a
+  multiple of 128 (lane), or second-minor not a multiple of 8
+  (sublane) — the assumption every kernel in this tree states in its
+  docstring, now enforced where it is spelled out as numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    register_code,
+)
+
+ATP201 = register_code(
+    "ATP201", "index-map-arity-vs-grid", Severity.ERROR,
+    "BlockSpec index_map takes a different number of arguments than "
+    "the pallas_call grid has dimensions")
+ATP202 = register_code(
+    "ATP202", "block-shape-rank-vs-index-map", Severity.ERROR,
+    "BlockSpec block shape rank differs from its index_map's returned "
+    "coordinate count")
+ATP203 = register_code(
+    "ATP203", "out-shape-dtype-mismatch", Severity.WARNING,
+    "kernel stores .astype(X) into an output ref whose out_shape "
+    "declares dtype Y — silent re-cast on store")
+ATP204 = register_code(
+    "ATP204", "tile-misalignment", Severity.WARNING,
+    "literal block shape breaks TPU tiling (last dim % 128, "
+    "second-minor % 8)")
+
+_PALLAS_CALL = ("pallas_call", "pl.pallas_call", "pallas.pallas_call")
+_DTYPE_NAMES = {
+    "float32", "float64", "bfloat16", "float16",
+    "int32", "int64", "int16", "int8", "int4", "uint8",
+    "uint32", "bool_",
+}
+
+
+def _literal_tuple(node: ast.expr) -> list[ast.expr] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return list(node.elts)
+    return None
+
+
+def _grid_rank(call: ast.Call) -> int | None:
+    for kw in call.keywords:
+        if kw.arg == "grid":
+            elts = _literal_tuple(kw.value)
+            if elts is not None:
+                return len(elts)
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, int):
+                return 1
+    return None
+
+
+def _dtype_literal(node: ast.expr) -> str | None:
+    """'bfloat16' for ``jnp.bfloat16`` / ``np.bfloat16`` / 'bfloat16'."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _DTYPE_NAMES else None
+    d = dotted_name(node)
+    if d is None:
+        return None
+    leaf = d.split(".")[-1]
+    return leaf if leaf in _DTYPE_NAMES else None
+
+
+def _block_specs(call: ast.Call) -> list[tuple[ast.Call, str]]:
+    """(BlockSpec call, which-kwarg) literals in in_specs/out_specs."""
+    out = []
+    for kw in call.keywords:
+        if kw.arg not in ("in_specs", "out_specs"):
+            continue
+        nodes = _literal_tuple(kw.value) or [kw.value]
+        for n in nodes:
+            if isinstance(n, ast.Call) and (
+                    dotted_name(n.func) or "").endswith("BlockSpec"):
+                out.append((n, kw.arg))
+    return out
+
+
+def _spec_parts(spec: ast.Call):
+    """(block-shape elements | None, index_map lambda | None)."""
+    shape = _literal_tuple(spec.args[0]) if spec.args else None
+    index_map = None
+    if len(spec.args) > 1 and isinstance(spec.args[1], ast.Lambda):
+        index_map = spec.args[1]
+    for kw in spec.keywords:
+        if kw.arg == "index_map" and isinstance(kw.value, ast.Lambda):
+            index_map = kw.value
+        if kw.arg == "block_shape":
+            shape = _literal_tuple(kw.value)
+    return shape, index_map
+
+
+def _lambda_return_arity(lam: ast.Lambda) -> int | None:
+    if isinstance(lam.body, ast.Tuple):
+        return len(lam.body.elts)
+    return None
+
+
+def _out_shape_dtypes(call: ast.Call) -> list[tuple[int, str]]:
+    """(output index, literal dtype) for ShapeDtypeStruct out_shapes."""
+    out: list[tuple[int, str]] = []
+    for kw in call.keywords:
+        if kw.arg != "out_shape":
+            continue
+        nodes = _literal_tuple(kw.value) or [kw.value]
+        for i, n in enumerate(nodes):
+            if not (isinstance(n, ast.Call) and (
+                    dotted_name(n.func) or "").endswith("ShapeDtypeStruct")):
+                continue
+            dt_node = n.args[1] if len(n.args) > 1 else None
+            for k in n.keywords:
+                if k.arg == "dtype":
+                    dt_node = k.value
+            dt = _dtype_literal(dt_node) if dt_node is not None else None
+            if dt:
+                out.append((i, dt))
+    return out
+
+
+def _kernel_def(call: ast.Call, tree: ast.Module):
+    """The kernel FunctionDef for this call site, when resolvable."""
+    from attention_tpu.analysis.purity import _kernel_arg_name
+
+    if not call.args:
+        return None
+    name = _kernel_arg_name(call.args[0])
+    if not name:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == name:
+                return node
+    return None
+
+
+def _n_inputs(call: ast.Call) -> int | None:
+    for kw in call.keywords:
+        if kw.arg == "in_specs":
+            elts = _literal_tuple(kw.value)
+            return len(elts) if elts is not None else None
+    return None
+
+
+def _check_store_dtypes(call: ast.Call, tree: ast.Module, path: str,
+                        findings: list[Finding]) -> None:
+    """ATP203: final-store astype vs the declared out_shape dtype.
+
+    Pallas positional convention: kernel params are the input refs (one
+    per in_spec), then the output refs (one per out_shape entry), then
+    scratch.  Only fires when every link in that chain is literal.
+    """
+    kernel = _kernel_def(call, tree)
+    n_in = _n_inputs(call)
+    outs = _out_shape_dtypes(call)
+    if kernel is None or n_in is None or not outs:
+        return
+    params = [p.arg for p in kernel.args.args]
+    for idx, declared in outs:
+        if n_in + idx >= len(params):
+            return
+        ref = params[n_in + idx]
+        for node in ast.walk(kernel):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == ref):
+                continue
+            val = node.value
+            if (isinstance(val, ast.Call)
+                    and isinstance(val.func, ast.Attribute)
+                    and val.func.attr == "astype" and val.args):
+                stored = _dtype_literal(val.args[0])
+                if stored and stored != declared:
+                    findings.append(Finding(
+                        ATP203,
+                        f"kernel stores .astype({stored}) into "
+                        f"{ref!r} but out_shape declares {declared} — "
+                        "the store silently re-casts",
+                        path, node.lineno, node.col_offset))
+
+
+@file_pass("pallas", [ATP201, ATP202, ATP203, ATP204])
+def check_pallas(path: str, tree: ast.Module, src: str):
+    """BlockSpec/grid/out_shape self-consistency at pallas_call sites."""
+    findings: list[Finding] = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if dotted_name(call.func) not in _PALLAS_CALL:
+            continue
+        grid_rank = _grid_rank(call)
+        for spec, which in _block_specs(call):
+            shape, index_map = _spec_parts(spec)
+            if index_map is not None and grid_rank is not None:
+                arity = len(index_map.args.args)
+                if arity != grid_rank:
+                    findings.append(Finding(
+                        ATP201,
+                        f"{which} index_map takes {arity} argument(s) "
+                        f"but the grid has {grid_rank} dimension(s)",
+                        path, spec.lineno, spec.col_offset))
+            if index_map is not None and shape is not None:
+                ret = _lambda_return_arity(index_map)
+                if ret is not None and ret != len(shape):
+                    findings.append(Finding(
+                        ATP202,
+                        f"{which} block shape has {len(shape)} "
+                        f"dimension(s) but index_map returns {ret} "
+                        "coordinate(s)",
+                        path, spec.lineno, spec.col_offset))
+            if shape is not None and len(shape) >= 1:
+                dims = [e.value if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int) else None
+                        for e in shape]
+                last, sub = dims[-1], (dims[-2] if len(dims) > 1 else None)
+                if last is not None and last % 128 != 0:
+                    findings.append(Finding(
+                        ATP204,
+                        f"{which} block shape last dim {last} is not a "
+                        "multiple of 128 (TPU lane tiling)",
+                        path, spec.lineno, spec.col_offset))
+                if sub is not None and len(dims) > 1 and sub % 8 != 0 \
+                        and sub != 1:
+                    findings.append(Finding(
+                        ATP204,
+                        f"{which} block shape second-minor dim {sub} "
+                        "is not a multiple of 8 (TPU sublane tiling)",
+                        path, spec.lineno, spec.col_offset))
+        _check_store_dtypes(call, tree, path, findings)
+    return findings

@@ -1,0 +1,175 @@
+"""Precision passes: silent low-precision accumulation.
+
+On the MXU, ``dot(bf16, bf16)`` accumulates in bf16 unless the call
+asks for fp32 (``preferred_element_type``) — numerically the single
+most expensive thing to forget in an attention kernel, and invisible
+until a chaos campaign trips a tolerance three layers downstream.
+``exp``/``softmax`` in sub-fp32 is the same hazard on the VPU side:
+the online-softmax running max/sum must live in fp32 (the contract
+ops/flash.py states in prose).
+
+Lexical inference, two triggers, no guessing:
+
+- an operand expression that is literally ``<x>.astype(<lowprec>)``
+  (or ``jnp.asarray/zeros/... (..., dtype=<lowprec>)``);
+- a Name assigned from such an expression earlier in the same (or an
+  enclosing) function scope.
+
+An explicit ``.astype`` to fp32+ marks a name clean again, so the
+``k32 = k.astype(jnp.float32)`` idiom never fires.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    iter_scope,
+    register_code,
+)
+
+ATP301 = register_code(
+    "ATP301", "lowprec-dot-no-preferred-type", Severity.ERROR,
+    "dot/dot_general/einsum/matmul/@ on bf16/fp16/int8/int4 operands "
+    "without preferred_element_type — accumulates in low precision")
+ATP302 = register_code(
+    "ATP302", "sub-fp32-exp-softmax", Severity.WARNING,
+    "exp/exp2/softmax computed on a sub-fp32 operand — the softmax "
+    "accumulator must be fp32")
+
+#: dtypes whose accumulation needs an explicit preferred_element_type
+_LOWPREC = {"bfloat16", "float16", "int8", "int4", "uint8", "float8_e4m3fn",
+            "float8_e5m2"}
+#: dot-like callables, by trailing attribute
+_DOT_LEAVES = {"dot", "dot_general", "matmul", "einsum"}
+#: constructors whose dtype= kwarg fixes the result dtype
+_CTOR_LEAVES = {"asarray", "array", "zeros", "ones", "full", "empty",
+                "zeros_like", "ones_like", "full_like", "empty_like"}
+_EXP_NAMES = {"jnp.exp", "jnp.exp2", "jnp.softmax", "jax.nn.softmax",
+              "nn.softmax", "jax.lax.exp", "lax.exp"}
+
+
+def _dtype_of(node: ast.expr) -> str | None:
+    from attention_tpu.analysis.pallas import _dtype_literal
+
+    return _dtype_literal(node)
+
+
+def _explicit_dtype(call: ast.Call) -> str | None:
+    """The literal dtype an .astype()/constructor call pins, if any."""
+    d = dotted_name(call.func)
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "astype":
+        if call.args:
+            return _dtype_of(call.args[0])
+        return None
+    if d and d.split(".")[-1] in _CTOR_LEAVES:
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return _dtype_of(kw.value)
+    return None
+
+
+def _is_lowprec(node: ast.expr, env: dict[str, bool]) -> bool:
+    """True when ``node`` is inferably a low-precision array."""
+    if isinstance(node, ast.Name):
+        return env.get(node.id, False)
+    if isinstance(node, ast.Call):
+        dt = _explicit_dtype(node)
+        if dt is not None:
+            return dt in _LOWPREC
+        return False
+    if isinstance(node, ast.BinOp):
+        return (_is_lowprec(node.left, env)
+                or _is_lowprec(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        return _is_lowprec(node.operand, env)
+    return False
+
+
+def _scope_env(fn, inherited: dict[str, bool]) -> dict[str, bool]:
+    """Name -> is-low-precision, from assignments in ``fn``'s scope."""
+    env = dict(inherited)
+    nodes = (iter_scope(fn) if not isinstance(fn, ast.Module)
+             else _module_scope(fn))
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(node.value, ast.Call):
+                dt = _explicit_dtype(node.value)
+                if dt is not None:
+                    env[tgt.id] = dt in _LOWPREC
+                    continue
+            env[tgt.id] = _is_lowprec(node.value, env)
+    return env
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _check_scope(fn, inherited: dict[str, bool], path: str,
+                 findings: list[Finding]) -> None:
+    env = _scope_env(fn, inherited)
+    walk = (iter_scope(fn) if not isinstance(fn, ast.Module)
+            else _module_scope(fn))
+    for node in walk:
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            leaf = d.split(".")[-1]
+            if leaf in _DOT_LEAVES and not _has_kw(
+                    node, "preferred_element_type"):
+                operands = (node.args[1:] if leaf == "einsum"
+                            else node.args[:2])
+                if any(_is_lowprec(a, env) for a in operands):
+                    findings.append(Finding(
+                        ATP301,
+                        f"{d}() on low-precision operand(s) without "
+                        "preferred_element_type — accumulates in the "
+                        "operand dtype on the MXU",
+                        path, node.lineno, node.col_offset))
+            elif d in _EXP_NAMES and node.args and _is_lowprec(
+                    node.args[0], env):
+                findings.append(Finding(
+                    ATP302,
+                    f"{d}() on a sub-fp32 operand — softmax/exp "
+                    "accumulators must be fp32",
+                    path, node.lineno, node.col_offset))
+        elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                        ast.MatMult):
+            if (_is_lowprec(node.left, env)
+                    or _is_lowprec(node.right, env)):
+                findings.append(Finding(
+                    ATP301,
+                    "@ (matmul) on low-precision operand(s) — use "
+                    "dot_general with preferred_element_type=float32",
+                    path, node.lineno, node.col_offset))
+    children = (iter_scope(fn) if not isinstance(fn, ast.Module)
+                else _module_scope(fn))
+    for node in children:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_scope(node, env, path, findings)
+
+
+def _module_scope(tree: ast.Module):
+    """Module-level statements, not descending into function bodies."""
+    stack = list(ast.iter_child_nodes(tree))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@file_pass("precision", [ATP301, ATP302])
+def check_precision(path: str, tree: ast.Module, src: str):
+    """Low-precision dots without fp32 accumulation; sub-fp32 softmax."""
+    findings: list[Finding] = []
+    _check_scope(tree, {}, path, findings)
+    return findings

@@ -1,0 +1,239 @@
+"""Trace-purity passes: impure Python inside traced code.
+
+A ``@jax.jit`` body and a Pallas kernel body run at *trace* time —
+once, on abstract values — so host-side effects inside them are
+hazards, not features: ``time.time()`` stamps the trace not the step,
+``np.random`` freezes one sample into the compiled graph, ``print``
+fires per-trace, and mutation of captured state leaks staleness
+across retraces.  All of it is decidable lexically, which is the whole
+point of catching it here rather than three layers into a chaos run.
+
+Traced scopes are found two ways:
+
+- functions decorated ``@jax.jit`` / ``@jit`` /
+  ``@(functools.)partial(jax.jit, ...)``;
+- kernel functions passed (directly or via ``functools.partial``) as
+  the first argument of a ``pl.pallas_call``; a Name that resolves to
+  a module-level ``x = partial(kernel, ...)`` alias follows through.
+
+Nested functions inside a traced scope are traced too (the ``@pl.when``
+idiom), and their *captured-ref* stores (``acc_scr[...] = ...`` where
+``acc_scr`` is the enclosing kernel's parameter) are pure by design —
+the binding environment is threaded down the lexical chain so only
+stores whose root name is bound in no enclosing traced scope fire.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    iter_scope,
+    register_code,
+)
+
+ATP101 = register_code(
+    "ATP101", "impure-call-under-trace", Severity.ERROR,
+    "time/np.random/print/open-style host call lexically inside a "
+    "@jax.jit function or Pallas kernel body")
+ATP102 = register_code(
+    "ATP102", "host-coercion-under-trace", Severity.WARNING,
+    ".item() or float(tracer) inside traced code — forces a "
+    "device->host sync (or a trace-time concretization error)")
+ATP103 = register_code(
+    "ATP103", "state-mutation-under-trace", Severity.ERROR,
+    "global/nonlocal statement, or store through a name captured from "
+    "outside the traced scope")
+
+#: ``time.<attr>`` calls that read host clocks / sleep
+_TIME_ATTRS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+               "monotonic", "monotonic_ns", "process_time", "sleep"}
+#: bare-name calls that are host effects wherever they appear
+_IMPURE_NAMES = {"print", "input", "breakpoint", "open"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """`jax.jit` / bare `jit` (as a decorator or a partial target)."""
+    d = dotted_name(node)
+    return d in ("jit", "jax.jit")
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnums=...) or @partial(jax.jit, ...)
+        if _is_jit_expr(dec.func):
+            return True
+        d = dotted_name(dec.func)
+        if d in ("partial", "functools.partial") and dec.args:
+            return _is_jit_expr(dec.args[0])
+    return False
+
+
+def _kernel_arg_name(node: ast.expr) -> str | None:
+    """The kernel name in a ``pallas_call`` first argument: a bare
+    Name, or the first argument of a ``partial(...)`` wrapper."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d in ("partial", "functools.partial") and node.args:
+            if isinstance(node.args[0], ast.Name):
+                return node.args[0].id
+    return None
+
+
+def traced_functions(tree: ast.Module) -> list[ast.FunctionDef]:
+    """Top-level traced scopes: jit-decorated defs + Pallas kernels."""
+    defs: dict[str, list] = {}
+    aliases: dict[str, str] = {}  # x = partial(kernel, ...) at any level
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and isinstance(node.value, ast.Call):
+                k = _kernel_arg_name(node.value)
+                if k:
+                    aliases[tgt.id] = k
+
+    out: list[ast.FunctionDef] = []
+    seen: set[int] = set()
+
+    def add(fn):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                add(node)
+        elif isinstance(node, ast.Call):
+            if dotted_name(node.func) in ("pallas_call", "pl.pallas_call",
+                                          "pallas.pallas_call") and node.args:
+                name = _kernel_arg_name(node.args[0])
+                name = aliases.get(name, name)
+                for fn in defs.get(name or "", []):
+                    add(fn)
+    return out
+
+
+def _bound_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Names bound in ``fn``'s own scope: parameters plus plain-Name
+    binding sites (assignments, for/with targets, comprehensions,
+    nested defs, imports) — not through nested function bodies."""
+    a = fn.args
+    bound = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+    for p in (a.vararg, a.kwarg):
+        if p:
+            bound.add(p.arg)
+    for node in iter_scope(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+    return bound
+
+
+def _store_root(node: ast.expr) -> ast.expr:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node
+
+
+def _impure_call(node: ast.Call) -> str | None:
+    """A human-readable culprit when ``node`` is an impure host call."""
+    d = dotted_name(node.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if d in _IMPURE_NAMES:
+        return f"{d}()"
+    if parts[0] == "time" and parts[-1] in _TIME_ATTRS:
+        return f"{d}()"
+    if parts[0] in ("np", "numpy") and len(parts) > 1 and parts[1] == "random":
+        return f"{d}()"
+    if parts[0] == "random" and len(parts) > 1:
+        return f"{d}()"
+    if parts[0] == "os" and parts[-1] == "urandom":
+        return f"{d}()"
+    if parts[0] in ("datetime",) and parts[-1] in ("now", "utcnow", "today"):
+        return f"{d}()"
+    return None
+
+
+def _check_scope(fn, inherited: set[str], where: str, path: str,
+                 findings: list[Finding]) -> None:
+    """Flag hazards in ``fn``'s own scope, then recurse into nested
+    functions with the accumulated binding environment."""
+    bound = inherited | _bound_names(fn)
+    for node in iter_scope(fn):
+        if isinstance(node, ast.Call):
+            culprit = _impure_call(node)
+            if culprit:
+                findings.append(Finding(
+                    ATP101,
+                    f"impure host call {culprit} inside {where} — "
+                    "runs at trace time, not per step",
+                    path, node.lineno, node.col_offset))
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                findings.append(Finding(
+                    ATP102,
+                    f".item() inside {where} — device->host sync / "
+                    "trace-time concretization",
+                    path, node.lineno, node.col_offset))
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id == "float"
+                    and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)):
+                findings.append(Finding(
+                    ATP102,
+                    f"float(...) coercion inside {where} — "
+                    "concretizes a tracer",
+                    path, node.lineno, node.col_offset))
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+            findings.append(Finding(
+                ATP103,
+                f"{kw} statement inside {where} — trace-time state "
+                "mutation leaks across retraces",
+                path, node.lineno, node.col_offset))
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if not isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    continue
+                root = _store_root(tgt)
+                if isinstance(root, ast.Name) and root.id not in bound:
+                    findings.append(Finding(
+                        ATP103,
+                        f"store through {root.id!r}, captured from "
+                        f"outside {where} — mutates module/closure "
+                        "state at trace time",
+                        path, tgt.lineno, tgt.col_offset))
+    for node in iter_scope(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_scope(node, bound, where, path, findings)
+
+
+@file_pass("purity", [ATP101, ATP102, ATP103])
+def check_purity(path: str, tree: ast.Module, src: str):
+    """Impure host calls / coercions / mutation inside traced scopes."""
+    findings: list[Finding] = []
+    for fn in traced_functions(tree):
+        where = f"traced scope {fn.name!r}"
+        _check_scope(fn, set(), where, path, findings)
+    return findings

@@ -1,0 +1,199 @@
+"""Reporting: text/JSON/SARIF renderers + the justified baseline.
+
+The baseline (``attention_tpu/analysis/baseline.json``) is the list of
+*accepted* findings: real rule hits that are deliberate and stay in
+the tree.  Every entry MUST carry a non-empty ``justification`` — a
+silent baseline is just a second way to ignore the linter.  Entries
+match findings by code + path plus either:
+
+- ``match``: a substring of the finding message (pin one specific
+  finding), and/or
+- ``count``: exactly how many findings of that code live in that path
+  (pin a family, e.g. "7 ValueError validations in request.py") — a
+  new finding of the same shape changes the count and fails the gate.
+
+An entry that matches nothing (or whose count drifts) is *stale* and
+fails the run too: the baseline can only shrink honestly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from attention_tpu.analysis.core import CODES, Finding, Severity
+
+BASELINE_REL = "attention_tpu/analysis/baseline.json"
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    code: str
+    path: str
+    justification: str
+    match: str | None = None
+    count: int | None = None
+
+
+def load_baseline(path: str) -> list[BaselineEntry]:
+    """Parse + validate a baseline file (every entry justified)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {data.get('version')!r} != "
+            f"{BASELINE_VERSION}")
+    entries = []
+    for i, raw in enumerate(data.get("entries", [])):
+        just = (raw.get("justification") or "").strip()
+        if not just:
+            raise ValueError(
+                f"{path}: entry {i} ({raw.get('code')} "
+                f"{raw.get('path')}) has no justification — silent "
+                "baseline entries are not allowed")
+        if raw.get("code") not in CODES:
+            raise ValueError(
+                f"{path}: entry {i} names unknown code "
+                f"{raw.get('code')!r}")
+        if not raw.get("path"):
+            raise ValueError(f"{path}: entry {i} has no path")
+        entries.append(BaselineEntry(
+            code=raw["code"], path=raw["path"], justification=just,
+            match=raw.get("match"), count=raw.get("count")))
+    return entries
+
+
+def save_baseline(path: str, entries: list[BaselineEntry]) -> None:
+    data = {
+        "version": BASELINE_VERSION,
+        "entries": [
+            {k: v for k, v in dataclasses.asdict(e).items()
+             if v is not None}
+            for e in entries
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], entries: list[BaselineEntry],
+) -> tuple[list[Finding], list[str]]:
+    """(unbaselined findings, baseline problems).
+
+    Matched findings are filtered out; an entry matching nothing, or a
+    ``count`` entry whose actual count drifted, is reported as a
+    problem (stale/drifted baselines fail the gate both ways).
+    """
+    remaining = list(findings)
+    problems: list[str] = []
+    for e in entries:
+        matched = [f for f in remaining
+                   if f.code == e.code and f.path == e.path
+                   and (e.match is None or e.match in f.message)]
+        if not matched:
+            problems.append(
+                f"stale baseline entry: {e.code} {e.path}"
+                + (f" (match={e.match!r})" if e.match else "")
+                + " no longer matches any finding — delete it")
+        elif e.count is not None and len(matched) != e.count:
+            problems.append(
+                f"baseline count drift: {e.code} {e.path} pins "
+                f"{e.count} finding(s) but the tree has "
+                f"{len(matched)} — re-justify or fix")
+        for f in matched:
+            remaining.remove(f)
+    return remaining, problems
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, BASELINE_REL)
+
+
+# -- renderers ------------------------------------------------------------
+
+def render_text(findings: list[Finding],
+                baseline_problems: list[str] = ()) -> str:
+    lines = []
+    for f in findings:
+        lines.append(f"{f.location()}: {f.severity.value} "
+                     f"{f.code} {f.message}")
+    for p in baseline_problems:
+        lines.append(f"baseline: error {p}")
+    n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
+    n_warn = len(findings) - n_err
+    if findings or baseline_problems:
+        lines.append(
+            f"{len(findings)} finding(s): {n_err} error(s), "
+            f"{n_warn} warning(s)"
+            + (f"; {len(baseline_problems)} baseline problem(s)"
+               if baseline_problems else ""))
+    else:
+        lines.append("analysis OK")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(findings: list[Finding],
+                baseline_problems: list[str] = ()) -> str:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "baseline_problems": list(baseline_problems),
+        "counts": counts,
+    }, sort_keys=True) + "\n"
+
+
+def render_sarif(findings: list[Finding],
+                 baseline_problems: list[str] = ()) -> str:
+    """Minimal SARIF 2.1.0 — one run, one rule per registered code."""
+    used = sorted({f.code for f in findings})
+    rules = [{
+        "id": code,
+        "name": CODES[code].title,
+        "shortDescription": {"text": CODES[code].summary},
+        "defaultConfiguration": {
+            "level": CODES[code].severity.value},
+    } for code in used]
+    results = [{
+        "ruleId": f.code,
+        "level": f.severity.value,
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(f.line, 1),
+                           "startColumn": f.col + 1},
+            },
+        }],
+    } for f in findings]
+    for p in baseline_problems:
+        results.append({
+            "ruleId": "ATP000",
+            "level": "error",
+            "message": {"text": f"baseline: {p}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": BASELINE_REL},
+                    "region": {"startLine": 1, "startColumn": 1},
+                },
+            }],
+        })
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "attention-tpu-analysis",
+                "informationUri":
+                    "https://github.com/attention-tpu",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }, sort_keys=True) + "\n"

@@ -23,6 +23,14 @@ Usage:
       # continuous-batching engine over a request trace; prints
       # per-step (--per-step) and summary metrics JSON; --obs-out
       # persists the telemetry dump for `cli obs`
+  python -m attention_tpu.cli analyze [paths ...] [--changed]
+      [--format text|json|sarif] [--baseline FILE | --no-baseline]
+      [--list-codes]
+      # static analysis (attention_tpu.analysis): AST passes with
+      # stable ATP### codes — trace purity, Pallas contracts,
+      # precision, error taxonomy, tree conventions; exit 0 iff clean
+      # modulo analysis/baseline.json; --changed lints only files
+      # touched since `git merge-base HEAD --base`
   python -m attention_tpu.cli obs report --run run_dir
   python -m attention_tpu.cli obs export --run run_dir
       --format chrome|prom|jsonl [--out timeline.json]
@@ -495,6 +503,84 @@ def _cmd_chaos_faults(args: argparse.Namespace) -> int:
     return 0 if report.ok else 1
 
 
+def _changed_files(root: str, base: str) -> list[str]:
+    """Repo-root-relative paths touched since ``merge-base HEAD base``
+    (committed, staged, unstaged, and untracked).  On ``base``'s own
+    branch the merge-base IS HEAD, so only working-tree changes show —
+    exactly what a builder mid-PR wants to lint."""
+    import subprocess
+
+    def git(*argv: str) -> list[str]:
+        out = subprocess.run(["git", "-C", root, *argv],
+                             capture_output=True, text=True, check=True)
+        return [line for line in out.stdout.splitlines() if line]
+
+    try:
+        mb = git("merge-base", "HEAD", base)[0]
+        changed = set(git("diff", "--name-only", mb, "--"))
+        changed |= set(git("ls-files", "--others", "--exclude-standard"))
+    except (OSError, subprocess.SubprocessError, IndexError) as e:
+        raise SystemExit(f"--changed needs a git checkout with ref "
+                         f"{base!r}: {e}") from e
+    return sorted(changed)
+
+
+def _cmd_analyze(args: argparse.Namespace) -> int:
+    """Run the static-analysis passes (attention_tpu.analysis): exit 0
+    iff the selected files are clean modulo the committed baseline."""
+    import os
+
+    from attention_tpu import analysis
+    from attention_tpu.analysis import report as areport
+
+    root = analysis.repo_root()
+    if args.list_codes:
+        for code in sorted(analysis.CODES.values(),
+                           key=lambda c: c.code):
+            print(f"{code.code}  {code.severity.value:7s} "
+                  f"{code.title}: {code.summary}")
+        return 0
+
+    rel_paths = None
+    if args.changed:
+        rel_paths = _changed_files(root, args.base)
+    if args.paths:
+        rel_paths = (rel_paths or []) + [
+            os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            for p in args.paths
+        ]
+    findings = analysis.analyze(root, rel_paths=rel_paths)
+
+    problems: list[str] = []
+    if not args.no_baseline:
+        bpath = args.baseline or areport.default_baseline_path(root)
+        if os.path.isfile(bpath):
+            try:
+                entries = areport.load_baseline(bpath)
+            except ValueError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            # a partial run can't tell a stale entry from an unscanned
+            # file, so only full runs police baseline staleness
+            findings, problems = areport.apply_baseline(findings, entries)
+            if rel_paths is not None:
+                problems = []
+        elif args.baseline:
+            print(f"no such baseline: {bpath}", file=sys.stderr)
+            return 2
+
+    render = {"text": areport.render_text, "json": areport.render_json,
+              "sarif": areport.render_sarif}[args.format]
+    text = render(findings, problems)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        _logger.info("wrote %s report: %s", args.format, args.out)
+    else:
+        sys.stdout.write(text)
+    return 1 if (findings or problems) else 0
+
+
 def _obs_load(args: argparse.Namespace):
     """(snapshot, events, device_dir) for an ``obs`` subcommand: from a
     --run dump directory, else the live in-process state (useful when a
@@ -705,6 +791,34 @@ def main(argv: list[str] | None = None) -> int:
                      help="include per-request token streams in the "
                           "report JSON")
     cfa.set_defaults(fn=_cmd_chaos_faults)
+
+    an = sub.add_parser(
+        "analyze",
+        help="static analysis (attention_tpu.analysis): AST passes "
+             "with stable ATP### codes over the whole tree; exit 0 "
+             "iff clean modulo analysis/baseline.json",
+    )
+    an.add_argument("paths", nargs="*",
+                    help="specific files to lint (default: the whole "
+                         "scanned tree)")
+    an.add_argument("--changed", action="store_true",
+                    help="lint only files touched since "
+                         "`git merge-base HEAD --base` (plus "
+                         "staged/unstaged/untracked changes)")
+    an.add_argument("--base", default="main",
+                    help="merge-base ref for --changed (default: main)")
+    an.add_argument("--format", choices=["text", "json", "sarif"],
+                    default="text")
+    an.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "attention_tpu/analysis/baseline.json)")
+    an.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, accepted or not")
+    an.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    an.add_argument("--list-codes", action="store_true",
+                    help="print the ATP### rule table and exit")
+    an.set_defaults(fn=_cmd_analyze)
 
     ob = sub.add_parser(
         "obs",

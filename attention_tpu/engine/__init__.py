@@ -27,6 +27,7 @@ from attention_tpu.engine.allocator import (  # noqa: F401
 from attention_tpu.engine.engine import (  # noqa: F401
     EngineConfig,
     ServingEngine,
+    StepLimitExceededError,
 )
 from attention_tpu.engine.metrics import (  # noqa: F401
     EngineMetrics,

@@ -52,6 +52,15 @@ _CANCELLED = obs.counter("engine.requests.cancelled",
                          "requests cancelled mid-flight")
 
 
+class StepLimitExceededError(RuntimeError):
+    """`run(max_steps=...)` hit its cap before the queue drained.
+
+    Subclasses RuntimeError for compatibility with callers that caught
+    the bare raise this replaces; typed so drivers can distinguish the
+    diagnostic guard from a genuine engine failure (the ATP401
+    error-taxonomy contract — see attention_tpu/analysis/errors.py)."""
+
+
 @functools.partial(jax.jit, static_argnames=("model",))
 def _paged_apply(model, params, tokens, caches):
     """One batched model step over paged caches.  Module-level with a
@@ -256,7 +265,7 @@ class ServingEngine:
         stalls = 0
         while self.scheduler.has_work():
             if max_steps is not None and self._step >= max_steps:
-                raise RuntimeError(
+                raise StepLimitExceededError(
                     f"engine exceeded max_steps={max_steps} with "
                     f"{len(self.scheduler.waiting)} waiting / "
                     f"{len(self.scheduler.running)} running"

@@ -1,0 +1,24 @@
+"""Umbrella correctness gate: every registered analysis pass, tree-wide.
+
+One entry point for CI and the tier-1 suite: runs the full
+``attention_tpu.analysis`` registry (trace purity, Pallas contracts,
+precision, error taxonomy, the absorbed check_* lints, the
+source-only guard) over the whole scanned tree and applies the
+committed baseline — exactly ``cli analyze`` with no arguments, so
+the two can never disagree.
+
+Exit 0 iff the tree is clean modulo analysis/baseline.json.
+Run: python scripts/check_all.py [cli-analyze flags, e.g. --format json]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from attention_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(["analyze", *sys.argv[1:]]))

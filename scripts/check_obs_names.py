@@ -1,70 +1,26 @@
 """Lint every literal telemetry name against the naming convention.
 
-The telemetry namespace (`attention_tpu.obs.naming`) is
-``layer.component.verb``: 2-4 lowercase dot-separated segments.  A
-dashboard full of ad-hoc spellings is how observability rots, so —
-`check_shipped_table.py`'s discipline applied to metrics — this script
-AST-walks the tree and validates the first string-literal argument of
-every ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` /
-``span(...)`` call (module functions, ``obs.``-qualified, or registry
-methods alike).  Non-literal names (variables, f-strings) are skipped:
-they are validated at runtime by ``require_name``.
+Thin wrapper: the check itself is the registered ``obs-naming``
+analysis pass (ATP501, ``attention_tpu/analysis/conventions.py``) and
+runs with every other rule under ``cli analyze`` /
+``scripts/check_all.py``.  This script keeps the original stand-alone
+contract — same scanned trees, same output lines, same exit codes —
+for CI jobs and muscle memory that call it directly.
 
 Exit 0 iff clean.  Run: python scripts/check_obs_names.py [root]
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from attention_tpu.obs.naming import check_name  # noqa: E402
-
-#: call names whose first literal argument must be a telemetry name
-INSTRUMENT_CALLS = {"counter", "gauge", "histogram", "span",
-                    "record_event"}
-
-#: scanned sub-trees, relative to the repo root
-SCAN = ("attention_tpu", "scripts", "tests", "bench.py")
-
-
-def _call_name(func: ast.expr) -> str | None:
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return None
-
-
-def check_file(path: str) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: unparsable ({e})"]
-    errors = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _call_name(node.func) not in INSTRUMENT_CALLS:
-            continue
-        if not node.args:
-            continue
-        first = node.args[0]
-        if not (isinstance(first, ast.Constant)
-                and isinstance(first.value, str)):
-            continue  # runtime-validated
-        if not check_name(first.value):
-            errors.append(
-                f"{path}:{node.lineno}: telemetry name "
-                f"{first.value!r} violates layer.component.verb "
-                "(2-4 lowercase dot-separated [a-z][a-z0-9_]* segments)"
-            )
-    return errors
+from attention_tpu.analysis.conventions import (  # noqa: E402
+    legacy_obs_check_file as check_file,
+)
+from attention_tpu.analysis.core import SCAN  # noqa: E402
 
 
 def check_tree(root: str) -> list[str]:

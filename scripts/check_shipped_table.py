@@ -1,20 +1,13 @@
 """Lint the committed shipped tuning table (CI-run schema validation).
 
-Checks, on ``attention_tpu/tuning/shipped_table.json`` (or a path
-argument, so freshly written user caches can be linted too):
+Thin wrapper: the check itself is the registered ``shipped-table``
+analysis pass (ATP502, ``attention_tpu/analysis/conventions.py``) and
+runs with every other rule under ``cli analyze`` /
+``scripts/check_all.py``.  This script keeps the original stand-alone
+contract — path argument for freshly written user caches, same output
+lines, same exit codes.
 
-- the file is valid JSON with the current schema version;
-- the raw JSON text has no duplicate entry keys (a plain ``json.load``
-  silently keeps the last duplicate — exactly the corruption a
-  hand-edited table would hide);
-- every key parses (device/kernel/bucket/dtype/flags — power-of-two
-  buckets, sorted flags, known kernel families);
-- every entry carries a tile field and all tile fields are positive
-  128-multiples;
-- entries only use tile fields their kernel family reads (a decode
-  entry with ``block_q`` would be silently ignored at lookup time).
-
-Exit 0 iff clean.  Run: python scripts/check_shipped_table.py
+Exit 0 iff clean.  Run: python scripts/check_shipped_table.py [path]
 """
 
 from __future__ import annotations
@@ -25,67 +18,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# which tile fields each family's lookup adapter actually reads
-FAMILY_FIELDS = {
-    "flash_fwd": {"block_q", "block_k"},
-    "flash_bwd": {"block_q", "block_k"},
-    "flash_bwd_fused": {"block_q", "block_k"},
-    "decode": {"block_k"},
-    "paged": {"page_size"},
-}
-
-META_FIELDS = {"ms", "source", "recorded"}
-
-
-def _load_no_duplicates(path: str):
-    """json.load that REJECTS duplicate keys instead of last-wins."""
-    def hook(pairs):
-        seen = set()
-        for k, _ in pairs:
-            if k in seen:
-                raise ValueError(f"duplicate key {k!r}")
-            seen.add(k)
-        return dict(pairs)
-
-    with open(path) as f:
-        return json.load(f, object_pairs_hook=hook)
-
-
-def check(path: str) -> list[str]:
-    from attention_tpu.tuning.cache import (
-        SCHEMA_VERSION,
-        parse_key,
-        validate_entry,
-    )
-
-    problems = []
-    try:
-        data = _load_no_duplicates(path)
-    except (OSError, ValueError) as e:
-        return [f"{path}: unreadable ({e})"]
-    if data.get("version") != SCHEMA_VERSION:
-        problems.append(
-            f"version {data.get('version')!r} != {SCHEMA_VERSION}")
-    entries = data.get("entries")
-    if not isinstance(entries, dict):
-        problems.append("'entries' missing or not an object")
-        return problems
-    for key, entry in entries.items():
-        try:
-            fields = parse_key(key)
-            validate_entry(entry)
-        except ValueError as e:
-            problems.append(str(e))
-            continue
-        allowed = FAMILY_FIELDS[fields["kernel"]] | META_FIELDS
-        extra = set(entry) - allowed
-        missing = FAMILY_FIELDS[fields["kernel"]] - set(entry)
-        if extra:
-            problems.append(f"{key}: unknown fields {sorted(extra)}")
-        if missing:
-            problems.append(f"{key}: missing tile fields "
-                            f"{sorted(missing)}")
-    return problems
+from attention_tpu.analysis.conventions import (  # noqa: E402
+    shipped_table_problems as check,
+)
 
 
 def main(argv=None) -> int:

@@ -1,13 +1,11 @@
 """Lint PARITY.md's tolerance-ledger table against chaos/budgets.py.
 
-The per-family error budgets are encoded ONCE in
-``attention_tpu.chaos.budgets.FAMILY_BUDGETS``; PARITY.md's "Tolerance
-ledger" section mirrors them for humans.  Documentation that quietly
-disagrees with the enforcing constants is how a ±0.02 contract rots to
-"about 0.05, probably" — so this script (the `check_shipped_table.py` /
-`check_obs_names.py` discipline applied to tolerances) parses the
-markdown table and demands an EXACT match both ways: every code budget
-documented, every documented budget backed by code, every value equal.
+Thin wrapper: the check itself is the registered ``tolerance-ledger``
+analysis pass (ATP503, ``attention_tpu/analysis/conventions.py``) and
+runs with every other rule under ``cli analyze`` /
+``scripts/check_all.py``.  This script keeps the original stand-alone
+contract — optional PARITY.md path argument, same output lines, same
+exit codes.
 
 Exit 0 iff clean.  Run: python scripts/check_tolerances.py [PARITY.md]
 """
@@ -15,62 +13,13 @@ Exit 0 iff clean.  Run: python scripts/check_tolerances.py [PARITY.md]
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SECTION = "## Tolerance ledger"
-#: | `family` | number | basis |
-ROW_RE = re.compile(
-    r"^\|\s*`(?P<family>[a-z0-9_]+)`\s*\|\s*(?P<tol>[0-9.eE+-]+)\s*\|"
+from attention_tpu.analysis.conventions import (  # noqa: E402
+    tolerance_problems as check,
 )
-
-
-def parse_ledger_table(path: str) -> dict[str, float]:
-    """The family -> tolerance rows of PARITY.md's ledger section."""
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    if SECTION not in text:
-        raise ValueError(f"{path}: no '{SECTION}' section")
-    body = text.split(SECTION, 1)[1]
-    # the section ends at the next heading
-    body = re.split(r"^## ", body, maxsplit=1, flags=re.MULTILINE)[0]
-    out: dict[str, float] = {}
-    for line in body.splitlines():
-        m = ROW_RE.match(line.strip())
-        if not m:
-            continue
-        family = m.group("family")
-        if family in out:
-            raise ValueError(f"{path}: duplicate ledger row {family!r}")
-        out[family] = float(m.group("tol"))
-    if not out:
-        raise ValueError(f"{path}: ledger section holds no parsable rows")
-    return out
-
-
-def check(path: str) -> list[str]:
-    from attention_tpu.chaos.budgets import FAMILY_BUDGETS
-
-    try:
-        documented = parse_ledger_table(path)
-    except (OSError, ValueError) as e:
-        return [str(e)]
-    problems = []
-    for family, tol in sorted(FAMILY_BUDGETS.items()):
-        if family not in documented:
-            problems.append(
-                f"budget {family!r} ({tol:g}) missing from {path}")
-        elif documented[family] != tol:
-            problems.append(
-                f"{family!r}: {path} says {documented[family]:g}, "
-                f"chaos/budgets.py says {tol:g}")
-    for family in sorted(set(documented) - set(FAMILY_BUDGETS)):
-        problems.append(
-            f"{path} documents unknown budget {family!r} "
-            f"({documented[family]:g})")
-    return problems
 
 
 def main(argv=None) -> int:

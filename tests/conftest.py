@@ -128,6 +128,15 @@ def pytest_configure(config):
         "markers",
         "slow: long-running campaigns/sweeps excluded from tier-1",
     )
+    # the static-analysis tier (tests/test_analysis.py): AST passes,
+    # baseline round-trips, and the tree-wide-clean gate; jax-free
+    # and CPU-fast, tier-1
+    config.addinivalue_line(
+        "markers",
+        "analysis: static-analysis framework (attention_tpu/analysis/) "
+        "— ATP### passes, suppressions, baseline, renderers; tier-1 "
+        "fast",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,511 @@
+"""attention_tpu.analysis: the static-analysis framework.
+
+Every pass gets fixture snippets compiled from strings — one that
+triggers each rule and one that legally does not — plus suppression
+and baseline round-trips, renderer schema smokes, wrapper-contract
+checks for the absorbed scripts/check_* lints, and the tier-1 gate:
+the committed tree is clean modulo analysis/baseline.json.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from attention_tpu.analysis import core, report
+from attention_tpu.analysis.conventions import non_source_findings
+
+pytestmark = pytest.mark.analysis
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_pass(src: str, pass_name: str,
+             path: str = "attention_tpu/fake.py"):
+    """Run one registered file pass on a source snippet, suppression
+    applied — codes only, in source order."""
+    src = textwrap.dedent(src)
+    tree = ast.parse(src)
+    findings = list(core.PASSES[pass_name].fn(path, tree, src))
+    lines = src.splitlines()
+    kept = [f for f in findings if not core.is_suppressed(f, lines)]
+    return sorted(kept, key=lambda f: (f.line, f.col, f.code))
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------- purity (ATP1xx) ----------------------
+
+def test_purity_flags_impure_calls_under_jit():
+    fs = run_pass(
+        """
+        import time, numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            noise = np.random.normal(size=3)
+            print("step", t)
+            return x + noise
+        """,
+        "purity")
+    assert codes(fs) == ["ATP101", "ATP101", "ATP101"]
+    assert "time.time()" in fs[0].message
+
+
+def test_purity_ignores_impure_calls_outside_traced_scopes():
+    fs = run_pass(
+        """
+        import time, numpy as np
+
+        def host_setup(x):
+            print("building", time.time())
+            return np.random.normal(size=3) + x
+        """,
+        "purity")
+    assert fs == []
+
+
+def test_purity_traces_partial_jit_and_pallas_kernels():
+    fs = run_pass(
+        """
+        import functools, time, jax
+        from jax.experimental import pallas as pl
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            time.sleep(0.1)
+            return x
+
+        def _kernel(x_ref, o_ref):
+            import numpy as np
+            o_ref[...] = x_ref[...] * np.random.rand()
+
+        def launch(x):
+            return pl.pallas_call(functools.partial(_kernel))(x)
+        """,
+        "purity")
+    assert codes(fs) == ["ATP101", "ATP101"]
+
+
+def test_purity_host_coercions_and_mutation():
+    fs = run_pass(
+        """
+        import jax
+
+        STATE = {}
+
+        @jax.jit
+        def step(x, lr):
+            global STATE
+            STATE["x"] = x
+            scale = float(lr)
+            return (x * scale).sum().item()
+        """,
+        "purity")
+    assert codes(fs) == ["ATP103", "ATP103", "ATP102", "ATP102"]
+
+
+def test_purity_captured_ref_store_in_nested_fn_is_clean():
+    # the @pl.when idiom: a nested fn mutates the ENCLOSING kernel's
+    # scratch refs — bound up the lexical chain, so pure by design
+    fs = run_pass(
+        """
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, acc_scr):
+            @pl.when(True)
+            def _tile():
+                acc_scr[...] = acc_scr[...] + x_ref[...]
+            o_ref[...] = acc_scr[...]
+
+        def launch(x):
+            return pl.pallas_call(_kernel)(x)
+        """,
+        "purity")
+    assert fs == []
+
+
+# ---------------------- pallas (ATP2xx) ----------------------
+
+def test_pallas_index_map_arity_vs_grid():
+    fs = run_pass(
+        """
+        from jax.experimental import pallas as pl
+
+        def f(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+            )(x)
+        """,
+        "pallas")
+    assert "ATP201" in codes(fs)
+
+
+def test_pallas_matching_contract_is_clean():
+    fs = run_pass(
+        """
+        from jax.experimental import pallas as pl
+
+        def f(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 4),
+                in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
+            )(x)
+        """,
+        "pallas")
+    assert fs == []
+
+
+def test_pallas_block_rank_vs_index_map_return():
+    fs = run_pass(
+        """
+        from jax.experimental import pallas as pl
+
+        def f(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 4),
+                in_specs=[pl.BlockSpec((1, 8, 128), lambda i, j: (i, j))],
+            )(x)
+        """,
+        "pallas")
+    assert "ATP202" in codes(fs)
+
+
+def test_pallas_out_shape_dtype_vs_store():
+    fs = run_pass(
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...].astype(jnp.bfloat16)
+
+        def f(x):
+            return pl.pallas_call(
+                _kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            )(x)
+        """,
+        "pallas")
+    assert codes(fs) == ["ATP203"]
+
+
+def test_pallas_tile_alignment():
+    fs = run_pass(
+        """
+        from jax.experimental import pallas as pl
+
+        def f(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((7, 100), lambda i: (0, i))],
+            )(x)
+        """,
+        "pallas")
+    assert codes(fs).count("ATP204") == 2  # 100 % 128 and 7 % 8
+
+
+def test_pallas_variable_shapes_are_skipped():
+    fs = run_pass(
+        """
+        from jax.experimental import pallas as pl
+
+        def f(x, kern, block_q, d, grid):
+            return pl.pallas_call(
+                kern,
+                grid=grid,
+                in_specs=[pl.BlockSpec((1, block_q, d),
+                                       lambda i, j, k: (0, i, 0))],
+            )(x)
+        """,
+        "pallas")
+    assert fs == []
+
+
+# ---------------------- precision (ATP3xx) ----------------------
+
+def test_precision_lowprec_dot_without_preferred_type():
+    fs = run_pass(
+        """
+        import jax.numpy as jnp
+
+        def f(q, k):
+            return jnp.dot(q.astype(jnp.bfloat16), k)
+        """,
+        "precision")
+    assert codes(fs) == ["ATP301"]
+
+
+def test_precision_preferred_type_is_clean():
+    fs = run_pass(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def f(q, k):
+            qb = q.astype(jnp.bfloat16)
+            s = jax.lax.dot_general(
+                qb, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return jnp.einsum("mn,nd->md", s, k,
+                              preferred_element_type=jnp.float32)
+        """,
+        "precision")
+    assert fs == []
+
+
+def test_precision_tracks_names_and_upcasts():
+    fs = run_pass(
+        """
+        import jax.numpy as jnp
+
+        def f(q, k):
+            q8 = q.astype(jnp.int8)
+            k32 = k.astype(jnp.float32)
+            a = jnp.einsum("md,nd->mn", q8, k32)   # q8 still int8: flag
+            b = jnp.matmul(k32, k32)               # fp32: clean
+            return a, b
+        """,
+        "precision")
+    assert codes(fs) == ["ATP301"]
+
+
+def test_precision_matmul_operator_and_exp():
+    fs = run_pass(
+        """
+        import jax.numpy as jnp
+
+        def f(q, k, s):
+            y = q.astype(jnp.bfloat16) @ k
+            p = jnp.exp(s.astype(jnp.bfloat16))
+            ok = jnp.exp(s)
+            return y, p, ok
+        """,
+        "precision")
+    assert codes(fs) == ["ATP301", "ATP302"]
+
+
+# ---------------------- errors (ATP4xx) ----------------------
+
+def test_errors_flags_generic_raises_in_typed_paths():
+    src = """
+        from attention_tpu.ops.paged import OutOfPagesError
+
+        def admit(n):
+            if n < 0:
+                raise ValueError("n must be >= 0")
+            if n > 100:
+                raise RuntimeError("pool wedged")
+            raise OutOfPagesError("typed: fine")
+        """
+    fs = run_pass(src, "errors", path="attention_tpu/engine/x.py")
+    assert codes(fs) == ["ATP402", "ATP401"]
+    # the same file outside engine//chaos/ is out of the rule's scope
+    assert run_pass(src, "errors", path="attention_tpu/ops/x.py") == []
+
+
+# ---------------------- conventions (ATP5xx/ATP601) ----------------------
+
+def test_obs_naming_pass_literal_vs_dynamic():
+    fs = run_pass(
+        """
+        from attention_tpu import obs
+
+        C = obs.counter("EngineSteps")
+        S = obs.span("just_one_segment")
+        G = obs.gauge(dynamic_name)
+        OK = obs.counter("engine.steps.run")
+        """,
+        "obs-naming")
+    assert codes(fs) == ["ATP501", "ATP501"]
+
+
+def test_non_source_guard():
+    fs = non_source_findings([
+        "attention_tpu/ops/flash.py",
+        "attention_tpu/ops/flash.pyc",
+        "tests/__pycache__/test_x.py",
+        "attention_tpu/_native/libattn.so",
+        "tests/test_ops.py",
+    ])
+    assert sorted(f.path for f in fs) == [
+        "attention_tpu/_native/libattn.so",
+        "attention_tpu/ops/flash.pyc",
+        "tests/__pycache__/test_x.py",
+    ]
+    assert {f.code for f in fs} == {"ATP601"}
+
+
+# ---------------------- suppression ----------------------
+
+def test_inline_suppression_by_code_and_bare():
+    base = """
+        import time, jax
+
+        @jax.jit
+        def step(x):
+            t = time.time(){}
+            return x + t
+        """
+    assert codes(run_pass(base.format(""), "purity")) == ["ATP101"]
+    assert run_pass(base.format("  # atp: disable=ATP101"),
+                    "purity") == []
+    assert run_pass(base.format("  # atp: disable"), "purity") == []
+    # a different code on the directive does NOT suppress
+    assert codes(run_pass(base.format("  # atp: disable=ATP301"),
+                          "purity")) == ["ATP101"]
+
+
+# ---------------------- baseline ----------------------
+
+def _finding(code="ATP402", path="attention_tpu/engine/x.py",
+             msg="raise ValueError in a typed-error path"):
+    return core.Finding(code, msg, path, 10, 4)
+
+
+def test_baseline_roundtrip_and_matching(tmp_path):
+    entries = [
+        report.BaselineEntry(code="ATP402",
+                             path="attention_tpu/engine/x.py",
+                             justification="API-boundary validation",
+                             count=2),
+    ]
+    p = tmp_path / "baseline.json"
+    report.save_baseline(str(p), entries)
+    loaded = report.load_baseline(str(p))
+    assert loaded == entries
+
+    remaining, problems = report.apply_baseline(
+        [_finding(), _finding()], loaded)
+    assert remaining == [] and problems == []
+
+    # count drift (a third ValueError appears) fails the gate
+    remaining, problems = report.apply_baseline(
+        [_finding(), _finding(), _finding()], loaded)
+    assert remaining == [] and any("count drift" in p for p in problems)
+
+    # stale entries (finding fixed but entry kept) fail the gate too
+    remaining, problems = report.apply_baseline([], loaded)
+    assert any("stale" in p for p in problems)
+
+
+def test_baseline_rejects_silent_entries(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"code": "ATP402",
+                     "path": "attention_tpu/engine/x.py",
+                     "justification": "   "}],
+    }))
+    with pytest.raises(ValueError, match="no justification"):
+        report.load_baseline(str(p))
+
+
+# ---------------------- renderers ----------------------
+
+def test_json_and_sarif_schema_smoke():
+    fs = [_finding(), _finding(code="ATP101", msg="impure host call")]
+    j = json.loads(report.render_json(fs, ["stale baseline entry: x"]))
+    assert j["version"] == 1
+    assert j["counts"] == {"ATP101": 1, "ATP402": 1}
+    assert len(j["findings"]) == 2 and len(j["baseline_problems"]) == 1
+    assert j["findings"][0]["severity"] in ("error", "warning")
+
+    s = json.loads(report.render_sarif(fs))
+    assert s["version"] == "2.1.0"
+    run = s["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == {"ATP101", "ATP402"}
+    res = run["results"][0]
+    assert res["ruleId"] in rule_ids
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] and loc["region"]["startLine"]
+
+
+def test_text_render_clean_and_dirty():
+    assert report.render_text([]) == "analysis OK\n"
+    text = report.render_text([_finding()])
+    assert "ATP402" in text and "1 finding(s)" in text
+
+
+# ---------------------- registry ----------------------
+
+def test_every_registered_pass_has_codes_and_stable_ids():
+    assert set(core.PASSES) == {"purity", "pallas", "precision",
+                                "errors", "obs-naming", "shipped-table",
+                                "tolerance-ledger", "source-only-tree"}
+    for p in core.PASSES.values():
+        assert p.codes, p.name
+        assert p.scope in ("file", "project")
+    # stable public ids: retiring/renumbering any of these is a break
+    assert {"ATP001", "ATP101", "ATP102", "ATP103", "ATP201", "ATP202",
+            "ATP203", "ATP204", "ATP301", "ATP302", "ATP401", "ATP402",
+            "ATP501", "ATP502", "ATP503", "ATP601"} <= set(core.CODES)
+
+
+# ---------------------- CLI + wrappers + the tier-1 gate ----------------
+
+def _run(args, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, *args], cwd=_REPO,
+                          capture_output=True, text=True, env=env, **kw)
+
+
+def test_legacy_wrappers_keep_contract():
+    """The absorbed check_* scripts: same happy-path stdout, exit 0."""
+    r = _run(["scripts/check_obs_names.py"])
+    assert r.returncode == 0 and r.stdout == "obs names OK\n"
+    r = _run(["scripts/check_shipped_table.py"])
+    assert r.returncode == 0
+    assert r.stdout.startswith("OK   ")
+    assert r.stdout.endswith("entries, schema valid\n")
+    r = _run(["scripts/check_tolerances.py"])
+    assert r.returncode == 0
+    assert r.stdout.startswith("OK   ")
+    assert r.stdout.endswith("budgets match chaos/budgets.py\n")
+
+
+def test_tree_wide_analysis_is_clean_modulo_baseline():
+    """THE gate this PR lands: the committed tree has zero unbaselined
+    findings (scripts/check_all.py is what CI runs)."""
+    r = _run(["scripts/check_all.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout == "analysis OK\n"
+
+
+def test_cli_analyze_json_on_fixture_file(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(
+        """
+        import time, jax
+
+        @jax.jit
+        def step(x):
+            return x + time.time()
+        """))
+    from attention_tpu.cli import main
+
+    rc = _run(["-m", "attention_tpu.cli", "analyze", str(bad),
+               "--format", "json"])
+    assert rc.returncode == 1
+    payload = json.loads(rc.stdout)
+    assert payload["counts"].get("ATP101") == 1
+    assert main(["analyze", "--list-codes"]) == 0
