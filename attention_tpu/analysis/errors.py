@@ -15,6 +15,12 @@ that distinction, so inside the ``engine/`` and ``chaos/`` trees:
   ``analysis/baseline.json`` so a *new* one forces a conscious choice
   between a typed error and a justified baseline bump.
 
+ISSUE 6 extended the scope over ``frontend/``: the resilient front
+end grew its own typed trio (``DeadlineExceededError`` /
+``ReplicaDeadError`` / ``RequestShedError`` in
+``attention_tpu.engine.errors``), so a bare RuntimeError there is just
+as much an erasure as in the engine.
+
 Raising a *name that ends in Error but is locally defined or imported
 from this package* is the blessed pattern and never flagged.
 """
@@ -33,16 +39,18 @@ from attention_tpu.analysis.core import (
 
 ATP401 = register_code(
     "ATP401", "generic-runtime-raise-in-typed-path", Severity.ERROR,
-    "raise RuntimeError/Exception/AssertionError under engine/ or "
-    "chaos/ — use a typed error (OutOfPagesError lineage)")
+    "raise RuntimeError/Exception/AssertionError under engine/, "
+    "chaos/, or frontend/ — use a typed error (OutOfPagesError "
+    "lineage)")
 ATP402 = register_code(
     "ATP402", "generic-value-raise-in-typed-path", Severity.WARNING,
-    "raise ValueError under engine/ or chaos/ — argument validation "
-    "is baselined per file; new ones need a typed error or a "
-    "justified baseline entry")
+    "raise ValueError under engine/, chaos/, or frontend/ — argument "
+    "validation is baselined per file; new ones need a typed error "
+    "or a justified baseline entry")
 
 #: trees where the typed taxonomy is the contract
-_TYPED_PATHS = ("attention_tpu/engine/", "attention_tpu/chaos/")
+_TYPED_PATHS = ("attention_tpu/engine/", "attention_tpu/chaos/",
+                "attention_tpu/frontend/")
 _GENERIC = {"RuntimeError", "Exception", "AssertionError"}
 
 

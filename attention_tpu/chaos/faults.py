@@ -327,7 +327,7 @@ def run_campaign(seed: int, *, num_plans: int = 5,
                  events_per_plan: int = 4,
                  config: EngineConfig | None = None,
                  model=None, params=None,
-                 log: Callable[[str], None] | None = None
+                 log: Callable[[str], None] | None = None,
                  ) -> FaultCampaignReport:
     """One seeded fault campaign: a fault-free baseline run, then
     ``num_plans`` seeded plans against the SAME trace, each checked
@@ -355,3 +355,309 @@ def run_campaign(seed: int, *, num_plans: int = 5,
         reports.append(r)
     return FaultCampaignReport(seed=seed, baseline_outputs=baseline,
                                reports=reports)
+
+
+# ------------------------------------------- multi-replica storm plans
+
+
+FRONTEND_FAULT_KINDS = ("replica_kill", "replica_restart", "oom",
+                        "preempt", "cancel")
+
+
+def random_frontend_plan(seed: int, request_ids: Sequence[str],
+                         num_replicas: int, *, num_events: int = 5,
+                         max_tick: int = 24,
+                         kinds: Sequence[str] = FRONTEND_FAULT_KINDS,
+                         ) -> FaultPlan:
+    """Sample one seeded multi-replica storm plan.  Reuses the
+    engine-plan schema (`FaultEvent.target` carries a replica id for
+    replica-scoped kinds, a request id for ``cancel``).  Every
+    ``replica_kill`` schedules a matching ``replica_restart`` a few
+    ticks later with high probability, so storms exercise the
+    kill -> requeue -> recover arc and not just attrition."""
+    rng = np.random.default_rng(seed)
+    events = []
+    for _ in range(num_events):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        step = int(rng.integers(1, max_tick))
+        arg, target = 1, None
+        if kind == "replica_kill":
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if rng.random() < 0.75:
+                events.append(FaultEvent(
+                    step=step + int(rng.integers(2, 7)),
+                    kind="replica_restart", target=target))
+        elif kind == "replica_restart":
+            target = f"replica-{int(rng.integers(num_replicas))}"
+        elif kind in ("oom", "preempt"):
+            arg = int(rng.integers(1, 3))
+            target = f"replica-{int(rng.integers(num_replicas))}"
+        elif kind == "cancel":
+            target = request_ids[int(rng.integers(len(request_ids)))]
+        events.append(FaultEvent(step=step, kind=kind, arg=arg,
+                                 target=target))
+    events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
+class FrontendFaultInjector:
+    """Attaches a storm plan to one `ServingFrontend`: wraps ``tick``
+    and fires due events before the round runs.  Replica-scoped OOM
+    windows wrap the CURRENT engine's allocator (a restarted engine
+    starts clean — exactly like a real process restart shedding its
+    fault state)."""
+
+    def __init__(self, frontend, plan: FaultPlan):
+        self.frontend = frontend
+        self.plan = plan
+        self.injected = 0
+        self.cancelled: list[str] = []
+        self.skipped: list[str] = []
+        self._orig_tick = frontend.tick
+        frontend.tick = self._tick
+
+    def _mark(self, kind: str) -> None:
+        self.injected += 1
+        _INJECTED.inc(kind=kind)
+
+    def _tick(self):
+        for ev in self.plan.events:
+            if ev.step == self.frontend.current_tick:
+                self._fire(ev)
+        return self._orig_tick()
+
+    def _handle(self, replica_id: str | None):
+        return next((h for h in self.frontend.replicas
+                     if h.replica_id == replica_id), None)
+
+    def _fire(self, ev: FaultEvent) -> None:
+        if ev.kind == "replica_kill":
+            if self.frontend.kill_replica(ev.target):
+                self._mark("replica_kill")
+            else:
+                self.skipped.append(f"replica_kill:{ev.target}")
+        elif ev.kind == "replica_restart":
+            if self.frontend.restart_replica(ev.target):
+                self._mark("replica_restart")
+            else:
+                self.skipped.append(f"replica_restart:{ev.target}")
+        elif ev.kind == "oom":
+            handle = self._handle(ev.target)
+            if handle is None or not handle.alive:
+                self.skipped.append(f"oom:{ev.target}")
+                return
+            self._arm_oom(handle, ev.arg)
+        elif ev.kind == "preempt":
+            handle = self._handle(ev.target)
+            if handle is None or not handle.alive:
+                self.skipped.append(f"preempt:{ev.target}")
+                return
+            self._preempt_storm(handle, ev.arg)
+        elif ev.kind == "cancel":
+            if self.frontend.cancel(ev.target):
+                self.cancelled.append(ev.target)
+                self._mark("cancel")
+            else:
+                self.skipped.append(f"cancel:{ev.target}")
+        else:
+            raise ValueError(f"unknown frontend fault kind {ev.kind!r}")
+
+    def _arm_oom(self, handle, count: int) -> None:
+        """The next ``count`` admission-path allocations on this
+        replica's CURRENT engine raise — the scheduler defers those
+        admissions, and the front end's stall detector must migrate
+        the starved requests elsewhere."""
+        alloc = handle.engine.allocator
+        state = {"left": count}
+        orig = alloc.allocate
+
+        def wrapped(n, *, for_decode=False):
+            if not for_decode and state["left"] > 0:
+                state["left"] -= 1
+                self._mark("oom")
+                raise OutOfPagesError(
+                    f"chaos: injected admission OutOfPagesError on "
+                    f"{handle.replica_id}"
+                )
+            return orig(n, for_decode=for_decode)
+
+        alloc.allocate = wrapped
+
+    def _preempt_storm(self, handle, count: int) -> None:
+        sched = handle.engine.scheduler
+        for _ in range(count):
+            if not sched.running:
+                return
+            victim = max(sched.running, key=sched._fcfs)
+            sched._preempt(victim, ScheduledStep(
+                step=handle.engine.current_step))
+            self._mark("preempt")
+
+
+@dataclasses.dataclass
+class FrontendPlanReport:
+    """One storm's verdict (the frontend analogue of `PlanReport`)."""
+
+    plan: FaultPlan
+    injected: int
+    cancelled: list[str]
+    skipped: list[str]
+    outputs: dict[str, list[int]]
+    states: dict[str, str]
+    violations: list[str]
+    surfaced_error: str | None
+    drained: bool
+    summary: dict[str, Any]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["plan"] = json.loads(self.plan.to_json())
+        return d
+
+
+def default_frontend_config(num_replicas: int = 3, **overrides):
+    """Storm-campaign front-end geometry: tight retry budget so
+    exhaustion paths actually fire, short stall window so injected
+    OOM windows visibly migrate requests."""
+    from attention_tpu.frontend import FrontendConfig, RetryPolicy
+
+    kw: dict[str, Any] = dict(
+        num_replicas=num_replicas, seed=0,
+        retry=RetryPolicy(max_retries=4, base_delay_ticks=1,
+                          max_delay_ticks=8),
+        stall_ticks=3,
+    )
+    kw.update(overrides)
+    return FrontendConfig(**kw)
+
+
+def run_frontend_plan(model, params, config: EngineConfig,
+                      frontend_config, trace: list[dict[str, Any]],
+                      plan: FaultPlan, *,
+                      baseline: dict[str, list[int]] | None = None,
+                      max_ticks: int = 1000) -> FrontendPlanReport:
+    """Replay ``trace`` through a fresh front end with ``plan``
+    attached; check every invariant that applies — including the two
+    ISSUE 6 checkers (no request lost, surviving-replica
+    conservation).  ``baseline`` (a fault-free SINGLE-replica run)
+    enables token parity over finished requests."""
+    from attention_tpu.frontend import ServingFrontend, replay_frontend
+
+    frontend = ServingFrontend(model, params, config, frontend_config)
+    injector = FrontendFaultInjector(frontend, plan)
+    error: BaseException | None = None
+    outputs: dict[str, list[int]] = {}
+    summary: dict[str, Any] = {}
+    try:
+        summary, outputs = replay_frontend(frontend, trace,
+                                           max_ticks=max_ticks)
+    except Exception as e:  # noqa: BLE001 - the typed-error invariant
+        error = e           # decides what may land here
+        outputs = frontend.outputs()
+    drained = error is None and not frontend.has_work()
+
+    from attention_tpu.frontend.frontend import FrontendRequestState
+
+    violations = []
+    violations += inv.replica_conservation_violations(frontend,
+                                                      drained=drained)
+    if drained:
+        violations += inv.no_request_lost_violations(frontend)
+        if baseline is not None:
+            finished = {
+                fr.request_id
+                for fr in frontend.requests.values()
+                if fr.state is FrontendRequestState.FINISHED
+            }
+            violations += inv.token_parity_violations(
+                {rid: toks for rid, toks in baseline.items()
+                 if rid in finished},
+                outputs,
+            )
+    violations += inv.termination_violations(drained, error,
+                                             max_steps=max_ticks)
+    violations += inv.typed_error_violations(error)
+    return FrontendPlanReport(
+        plan=plan, injected=injector.injected,
+        cancelled=injector.cancelled, skipped=injector.skipped,
+        outputs=outputs,
+        states={fr.request_id: fr.state.value
+                for fr in sorted(frontend.requests.values(),
+                                 key=lambda f: f.seq)},
+        violations=violations,
+        surfaced_error=None if error is None else type(error).__name__,
+        drained=drained,
+        summary=summary,
+    )
+
+
+@dataclasses.dataclass
+class FrontendCampaignReport:
+    seed: int
+    num_replicas: int
+    baseline_outputs: dict[str, list[int]]
+    reports: list[FrontendPlanReport]
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.reports)
+
+    @property
+    def total_injected(self) -> int:
+        return sum(r.injected for r in self.reports)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "replicas": self.num_replicas,
+            "plans": len(self.reports),
+            "injected": self.total_injected,
+            "violations": sum(len(r.violations) for r in self.reports),
+            "reports": [r.to_dict() for r in self.reports],
+        }
+
+
+def run_frontend_campaign(seed: int, *, num_plans: int = 5,
+                          num_requests: int = 6, num_replicas: int = 3,
+                          temperature: float = 0.0,
+                          events_per_plan: int = 5,
+                          config: EngineConfig | None = None,
+                          model=None, params=None,
+                          log: Callable[[str], None] | None = None,
+                          ) -> FrontendCampaignReport:
+    """One seeded storm campaign: a fault-free SINGLE-replica baseline
+    run, then ``num_plans`` seeded replica-kill/OOM/preemption storms
+    against the same trace through an N-replica front end, each
+    checked for all six invariants."""
+    if model is None or params is None:
+        model, params = build_sim_model()
+    config = config or default_engine_config()
+    trace = synthetic_trace(
+        num_requests, vocab=model.vocab, seed=seed, max_tokens=6,
+        temperature=temperature,
+    )
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    ids = [t["id"] for t in trace]
+    reports = []
+    for i in range(num_plans):
+        plan = random_frontend_plan(seed * 2003 + i, ids, num_replicas,
+                                    num_events=events_per_plan)
+        r = run_frontend_plan(
+            model, params, config,
+            default_frontend_config(num_replicas), trace, plan,
+            baseline=baseline,
+        )
+        if log is not None:
+            log(f"storm {i} (seed {plan.seed}): injected={r.injected} "
+                f"violations={len(r.violations)} "
+                f"states={sorted(set(r.states.values()))} "
+                f"error={r.surfaced_error or 'none'}")
+        reports.append(r)
+    return FrontendCampaignReport(seed=seed, num_replicas=num_replicas,
+                                  baseline_outputs=baseline,
+                                  reports=reports)

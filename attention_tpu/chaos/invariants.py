@@ -18,8 +18,21 @@ The four invariants the fault harness pins (ISSUE 4):
 3. **Termination** — the engine drains every trace within a step
    bound; no fault plan may wedge the step loop.
 4. **Typed errors** — anything that does escape the step loop is one
-   of the typed capacity/accounting errors (`OutOfPagesError`,
-   `PageAccountingError`), never a bare RuntimeError three layers down.
+   of the typed serving errors (`OutOfPagesError`,
+   `PageAccountingError`, and the resilience trio
+   `DeadlineExceededError` / `ReplicaDeadError` / `RequestShedError`),
+   never a bare RuntimeError three layers down.
+
+The multi-replica front end (ISSUE 6) adds two more:
+
+5. **No request lost** — every request submitted to a
+   `ServingFrontend` reaches exactly one of the four terminal states
+   (FINISHED / CANCELLED / TIMED_OUT / SHED), finished streams are
+   complete, and shed/timed-out requests carry their typed cause.
+6. **Replica conservation** — page/refcount conservation (and, once
+   drained, prefix-cache-only quiescence) holds on every SURVIVING
+   replica of a storm; a neighbor's death may not corrupt anyone
+   else's pool.
 """
 
 from __future__ import annotations
@@ -27,10 +40,20 @@ from __future__ import annotations
 from typing import Iterable, Mapping
 
 from attention_tpu import obs
+from attention_tpu.engine.errors import (
+    DeadlineExceededError,
+    ReplicaDeadError,
+    RequestShedError,
+)
 from attention_tpu.ops.paged import OutOfPagesError, PageAccountingError
 
 _VIOLATIONS = obs.counter("chaos.invariant.violations",
                           "invariant-checker violations, by invariant")
+
+#: everything that may legitimately escape a serving step/tick loop
+TYPED_ERRORS = (OutOfPagesError, PageAccountingError,
+                DeadlineExceededError, ReplicaDeadError,
+                RequestShedError)
 
 
 def _report(invariant: str, problems: list[str]) -> list[str]:
@@ -120,7 +143,7 @@ def termination_violations(finished: bool, error: BaseException | None,
     if not finished and error is None:
         problems.append(f"engine did not drain within {max_steps} steps")
     if isinstance(error, RuntimeError) and not isinstance(
-            error, OutOfPagesError):
+            error, TYPED_ERRORS):
         # engine.run's max_steps guard surfaces as RuntimeError: a wedge
         problems.append(f"step loop wedged: {error}")
     return _report("termination", problems)
@@ -128,11 +151,82 @@ def termination_violations(finished: bool, error: BaseException | None,
 
 def typed_error_violations(error: BaseException | None) -> list[str]:
     """Anything surfacing out of the step loop must be a typed
-    capacity/accounting error."""
-    if error is None or isinstance(error, (OutOfPagesError,
-                                           PageAccountingError)):
+    serving error (capacity/accounting or the resilience trio)."""
+    if error is None or isinstance(error, TYPED_ERRORS):
         return []
     return _report(
         "typed_errors",
         [f"untyped {type(error).__name__} escaped the engine: {error}"],
     )
+
+
+# ------------------------------------------------- front-end invariants
+
+
+def no_request_lost_violations(frontend) -> list[str]:
+    """ISSUE 6 headline: every request submitted to a
+    `ServingFrontend` terminates in exactly one of FINISHED /
+    CANCELLED / TIMED_OUT / SHED — no storm may drop a request on the
+    floor or leave it limping in a non-terminal state after the run
+    drains.  Terminal bookkeeping must be consistent: finished streams
+    complete (max_tokens or stop token), shed and timed-out requests
+    carry their typed cause."""
+    from attention_tpu.frontend.frontend import FrontendRequestState
+
+    problems = []
+    for fr in sorted(frontend.requests.values(), key=lambda f: f.seq):
+        if not fr.is_terminal:
+            problems.append(
+                f"request {fr.request_id} lost: non-terminal state "
+                f"{fr.state.name} after drain"
+            )
+            continue
+        if fr.state is FrontendRequestState.FINISHED:
+            stopped = (fr.sampling.stop_token is not None
+                       and fr.sampling.stop_token in fr.tokens)
+            if len(fr.tokens) != fr.sampling.max_tokens and not stopped:
+                problems.append(
+                    f"request {fr.request_id} FINISHED with "
+                    f"{len(fr.tokens)}/{fr.sampling.max_tokens} tokens "
+                    "and no stop token"
+                )
+        elif fr.state is FrontendRequestState.SHED:
+            if not isinstance(fr.error, RequestShedError):
+                problems.append(
+                    f"request {fr.request_id} SHED without a "
+                    f"RequestShedError cause (got "
+                    f"{type(fr.error).__name__})"
+                )
+        elif fr.state is FrontendRequestState.TIMED_OUT:
+            if not isinstance(fr.error, DeadlineExceededError):
+                problems.append(
+                    f"request {fr.request_id} TIMED_OUT without a "
+                    f"DeadlineExceededError cause (got "
+                    f"{type(fr.error).__name__})"
+                )
+    for name, queue in (("pending", frontend._pending),
+                        ("retry", frontend._retry)):
+        if queue:
+            problems.append(
+                f"{len(queue)} request(s) stranded on the front-end "
+                f"{name} queue after drain"
+            )
+    return _report("request_conservation", problems)
+
+
+def replica_conservation_violations(frontend, *,
+                                    drained: bool) -> list[str]:
+    """Page/refcount conservation on every SURVIVING replica; after a
+    drained run each must also be quiescent (pages held only by its
+    prefix cache).  Dead replicas are exempt — their pools died with
+    them; what matters is that a neighbor's death never corrupts a
+    survivor's accounting."""
+    problems: list[str] = []
+    for handle in frontend.replicas:
+        if not handle.alive:
+            continue
+        inner = pool_accounting_violations(handle.engine.pool)
+        if drained:
+            inner += engine_quiescence_violations(handle.engine)
+        problems += [f"{handle.replica_id}: {p}" for p in inner]
+    return problems

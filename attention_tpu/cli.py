@@ -19,10 +19,16 @@ Usage:
       # cache (~/.cache/attention_tpu/) and future calls pick them up
   python -m attention_tpu.cli serve-sim [--trace trace.json]
       [--num-requests 8 --shared-prefix-len 129 --shared-count 4 ...]
+      [--replicas 3 --deadline-ms 40 --tick-ms 1 --max-retries 3
+       --chaos-plan plan.json --bursty --tenants 2]
       [--obs --obs-out run_dir [--obs-profile]]
       # continuous-batching engine over a request trace; prints
       # per-step (--per-step) and summary metrics JSON; --obs-out
-      # persists the telemetry dump for `cli obs`
+      # persists the telemetry dump for `cli obs`; --replicas N serves
+      # through the resilient multi-replica front end
+      # (attention_tpu.frontend: deadlines, retry-with-backoff, load
+      # shedding, graceful degradation) and --chaos-plan attaches a
+      # replica-kill storm
   python -m attention_tpu.cli analyze [paths ...] [--changed]
       [--format text|json|sarif] [--baseline FILE | --no-baseline]
       [--list-codes]
@@ -41,6 +47,7 @@ Usage:
   python -m attention_tpu.cli chaos replay <repro.json|repro.bin>
   python -m attention_tpu.cli chaos shrink repro.json [--bin repro.bin]
   python -m attention_tpu.cli chaos faults --seed 0 --plans 5
+      [--replicas 3]
       # differential fuzzing + engine fault injection
       # (attention_tpu.chaos): sampled kernel configs vs the fp64
       # oracle under the tolerance ledger; failing configs shrink to
@@ -217,6 +224,19 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
     model, params = _build_sim_model(args)
     if args.trace:
         trace = load_trace(args.trace)
+    elif args.bursty:
+        from attention_tpu.engine import bursty_trace
+
+        trace = bursty_trace(
+            args.num_requests, vocab=args.vocab, seed=args.seed,
+            tenants=args.tenants, burst_every=args.burst_every,
+            burst_size=args.burst_size,
+            shared_prefix_len=args.shared_prefix_len,
+            prompt_len_min=args.prompt_len_min,
+            prompt_len_max=args.prompt_len_max,
+            max_tokens=args.max_tokens,
+            temperature=args.temperature,
+        )
     else:
         trace = synthetic_trace(
             args.num_requests, vocab=args.vocab, seed=args.seed,
@@ -242,6 +262,9 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         token_budget=args.token_budget,
         watermark_pages=args.watermark_pages,
     )
+    if args.replicas:
+        return _serve_sim_frontend(args, model, params, config, trace)
+
     engine = ServingEngine(model, params, config)
     import contextlib
 
@@ -282,6 +305,64 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
     return 0
 
 
+def _serve_sim_frontend(args: argparse.Namespace, model, params,
+                        config, trace) -> int:
+    """serve-sim through the resilient multi-replica front end
+    (attention_tpu.frontend): N engine replicas, deadlines, retry,
+    shedding, optional chaos storm plan."""
+    import json
+
+    from attention_tpu.frontend import (
+        FrontendConfig,
+        RetryPolicy,
+        ServingFrontend,
+        replay_frontend,
+    )
+
+    ttl = None
+    if args.deadline_ms is not None:
+        ttl = max(1, int(round(args.deadline_ms / args.tick_ms)))
+    frontend = ServingFrontend(
+        model, params, config,
+        FrontendConfig(
+            num_replicas=args.replicas, seed=args.seed,
+            retry=RetryPolicy(max_retries=args.max_retries),
+            default_ttl_ticks=ttl,
+        ),
+    )
+    if args.chaos_plan:
+        from attention_tpu.chaos.faults import (
+            FaultPlan,
+            FrontendFaultInjector,
+        )
+
+        with open(args.chaos_plan) as f:
+            plan = FaultPlan.from_json(f.read())
+        FrontendFaultInjector(frontend, plan)
+        _logger.info("attached chaos plan: %s (%d events)",
+                     args.chaos_plan, len(plan.events))
+    summary, outputs = replay_frontend(frontend, trace,
+                                       max_ticks=args.max_steps)
+    record = frontend.to_run_record(
+        config="frontend-serve-sim",
+        extra={"num_pages": config.num_pages,
+               "page_size": config.page_size,
+               "deadline_ms": args.deadline_ms,
+               "tick_ms": args.tick_ms},
+    )
+    out = {"summary": summary,
+           "run_record": json.loads(record.to_json())}
+    if args.outputs:
+        out["outputs"] = outputs
+    if args.obs_out:
+        from attention_tpu import obs
+
+        obs.dump(args.obs_out)
+        _logger.info("wrote telemetry dump: %s", args.obs_out)
+    print(json.dumps(out))
+    return 0
+
+
 def _add_serve_sim_args(ss) -> None:
     """serve-sim's flag set, shared with scripts/engine_trace.py."""
     ss.add_argument("--trace", default=None,
@@ -304,6 +385,30 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--shared-prefix-len", type=int, default=0)
     ss.add_argument("--shared-count", type=int, default=0)
     ss.add_argument("--temperature", type=float, default=0.0)
+    # bursty multi-tenant trace knobs (engine.sim.bursty_trace)
+    ss.add_argument("--bursty", action="store_true",
+                    help="synthesize a multi-tenant bursty trace "
+                         "(sessions, priorities, per-tenant shared "
+                         "prefixes) instead of the plain one")
+    ss.add_argument("--tenants", type=int, default=2)
+    ss.add_argument("--burst-every", type=int, default=6)
+    ss.add_argument("--burst-size", type=int, default=3)
+    # resilient multi-replica front end (attention_tpu.frontend)
+    ss.add_argument("--replicas", type=int, default=0,
+                    help="serve through the resilient front end with "
+                         "N engine replicas (0 = single engine, the "
+                         "legacy path)")
+    ss.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request TTL in virtual ms "
+                         "(converted to ticks via --tick-ms; "
+                         "front-end path only)")
+    ss.add_argument("--tick-ms", type=float, default=1.0,
+                    help="virtual milliseconds per front-end tick")
+    ss.add_argument("--max-retries", type=int, default=3,
+                    help="front-end retry budget per request")
+    ss.add_argument("--chaos-plan", default=None,
+                    help="frontend fault-plan JSON (chaos.faults."
+                         "FaultPlan) to attach to the run")
     # model knobs (deterministic from --model-seed)
     ss.add_argument("--vocab", type=int, default=64)
     ss.add_argument("--dim", type=int, default=64)
@@ -483,18 +588,32 @@ def _cmd_chaos_shrink(args: argparse.Namespace) -> int:
 
 
 def _cmd_chaos_faults(args: argparse.Namespace) -> int:
-    """Seeded fault-injection campaign against the serving engine:
-    every plan must hold all four invariants (page conservation, token
-    parity, termination, typed errors).  Exit 0 iff no violations."""
+    """Seeded fault-injection campaign against the serving engine
+    (--replicas 1, default) or the multi-replica front end
+    (--replicas N > 1: replica-kill/restart storms on top of the
+    OOM/preempt/cancel kinds).  Every plan must hold the engine
+    invariants — plus, for storms, no-request-lost and surviving-
+    replica conservation.  Exit 0 iff no violations."""
     import json
 
-    from attention_tpu.chaos.faults import run_campaign
+    if args.replicas > 1:
+        from attention_tpu.chaos.faults import run_frontend_campaign
 
-    report = run_campaign(
-        args.seed, num_plans=args.plans, num_requests=args.requests,
-        temperature=args.temperature, events_per_plan=args.events,
-        log=_logger.info,
-    )
+        report = run_frontend_campaign(
+            args.seed, num_plans=args.plans,
+            num_requests=args.requests, num_replicas=args.replicas,
+            temperature=args.temperature,
+            events_per_plan=args.events, log=_logger.info,
+        )
+    else:
+        from attention_tpu.chaos.faults import run_campaign
+
+        report = run_campaign(
+            args.seed, num_plans=args.plans,
+            num_requests=args.requests,
+            temperature=args.temperature,
+            events_per_plan=args.events, log=_logger.info,
+        )
     out = report.to_dict()
     if not args.outputs:
         for r in out["reports"]:
@@ -786,6 +905,11 @@ def main(argv: list[str] | None = None) -> int:
     cfa.add_argument("--plans", type=int, default=5)
     cfa.add_argument("--requests", type=int, default=5)
     cfa.add_argument("--events", type=int, default=4)
+    cfa.add_argument("--replicas", type=int, default=1,
+                     help="storm a --replicas N multi-replica front "
+                          "end instead of a single engine (adds "
+                          "replica_kill/restart fault kinds and the "
+                          "no-request-lost invariant)")
     cfa.add_argument("--temperature", type=float, default=0.0)
     cfa.add_argument("--outputs", action="store_true",
                      help="include per-request token streams in the "

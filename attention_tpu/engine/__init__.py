@@ -29,12 +29,18 @@ from attention_tpu.engine.engine import (  # noqa: F401
     ServingEngine,
     StepLimitExceededError,
 )
+from attention_tpu.engine.errors import (  # noqa: F401
+    DeadlineExceededError,
+    ReplicaDeadError,
+    RequestShedError,
+)
 from attention_tpu.engine.metrics import (  # noqa: F401
     EngineMetrics,
     RequestMetrics,
     StepMetrics,
 )
 from attention_tpu.engine.request import (  # noqa: F401
+    TERMINAL_STATES,
     Request,
     RequestState,
     SamplingParams,
@@ -44,8 +50,10 @@ from attention_tpu.engine.scheduler import (  # noqa: F401
     Scheduler,
 )
 from attention_tpu.engine.sim import (  # noqa: F401
+    bursty_trace,
     load_trace,
     replay,
+    sampling_of,
     save_trace,
     synthetic_trace,
 )

@@ -147,6 +147,22 @@ class BlockAllocator:
 
     # -- prefix cache -----------------------------------------------------
 
+    def peek_prefix(self, tokens) -> int:
+        """Pages of the longest cached page-aligned prefix of
+        ``tokens`` WITHOUT taking references or touching hit stats /
+        LRU clocks — the multi-replica router's side-effect-free probe
+        (routing by cache contents must not perturb the cache, or the
+        probe of a replica that loses the routing race would still
+        refresh its entries)."""
+        toks = tuple(tokens)
+        limit = (len(toks) - 1) // self.page_size
+        n = 0
+        for i in range(1, limit + 1):
+            if toks[: i * self.page_size] not in self._prefix:
+                break
+            n += 1
+        return n
+
     def lookup_prefix(self, tokens, *, now: int) -> list[int]:
         """Longest cached page-aligned prefix of ``tokens``; increfs and
         returns the matched pages (caller owns one reference each).

@@ -39,6 +39,7 @@ import numpy as np
 
 from attention_tpu import obs
 from attention_tpu.engine.allocator import BlockAllocator
+from attention_tpu.engine.errors import DeadlineExceededError
 from attention_tpu.engine.metrics import (
     EngineMetrics,
     RequestMetrics,
@@ -50,6 +51,8 @@ from attention_tpu.ops.paged import OutOfPagesError, PagedKV, PagePool
 
 _CANCELLED = obs.counter("engine.requests.cancelled",
                          "requests cancelled mid-flight")
+_TIMED_OUT = obs.counter("engine.requests.timed_out",
+                         "requests expired by the deadline sweep")
 
 
 class StepLimitExceededError(RuntimeError):
@@ -119,7 +122,8 @@ class ServingEngine:
 
     def __init__(self, model, params, config: EngineConfig, *,
                  on_token: Callable[[Request, int], None] | None = None,
-                 on_finish: Callable[[Request], None] | None = None):
+                 on_finish: Callable[[Request], None] | None = None,
+                 on_timeout: Callable[[Request], None] | None = None):
         config.validate()
         if model.impl != "flash":
             raise ValueError(
@@ -130,6 +134,7 @@ class ServingEngine:
         self.config = config
         self.on_token = on_token
         self.on_finish = on_finish
+        self.on_timeout = on_timeout
 
         head_dim = model.dim // model.num_q_heads
         dtype = config.cache_dtype or model.dtype
@@ -165,12 +170,10 @@ class ServingEngine:
     def current_step(self) -> int:
         return self._step
 
-    def add_request(self, prompt, sampling: SamplingParams | None = None,
-                    *, request_id: str | None = None,
-                    arrival: int | None = None) -> Request:
-        """Enqueue one request.  ``arrival`` (engine step) defaults to
-        now; future arrivals let traces replay deterministically."""
-        sampling = sampling or SamplingParams()
+    def _validate_intake(self, prompt, sampling: SamplingParams,
+                         deadline_step: int | None) -> tuple[int, ...]:
+        """Shared admission validation for add_request/resume_request;
+        returns the normalized prompt tuple."""
         sampling.validate(self.model.vocab)
         prompt = tuple(int(t) for t in prompt)
         if any(not (0 <= t < self.model.vocab) for t in prompt):
@@ -185,6 +188,28 @@ class ServingEngine:
                 f"({sampling.max_tokens}) - 1 = {total} exceeds "
                 f"max_seq_len {self.config.max_seq_len}"
             )
+        # deadline enforcement AT ADMISSION: a request whose TTL has
+        # already elapsed never enters the queue — the typed raise is
+        # the front end's signal to mark it TIMED_OUT without burning
+        # a queue slot on it
+        if deadline_step is not None and deadline_step <= self._step:
+            raise DeadlineExceededError(
+                f"deadline step {deadline_step} is not after the "
+                f"current step {self._step}: expired before admission"
+            )
+        return prompt
+
+    def add_request(self, prompt, sampling: SamplingParams | None = None,
+                    *, request_id: str | None = None,
+                    arrival: int | None = None,
+                    deadline_step: int | None = None) -> Request:
+        """Enqueue one request.  ``arrival`` (engine step) defaults to
+        now; future arrivals let traces replay deterministically.
+        ``deadline_step`` (engine step, exclusive) arms the per-step
+        deadline sweep; an already-expired deadline raises the typed
+        `DeadlineExceededError` here instead of enqueueing."""
+        sampling = sampling or SamplingParams()
+        prompt = self._validate_intake(prompt, sampling, deadline_step)
         seq = next(self._seq)
         req = Request(
             request_id=request_id or f"req-{seq}",
@@ -192,7 +217,58 @@ class ServingEngine:
             sampling=sampling,
             arrival=self._step if arrival is None else arrival,
             seq=seq,
+            deadline_step=deadline_step,
         )
+        self._wall[req.request_id] = {"added": time.perf_counter()}
+        self.scheduler.add(req)
+        return req
+
+    def resume_request(self, prompt, sampling: SamplingParams, *,
+                       request_id: str,
+                       output_tokens: list[int] | None = None,
+                       arrival: int | None = None,
+                       deadline_step: int | None = None) -> Request:
+        """Re-admit a partially generated request — the cross-replica
+        half of preemption-by-recompute.  ``output_tokens`` are the
+        tokens already streamed to the client (by this engine before a
+        fault, or by ANOTHER replica that died); the request re-prefills
+        prompt + fed generation and resumes decoding without resampling
+        anything, exactly like a preempted request readmitting.
+
+        The RNG chain is restored arithmetically: the engine's sampler
+        performs one key split per sampled token, so splitting
+        ``PRNGKey(seed)`` ``len(output_tokens)`` times reconstructs the
+        live key a dead replica took with it — sampled continuations
+        stay token-identical to an uninterrupted run."""
+        out = [int(t) for t in (output_tokens or [])]
+        prompt = self._validate_intake(prompt, sampling, deadline_step)
+        if len(out) >= sampling.max_tokens:
+            raise ValueError(
+                f"request {request_id}: {len(out)} streamed tokens "
+                f"leave nothing to resume (max_tokens "
+                f"{sampling.max_tokens})"
+            )
+        seq = next(self._seq)
+        req = Request(
+            request_id=request_id,
+            prompt=prompt,
+            sampling=sampling,
+            arrival=self._step if arrival is None else arrival,
+            seq=seq,
+            deadline_step=deadline_step,
+        )
+        if out:
+            # between steps the invariant is: every emitted token has
+            # been fed back EXCEPT the newest, which waits in
+            # pending_token (mirrors `Request.emit`/`feed_pending`)
+            req.tokens = list(prompt) + out[:-1]
+            req.output_tokens = list(out)
+            req.pending_token = out[-1]
+            if sampling.temperature > 0.0:
+                key = jax.random.PRNGKey(sampling.seed)
+                for _ in range(len(out)):
+                    key, _ = jax.random.split(key)
+                self._rng_keys[request_id] = key
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
         return req
@@ -221,6 +297,39 @@ class ServingEngine:
                 return True
         return False
 
+    # -- deadlines --------------------------------------------------------
+
+    def _time_out(self, req: Request) -> None:
+        """Expire one request: free pages (prefix-cache references
+        survive, like cancel), terminal TIMED_OUT transition, notify."""
+        for queue in (self.scheduler.waiting, self.scheduler.running):
+            if req in queue:
+                queue.remove(req)
+        _TIMED_OUT.inc()
+        if req.pages:
+            self.allocator.free(req.pages)
+        req.pages = []
+        req.transition(RequestState.TIMED_OUT)
+        req.finish_step = self._step
+        self._rng_keys.pop(req.request_id, None)
+        self._wall.pop(req.request_id, None)
+        if self.on_timeout is not None:
+            self.on_timeout(req)
+
+    def _expire_deadlines(self) -> int:
+        """The per-step deadline sweep: every queued or running request
+        whose ``deadline_step`` has arrived is timed out before the
+        step schedules — a deadline can fire mid-prefill (chunks
+        computed, no token ever emitted) exactly as it can mid-decode."""
+        expired = [
+            r for r in (*self.scheduler.waiting, *self.scheduler.running)
+            if r.deadline_step is not None
+            and r.deadline_step <= self._step
+        ]
+        for req in expired:
+            self._time_out(req)
+        return len(expired)
+
     # -- step loop --------------------------------------------------------
 
     def step(self) -> StepMetrics:
@@ -229,6 +338,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         self._finished_in_step = 0
         with obs.span("engine.step"):
+            timed_out = self._expire_deadlines()
             sched = self.scheduler.schedule(self._step)
             if sched.decode:
                 with obs.span("engine.step.decode"):
@@ -248,6 +358,7 @@ class ServingEngine:
             admitted=len(sched.admitted),
             preempted=len(sched.preempted),
             finished=self._finished_in_step,
+            timed_out=timed_out,
             free_pages=self.pool.free_pages,
             used_pages=self.pool.used_pages,
             page_utilization=self.pool.used_pages / self.pool.num_pages,
@@ -283,6 +394,33 @@ class ServingEngine:
                     "(needs more pages than the pool can ever free)"
                 )
         return self.metrics.summary()
+
+    # -- health / drain hooks (the multi-replica front end's probes) ------
+
+    def health(self) -> dict[str, Any]:
+        """Cheap host-side pressure snapshot — what a fronting router
+        reads every tick to drive load scoring, shedding thresholds,
+        and the degradation ladder.  Pure Python state, no device
+        sync, safe to call between steps at any frequency."""
+        return {
+            "step": self._step,
+            "waiting": len(self.scheduler.waiting),
+            "running": len(self.scheduler.running),
+            "free_pages": self.pool.free_pages,
+            "used_pages": self.pool.used_pages,
+            "page_utilization": self.pool.used_pages
+            / self.pool.num_pages,
+            "cached_pages": self.allocator.cached_pages,
+            "preemptions": self.scheduler.num_preemptions,
+        }
+
+    def drain(self, *, max_steps: int | None = None) -> dict[str, Any]:
+        """Graceful shutdown: serve the current queue dry and return
+        the metrics summary.  New work only arrives through
+        add_request/resume_request, so a caller that stops admitting
+        and calls drain gets clean quiescence — every page back in the
+        pool or held solely by the prefix cache."""
+        return self.run(max_steps=max_steps)
 
     # -- batch lowering ---------------------------------------------------
 

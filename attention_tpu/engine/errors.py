@@ -1,0 +1,52 @@
+"""Typed serving errors: the resilience half of the error taxonomy.
+
+PR 2 introduced the capacity/accounting pair
+(`attention_tpu.ops.paged.OutOfPagesError` / `PageAccountingError`);
+the multi-replica front end (`attention_tpu.frontend`) adds the three
+failure modes a *resilient* serving layer must distinguish:
+
+* :class:`DeadlineExceededError` — a request's TTL expired.  Raised at
+  admission when the deadline is already in the past; requests that
+  expire mid-flight are not raised but transitioned to the terminal
+  ``TIMED_OUT`` state (the step loop must keep serving everyone else).
+* :class:`ReplicaDeadError` — an operation touched a replica that has
+  been killed.  The front end's retry machinery catches it and
+  requeues the victim's requests elsewhere; reaching a caller means
+  the retry budget could not absorb the failure.
+* :class:`RequestShedError` — admission control rejected the request
+  (load shedding, degradation-ladder policy, or an exhausted retry
+  budget).  Stored on the shed request so callers see a typed cause,
+  never a bare RuntimeError.
+
+All three subclass RuntimeError, the `OutOfPagesError` lineage — the
+ATP401 contract (attention_tpu/analysis/errors.py) extends over
+``frontend/`` so generic raises cannot creep back in.
+"""
+
+from __future__ import annotations
+
+
+class DeadlineExceededError(RuntimeError):
+    """A request's deadline/TTL expired.
+
+    Surfaced by `ServingEngine.add_request`/`resume_request` when the
+    deadline predates the admission step; mid-flight expiry instead
+    transitions the request to the terminal TIMED_OUT state."""
+
+
+class ReplicaDeadError(RuntimeError):
+    """An operation was routed at a killed replica.
+
+    `ReplicaHandle.step` (and every other engine accessor on a dead
+    handle) raises this; the front end's retry-with-backoff path
+    catches it and requeues the in-flight requests elsewhere."""
+
+
+class RequestShedError(RuntimeError):
+    """Admission control rejected the request.
+
+    Load shedding under watermark/queue pressure, the degradation
+    ladder's lowest-priority cut, or a retry budget that ran dry —
+    always deliberate policy, recorded on the request's ``error``
+    field so clients can distinguish "shed, retry later" from a
+    serving bug."""

@@ -65,6 +65,7 @@ class StepMetrics:
     admitted: int = 0
     preempted: int = 0
     finished: int = 0
+    timed_out: int = 0               # deadline-sweep expiries this step
     free_pages: int = 0
     used_pages: int = 0
     page_utilization: float = 0.0

@@ -10,6 +10,12 @@ token).  The lifecycle is a small explicit state machine —
        |        PREEMPTED <-----+
        +-----------+   (requeued; recompute on readmission)
 
+plus three terminal exits reachable from every non-terminal state:
+CANCELLED (client gone), TIMED_OUT (deadline expired — the resilient
+front end's TTL enforcement, checked at every engine step), and the
+front-end-only SHED (admission control refused the request before it
+ever touched an engine).
+
 — and every transition goes through :meth:`Request.transition`, which
 rejects illegal edges loudly (a request decoding before its prefill
 finished is exactly the kind of bug that otherwise surfaces three
@@ -29,30 +35,48 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
     PREEMPTED = "preempted"
     CANCELLED = "cancelled"
+    TIMED_OUT = "timed_out"
+    SHED = "shed"
 
+
+#: the states a request can never leave — exactly the set the
+#: resilience invariant pins: every admitted request ends in ONE of
+#: FINISHED / CANCELLED / TIMED_OUT / SHED
+TERMINAL_STATES = frozenset({
+    RequestState.FINISHED, RequestState.CANCELLED,
+    RequestState.TIMED_OUT, RequestState.SHED,
+})
 
 # legal lifecycle edges; PREFILLING -> FINISHED covers max_tokens == 1
 # (the first token is sampled at prefill completion and already ends
 # the request).  CANCELLED is reachable from every non-terminal state
-# (`ServingEngine.cancel` — a client abandoning the request), and is
-# terminal like FINISHED.
+# (`ServingEngine.cancel` — a client abandoning the request), and
+# TIMED_OUT likewise (the engine's per-step deadline sweep); both are
+# terminal like FINISHED.  SHED is the front end's admission refusal,
+# so it is only reachable from WAITING — a request that has touched an
+# engine is past the shedding gate.
 _TRANSITIONS: dict[RequestState, frozenset[RequestState]] = {
     RequestState.WAITING: frozenset(
-        {RequestState.PREFILLING, RequestState.CANCELLED}
+        {RequestState.PREFILLING, RequestState.CANCELLED,
+         RequestState.TIMED_OUT, RequestState.SHED}
     ),
     RequestState.PREFILLING: frozenset(
         {RequestState.DECODING, RequestState.FINISHED,
-         RequestState.PREEMPTED, RequestState.CANCELLED}
+         RequestState.PREEMPTED, RequestState.CANCELLED,
+         RequestState.TIMED_OUT}
     ),
     RequestState.DECODING: frozenset(
         {RequestState.FINISHED, RequestState.PREEMPTED,
-         RequestState.CANCELLED}
+         RequestState.CANCELLED, RequestState.TIMED_OUT}
     ),
     RequestState.PREEMPTED: frozenset(
-        {RequestState.PREFILLING, RequestState.CANCELLED}
+        {RequestState.PREFILLING, RequestState.CANCELLED,
+         RequestState.TIMED_OUT}
     ),
     RequestState.FINISHED: frozenset(),
     RequestState.CANCELLED: frozenset(),
+    RequestState.TIMED_OUT: frozenset(),
+    RequestState.SHED: frozenset(),
 }
 
 
@@ -117,6 +141,10 @@ class Request:
     sampling: SamplingParams
     arrival: int = 0  # engine step at which the request becomes visible
     seq: int = 0      # admission tiebreak: FCFS is (arrival, seq)
+    # engine step at which the request expires (None = no deadline):
+    # the deadline sweep at the top of every `ServingEngine.step` times
+    # out any request whose deadline_step <= the current step
+    deadline_step: int | None = None
 
     state: RequestState = RequestState.WAITING
     tokens: list[int] = dataclasses.field(default_factory=list)
@@ -149,6 +177,10 @@ class Request:
     @property
     def is_finished(self) -> bool:
         return self.state is RequestState.FINISHED
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
 
     def transition(self, new: RequestState) -> None:
         if new not in _TRANSITIONS[self.state]:

@@ -82,6 +82,11 @@ class Scheduler:
         self.waiting: list[Request] = []   # kept FCFS-sorted
         self.running: list[Request] = []   # admission order (== FCFS)
         self.num_preemptions = 0
+        # degradation-ladder hook: False turns admission-path prefix-
+        # cache lookups off (committed pages stay resident for later
+        # recovery, but new admissions recompute instead of increffing
+        # shared pages — cheaper page churn under sustained pressure)
+        self.prefix_admission = True
 
     # -- queue plumbing ---------------------------------------------------
 
@@ -211,7 +216,9 @@ class Scheduler:
                 if req.pages:  # defensive: queued requests hold nothing
                     self.allocator.free(req.pages)
                     req.pages = []
-                pages = self.allocator.lookup_prefix(req.tokens, now=step)
+                pages = (self.allocator.lookup_prefix(req.tokens,
+                                                      now=step)
+                         if self.prefix_admission else [])
                 try:
                     req.pages = pages
                     req.computed_tokens = (

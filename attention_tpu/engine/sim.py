@@ -71,6 +71,79 @@ def synthetic_trace(
     return trace
 
 
+def bursty_trace(
+    num_requests: int,
+    *,
+    vocab: int,
+    seed: int = 0,
+    tenants: int = 2,
+    burst_every: int = 6,
+    burst_size: int = 3,
+    shared_prefix_len: int = 0,
+    prompt_len_min: int = 4,
+    prompt_len_max: int = 24,
+    max_tokens: int = 8,
+    temperature: float = 0.0,
+    deadline_ticks: int | None = None,
+    priorities: tuple[int, ...] = (0, 1, 1, 2),
+) -> list[dict[str, Any]]:
+    """A seeded multi-tenant bursty trace — the front end's workload.
+
+    Requests arrive in BURSTS of ``burst_size`` every ``burst_every``
+    ticks (the diurnal-spike shape that makes load shedding and the
+    degradation ladder earn their keep), tagged with the resilience
+    fields the plain engine ignores and `replay_frontend` consumes:
+
+    * ``session``: ``tenant-<k>`` — requests of one tenant share a
+      session (sticky routing) and, when ``shared_prefix_len`` > 0,
+      a per-tenant common prompt prefix (make it >= page_size + 1 for
+      the prefix cache to engage);
+    * ``priority``: drawn from ``priorities`` (0 = highest; class 2 is
+      the sheddable tail);
+    * ``deadline_ticks``: per-request TTL relative to arrival
+      (None = no deadline).
+
+    Token 0 stays reserved as the engine's pad token.
+    """
+    if num_requests < 1:
+        raise ValueError(f"num_requests must be >= 1, got {num_requests}")
+    if tenants < 1 or burst_every < 1 or burst_size < 1:
+        raise ValueError(
+            "tenants, burst_every, and burst_size must all be >= 1"
+        )
+    if not (1 <= prompt_len_min <= prompt_len_max):
+        raise ValueError(
+            f"bad prompt length range [{prompt_len_min}, {prompt_len_max}]"
+        )
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(1, vocab, shared_prefix_len).tolist()
+        if shared_prefix_len else []
+        for _ in range(tenants)
+    ]
+    trace = []
+    for i in range(num_requests):
+        burst = i // burst_size
+        tenant = int(rng.integers(tenants))
+        n = int(rng.integers(prompt_len_min, prompt_len_max + 1))
+        body = rng.integers(1, vocab, n).tolist()
+        entry = {
+            "id": f"req-{i}",
+            "arrival": burst * burst_every,
+            "prompt": [int(t) for t in prefixes[tenant] + body],
+            "max_tokens": int(max_tokens),
+            "temperature": float(temperature),
+            "seed": int(seed + i),
+            "session": f"tenant-{tenant}",
+            "priority": int(priorities[int(rng.integers(
+                len(priorities)))]),
+        }
+        if deadline_ticks is not None:
+            entry["deadline_ticks"] = int(deadline_ticks)
+        trace.append(entry)
+    return trace
+
+
 def save_trace(path: str, trace: list[dict[str, Any]]) -> None:
     with open(path, "w") as f:
         json.dump({"requests": trace}, f, indent=1)
@@ -89,9 +162,14 @@ def load_trace(path: str) -> list[dict[str, Any]]:
     return reqs
 
 
-def _sampling_of(entry: dict[str, Any]) -> SamplingParams:
+def sampling_of(entry: dict[str, Any]) -> SamplingParams:
+    """`SamplingParams` from one trace entry (shared with the
+    multi-replica front end's `replay_frontend`)."""
     kw = {k: entry[k] for k in _SAMPLING_KEYS if entry.get(k) is not None}
     return SamplingParams(**kw)
+
+
+_sampling_of = sampling_of  # internal alias, kept for existing callers
 
 
 def replay(engine: ServingEngine, trace: list[dict[str, Any]], *,

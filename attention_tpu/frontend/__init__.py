@@ -1,0 +1,49 @@
+"""Resilient multi-replica serving front end over `ServingEngine`.
+
+The layer between clients and N engine replicas — the half of the
+ROADMAP's "millions of users" item the single-replica engine cannot
+provide: surviving a replica dying mid-decode.
+
+    submit() ──> ServingFrontend.tick()
+                   │  deadline sweep (TTL at admission + every tick)
+                   │  admission control (shed / down-class on pressure)
+                   │  Router: prefix-affine -> sticky -> least-loaded
+                   │  retry-with-backoff (seeded, virtual-clock)
+                   │  DegradationLadder (hysteretic, 4 levels)
+                   ▼
+            ReplicaHandle x N  (kill/restart-able; the chaos harness's
+                   │            fail-stop unit)
+                   ▼
+            ServingEngine x N  (PR 2: continuous batching, paged KV,
+                                prefix cache, preemption-by-recompute)
+
+Modules: `replica` (the fail-stop unit), `routing` (cache-aware
+placement), `backoff` (deterministic retry schedule), `degrade`
+(shedding thresholds + ladder), `frontend` (the tick loop and the
+terminal-state invariant).  Typed failures live in the ENGINE taxonomy
+(`attention_tpu.engine.errors`) so one import site covers both layers.
+"""
+
+from attention_tpu.frontend.backoff import RetryPolicy  # noqa: F401
+from attention_tpu.frontend.degrade import (  # noqa: F401
+    LEVELS,
+    NUM_PRIORITY_CLASSES,
+    DegradationLadder,
+    DegradePolicy,
+    ShedPolicy,
+    pool_pressure,
+    replica_pressure,
+)
+from attention_tpu.frontend.frontend import (  # noqa: F401
+    FRONTEND_TERMINAL,
+    FrontendConfig,
+    FrontendRequest,
+    FrontendRequestState,
+    ServingFrontend,
+    replay_frontend,
+)
+from attention_tpu.frontend.replica import ReplicaHandle  # noqa: F401
+from attention_tpu.frontend.routing import (  # noqa: F401
+    RouteDecision,
+    Router,
+)

@@ -1,0 +1,71 @@
+"""Deterministic retry-with-backoff: seeded jitter on a virtual clock.
+
+Production backoff is wall-clock and random; this front end's unit of
+time is the *tick* (one scheduler round across every replica), and its
+"randomness" is a counter-mode PRNG keyed by ``(seed, request_id,
+attempt)`` — so the same seed replays the same retry schedule to the
+tick, which is what lets a chaos storm assert byte-identical
+`RunRecord` across runs.  Delays grow exponentially with the attempt
+number, are capped, and carry multiplicative jitter in
+``[1 - jitter, 1 + jitter]`` to de-synchronize retry herds without
+sacrificing determinism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry budget + backoff shape for one front end.
+
+    ``max_retries`` counts REQUEUES, not attempts: a request is first
+    assigned for free, then may be requeued (replica death, admission
+    OutOfPagesError, stalled admission) at most ``max_retries`` times
+    before the budget is exhausted and it is shed with the typed
+    `RequestShedError`."""
+
+    max_retries: int = 3
+    base_delay_ticks: int = 1
+    multiplier: float = 2.0
+    max_delay_ticks: int = 16
+    jitter: float = 0.25      # +/- fraction of the exponential delay
+
+    def validate(self) -> None:
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}"
+            )
+        if self.base_delay_ticks < 1 or self.max_delay_ticks < 1:
+            raise ValueError("backoff delays must be >= 1 tick")
+        if self.multiplier < 1.0:
+            raise ValueError(
+                f"multiplier must be >= 1, got {self.multiplier}"
+            )
+        if not (0.0 <= self.jitter < 1.0):
+            raise ValueError(
+                f"jitter must be in [0, 1), got {self.jitter}"
+            )
+
+    def delay_ticks(self, seed: int, request_id: str,
+                    attempt: int) -> int:
+        """Virtual-clock delay before retry number ``attempt`` (1-based)
+        of ``request_id``.  Pure function of its arguments: the jitter
+        stream is seeded from (seed, crc32(request_id), attempt), so a
+        replayed run backs off identically."""
+        if attempt < 1:
+            raise ValueError(f"attempt must be >= 1, got {attempt}")
+        raw = self.base_delay_ticks * self.multiplier ** (attempt - 1)
+        raw = min(float(self.max_delay_ticks), raw)
+        if self.jitter:
+            rng = np.random.default_rng(
+                (seed & 0xFFFFFFFF,
+                 zlib.crc32(request_id.encode()) & 0xFFFFFFFF,
+                 attempt)
+            )
+            raw *= 1.0 + self.jitter * float(rng.uniform(-1.0, 1.0))
+        return max(1, int(round(raw)))

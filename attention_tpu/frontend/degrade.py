@@ -1,0 +1,156 @@
+"""Load shedding + the graceful-degradation ladder.
+
+Two pressure responses with different time constants:
+
+* **Shedding** is instantaneous admission control: each arriving
+  request is judged against the pressure of the BEST alive replica
+  (if even the least-loaded replica is saturated, queueing more work
+  only grows tail latency).  Above ``downclass_pressure`` normal-
+  priority arrivals are demoted one class; above ``shed_pressure``
+  the lowest class is rejected outright with the typed
+  `RequestShedError`.
+* **The ladder** responds to *sustained* pressure with hysteresis:
+  ``step_down_after`` consecutive high-pressure ticks drop one level,
+  ``recover_after`` consecutive low-pressure ticks climb one back —
+  and the high/low thresholds are separated so the ladder cannot
+  flap on a boundary load.  Levels stack:
+
+      0  normal       full token budget, prefix admission on
+      1  lean_prefill replica token budgets scaled by
+                      ``token_budget_factor`` (chunked prefill
+                      throttles first — decode latency is protected)
+      2  no_prefix    admission-path prefix-cache lookups off
+                      (page churn drops; committed pages stay
+                      resident for recovery)
+      3  shed_low     lowest-priority arrivals shed regardless of
+                      instantaneous pressure
+
+Pressure is computed from the same host-side quantities the
+``frontend.replica.*`` gauges export — but read directly off the
+replica handles, never through the obs registry: telemetry is OFF by
+default and control flow may not depend on it (the zero-overhead
+contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from attention_tpu.frontend.replica import ReplicaHandle
+
+#: ladder level names, index == level
+LEVELS = ("normal", "lean_prefill", "no_prefix", "shed_low")
+
+#: priority classes: 0 = highest; class 2 is the sheddable tail
+NUM_PRIORITY_CLASSES = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class ShedPolicy:
+    """Instantaneous admission-control thresholds."""
+
+    queue_cap: int = 8              # queue depth that counts as "full"
+    downclass_pressure: float = 0.75
+    shed_pressure: float = 0.92
+
+    def validate(self) -> None:
+        if self.queue_cap < 1:
+            raise ValueError(
+                f"queue_cap must be >= 1, got {self.queue_cap}"
+            )
+        if not (0.0 < self.downclass_pressure
+                <= self.shed_pressure <= 1.0):
+            raise ValueError(
+                "need 0 < downclass_pressure <= shed_pressure <= 1, "
+                f"got {self.downclass_pressure}/{self.shed_pressure}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradePolicy:
+    """Hysteretic ladder thresholds (see module docstring)."""
+
+    pressure_high: float = 0.8      # sustained >= this steps down
+    pressure_low: float = 0.4       # sustained <= this recovers
+    step_down_after: int = 3        # consecutive high ticks
+    recover_after: int = 5          # consecutive low ticks
+    token_budget_factor: float = 0.5
+
+    def validate(self) -> None:
+        if not (0.0 <= self.pressure_low < self.pressure_high <= 1.0):
+            raise ValueError(
+                "need 0 <= pressure_low < pressure_high <= 1, got "
+                f"{self.pressure_low}/{self.pressure_high}"
+            )
+        if self.step_down_after < 1 or self.recover_after < 1:
+            raise ValueError("hysteresis windows must be >= 1 tick")
+        if not (0.0 < self.token_budget_factor <= 1.0):
+            raise ValueError(
+                f"token_budget_factor must be in (0, 1], got "
+                f"{self.token_budget_factor}"
+            )
+
+
+def replica_pressure(handle: ReplicaHandle, *, queue_cap: int) -> float:
+    """One replica's pressure in [0, 1]: the max of its page
+    occupancy and its normalized queue depth (a dead replica is 1.0)."""
+    if not handle.alive:
+        return 1.0
+    load = handle.load()
+    page = float(load["page_utilization"])
+    queue = min(1.0, (load["waiting"] + load["running"]) / queue_cap)
+    return max(page, queue)
+
+
+def pool_pressure(replicas: Sequence[ReplicaHandle], *,
+                  queue_cap: int) -> tuple[float, float]:
+    """(best, mean) pressure over the replica set.  ``best`` (the
+    least-loaded replica) drives shedding — new work can be routed
+    there; ``mean`` drives the ladder — sustained fleet-wide load."""
+    vals = [replica_pressure(r, queue_cap=queue_cap) for r in replicas]
+    if not vals:
+        return 1.0, 1.0
+    return min(vals), sum(vals) / len(vals)
+
+
+class DegradationLadder:
+    """Level state machine with the two hysteresis counters."""
+
+    def __init__(self, policy: DegradePolicy):
+        policy.validate()
+        self.policy = policy
+        self.level = 0
+        self._high_ticks = 0
+        self._low_ticks = 0
+        self.step_downs = 0
+        self.recoveries = 0
+
+    @property
+    def level_name(self) -> str:
+        return LEVELS[self.level]
+
+    def observe(self, pressure: float) -> int:
+        """Feed one tick's mean pressure; returns the (possibly
+        changed) level.  Mid-band pressure resets both streaks — a
+        level change requires CONSECUTIVE ticks beyond a threshold."""
+        p = self.policy
+        if pressure >= p.pressure_high:
+            self._high_ticks += 1
+            self._low_ticks = 0
+        elif pressure <= p.pressure_low:
+            self._low_ticks += 1
+            self._high_ticks = 0
+        else:
+            self._high_ticks = 0
+            self._low_ticks = 0
+        if (self._high_ticks >= p.step_down_after
+                and self.level < len(LEVELS) - 1):
+            self.level += 1
+            self.step_downs += 1
+            self._high_ticks = 0
+        elif self._low_ticks >= p.recover_after and self.level > 0:
+            self.level -= 1
+            self.recoveries += 1
+            self._low_ticks = 0
+        return self.level

@@ -1,0 +1,744 @@
+"""`ServingFrontend`: N engine replicas behind one resilient door.
+
+The layer the ROADMAP's "millions of users" story needs between
+clients and `ServingEngine` replicas.  Requests are submitted once to
+the front end; everything after that — routing, admission control,
+deadline enforcement, retry, shedding, degradation — happens inside
+the deterministic ``tick`` loop:
+
+    submit() ─> QUEUED ──admit──> ASSIGNED ──────────> FINISHED
+                  │                 │  ▲ retry            │
+                  │ (deadline/shed) │  │ (backoff)        │ stream
+                  ▼                 ▼  │                  ▼
+          TIMED_OUT / SHED       RETRY_WAIT          on_token/on_finish
+                                    │
+                                    └──(budget dry)──> SHED
+
+One tick = one scheduler round: expire deadlines in the front-end
+queues, admit due arrivals (shed/route/assign), re-admit due retries,
+step EVERY alive replica exactly once (keeping each engine's step
+counter aligned with the global tick, which is what makes per-replica
+deadline translation exact), migrate admission-stalled requests, then
+feed the degradation ladder.  The headline invariant — every submitted
+request terminates in exactly one of FINISHED / CANCELLED / TIMED_OUT
+/ SHED, with finished requests token-identical to a fault-free
+single-replica run — is pinned by `chaos.invariants` under replica-kill
+storms.
+
+Determinism: the only clocks are the tick counter and each engine's
+step counter; backoff jitter is seeded (`frontend.backoff`); routing
+tiebreaks on replica index.  Same seed, same trace, same fault plan →
+byte-identical summary and `RunRecord`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from typing import Any, Callable, Sequence
+
+from attention_tpu import obs
+from attention_tpu.engine.engine import (
+    EngineConfig,
+    StepLimitExceededError,
+)
+from attention_tpu.engine.errors import (
+    DeadlineExceededError,
+    ReplicaDeadError,
+    RequestShedError,
+)
+from attention_tpu.engine.request import Request, SamplingParams
+from attention_tpu.engine.sim import sampling_of
+from attention_tpu.frontend.backoff import RetryPolicy
+from attention_tpu.frontend.degrade import (
+    NUM_PRIORITY_CLASSES,
+    DegradationLadder,
+    DegradePolicy,
+    ShedPolicy,
+    pool_pressure,
+)
+from attention_tpu.frontend.replica import ReplicaHandle
+from attention_tpu.frontend.routing import Router
+from attention_tpu.ops.paged import OutOfPagesError
+from attention_tpu.utils.profiling import RunRecord
+
+_SHED = obs.counter("frontend.shed.rejected",
+                    "arrivals rejected by admission control")
+_DOWNCLASSED = obs.counter("frontend.shed.downclassed",
+                           "arrivals demoted one priority class")
+_RETRY_SCHED = obs.counter("frontend.retry.scheduled",
+                           "requeues placed on the backoff queue")
+_RETRY_EXHAUSTED = obs.counter("frontend.retry.exhausted",
+                               "requests shed with the budget dry")
+_MIGRATED = obs.counter("frontend.retry.migrated",
+                        "admission-stalled requests moved off a replica")
+_DEADLINE_EXPIRED = obs.counter("frontend.deadline.expired",
+                                "front-end-side deadline expiries")
+_KILLED = obs.counter("frontend.replica.killed", "replica kills")
+_RESTARTED = obs.counter("frontend.replica.restarted",
+                         "replica restarts")
+_STEP_DOWN = obs.counter("frontend.degrade.step_down",
+                         "degradation-ladder level drops")
+_RECOVER = obs.counter("frontend.degrade.recover",
+                       "degradation-ladder level recoveries")
+_LEVEL_G = obs.gauge("frontend.degrade.level",
+                     "current degradation-ladder level")
+_PRESSURE_G = obs.gauge("frontend.pressure.mean",
+                        "mean replica pressure after the tick")
+_R_QUEUE_G = obs.gauge("frontend.replica.queue_depth",
+                       "per-replica waiting+running requests")
+_R_UTIL_G = obs.gauge("frontend.replica.page_util",
+                      "per-replica page-pool utilization")
+
+
+class FrontendRequestState(enum.Enum):
+    QUEUED = "queued"          # submitted, not yet on a replica
+    ASSIGNED = "assigned"      # live on a replica
+    RETRY_WAIT = "retry_wait"  # backing off before re-assignment
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    TIMED_OUT = "timed_out"
+    SHED = "shed"
+
+
+#: the front-end terminal set — the resilience invariant's alphabet
+FRONTEND_TERMINAL = frozenset({
+    FrontendRequestState.FINISHED, FrontendRequestState.CANCELLED,
+    FrontendRequestState.TIMED_OUT, FrontendRequestState.SHED,
+})
+
+# RETRY_WAIT -> RETRY_WAIT is a real edge: a retry that finds no alive
+# replica goes straight back on the backoff queue.  ASSIGNED/RETRY_WAIT
+# -> SHED is retry-budget exhaustion.
+_FE_TRANSITIONS: dict[FrontendRequestState,
+                      frozenset[FrontendRequestState]] = {
+    FrontendRequestState.QUEUED: frozenset(
+        {FrontendRequestState.ASSIGNED, FrontendRequestState.RETRY_WAIT,
+         FrontendRequestState.CANCELLED, FrontendRequestState.TIMED_OUT,
+         FrontendRequestState.SHED}
+    ),
+    FrontendRequestState.ASSIGNED: frozenset(
+        {FrontendRequestState.RETRY_WAIT, FrontendRequestState.FINISHED,
+         FrontendRequestState.CANCELLED, FrontendRequestState.TIMED_OUT,
+         FrontendRequestState.SHED}
+    ),
+    FrontendRequestState.RETRY_WAIT: frozenset(
+        {FrontendRequestState.ASSIGNED, FrontendRequestState.RETRY_WAIT,
+         FrontendRequestState.CANCELLED, FrontendRequestState.TIMED_OUT,
+         FrontendRequestState.SHED}
+    ),
+    FrontendRequestState.FINISHED: frozenset(),
+    FrontendRequestState.CANCELLED: frozenset(),
+    FrontendRequestState.TIMED_OUT: frozenset(),
+    FrontendRequestState.SHED: frozenset(),
+}
+
+
+@dataclasses.dataclass
+class FrontendRequest:
+    """One client request as the front end sees it — survives replica
+    deaths and re-assignments (the per-replica engine `Request` objects
+    are disposable; this record is the durable truth)."""
+
+    request_id: str
+    prompt: tuple[int, ...]
+    sampling: SamplingParams
+    arrival: int                      # front-end tick
+    deadline: int | None              # absolute tick (None = no TTL)
+    priority: int = 1                 # 0 = highest class
+    session: str | None = None
+    seq: int = 0
+
+    state: FrontendRequestState = FrontendRequestState.QUEUED
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    replica_id: str | None = None
+    last_replica: str | None = None
+    routed_by: str | None = None
+    attempts: int = 0                 # requeues consumed
+    next_retry: int | None = None
+    assigned_tick: int = -1
+    waiting_since: int | None = None  # stall-detection bookkeeping
+    downclassed: bool = False
+    prefix_cached_tokens: int = 0
+    finish_tick: int = -1
+    error: BaseException | None = None
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.state in FRONTEND_TERMINAL
+
+    def transition(self, new: FrontendRequestState) -> None:
+        if new not in _FE_TRANSITIONS[self.state]:
+            raise ValueError(
+                f"request {self.request_id}: illegal front-end "
+                f"transition {self.state.name} -> {new.name}"
+            )
+        self.state = new
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontendConfig:
+    """Front-end knobs; every time-like field is in ticks."""
+
+    num_replicas: int = 2
+    seed: int = 0
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    shed: ShedPolicy = dataclasses.field(default_factory=ShedPolicy)
+    degrade: DegradePolicy = dataclasses.field(
+        default_factory=DegradePolicy)
+    default_ttl_ticks: int | None = None  # applied when submit has none
+    stall_ticks: int = 4   # un-admitted for this long -> migrate
+
+    def validate(self) -> None:
+        if self.num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {self.num_replicas}"
+            )
+        if self.stall_ticks < 1:
+            raise ValueError(
+                f"stall_ticks must be >= 1, got {self.stall_ticks}"
+            )
+        if (self.default_ttl_ticks is not None
+                and self.default_ttl_ticks < 1):
+            raise ValueError(
+                f"default_ttl_ticks must be >= 1, got "
+                f"{self.default_ttl_ticks}"
+            )
+        self.retry.validate()
+        self.shed.validate()
+        self.degrade.validate()
+
+
+class ServingFrontend:
+    """Deterministic multi-replica serving front end (module doc)."""
+
+    def __init__(self, model, params, engine_config: EngineConfig,
+                 config: FrontendConfig | None = None, *,
+                 on_token: Callable[..., None] | None = None,
+                 on_finish: Callable[..., None] | None = None):
+        config = config or FrontendConfig()
+        config.validate()
+        self.model = model
+        self.params = params
+        self.engine_config = engine_config
+        self.config = config
+        self.on_token = on_token
+        self.on_finish = on_finish
+
+        self.router = Router()
+        self.ladder = DegradationLadder(config.degrade)
+        self.replicas = [
+            ReplicaHandle(
+                f"replica-{i}", model, params, engine_config,
+                on_token=self._on_engine_token,
+                on_finish=self._on_engine_finish,
+                on_timeout=self._on_engine_timeout,
+            )
+            for i in range(config.num_replicas)
+        ]
+        self._tick = 0
+        self._seq = itertools.count()
+        self.requests: dict[str, FrontendRequest] = {}
+        self._pending: list[FrontendRequest] = []  # (arrival, seq) order
+        self._retry: list[FrontendRequest] = []
+        # deterministic mirrors of the obs counters (telemetry is off
+        # by default; the summary must not depend on it)
+        self.counts = {
+            "shed_rejected": 0, "downclassed": 0,
+            "retries_scheduled": 0, "retries_exhausted": 0,
+            "migrations": 0, "deadline_expired": 0,
+            "replica_kills": 0, "replica_restarts": 0,
+        }
+
+    # -- intake -----------------------------------------------------------
+
+    @property
+    def current_tick(self) -> int:
+        return self._tick
+
+    def submit(self, prompt, sampling: SamplingParams | None = None, *,
+               request_id: str | None = None, arrival: int | None = None,
+               ttl_ticks: int | None = None, priority: int = 1,
+               session: str | None = None) -> FrontendRequest:
+        """Register one request.  ``ttl_ticks`` is relative to arrival
+        (falling back to the config default); validation happens here
+        so the tick loop never trips over a malformed request."""
+        sampling = sampling or SamplingParams()
+        sampling.validate(self.model.vocab)
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(not (0 <= t < self.model.vocab) for t in prompt):
+            raise ValueError(
+                f"prompt tokens must be in the vocab "
+                f"[0, {self.model.vocab})"
+            )
+        total = len(prompt) + sampling.max_tokens - 1
+        if total > self.engine_config.max_seq_len:
+            raise ValueError(
+                f"prompt + max_tokens - 1 = {total} exceeds "
+                f"max_seq_len {self.engine_config.max_seq_len}"
+            )
+        if not (0 <= priority < NUM_PRIORITY_CLASSES):
+            raise ValueError(
+                f"priority must be in [0, {NUM_PRIORITY_CLASSES}), "
+                f"got {priority}"
+            )
+        if ttl_ticks is not None and ttl_ticks < 1:
+            raise ValueError(f"ttl_ticks must be >= 1, got {ttl_ticks}")
+        arrival = self._tick if arrival is None else int(arrival)
+        ttl = (ttl_ticks if ttl_ticks is not None
+               else self.config.default_ttl_ticks)
+        seq = next(self._seq)
+        fr = FrontendRequest(
+            request_id=request_id or f"req-{seq}",
+            prompt=prompt,
+            sampling=sampling,
+            arrival=arrival,
+            deadline=None if ttl is None else arrival + ttl,
+            priority=int(priority),
+            session=session,
+            seq=seq,
+        )
+        if fr.request_id in self.requests:
+            raise ValueError(f"duplicate request id {fr.request_id!r}")
+        self.requests[fr.request_id] = fr
+        self._pending.append(fr)
+        self._pending.sort(key=lambda f: (f.arrival, f.seq))
+        return fr
+
+    def cancel(self, request_id: str) -> bool:
+        """Client abandons a request wherever it is; False when the
+        id is unknown or already terminal."""
+        fr = self.requests.get(request_id)
+        if fr is None or fr.is_terminal:
+            return False
+        if fr.state is FrontendRequestState.ASSIGNED:
+            handle = self._handle(fr.replica_id)
+            if handle is not None and handle.alive:
+                handle.engine.cancel(request_id)
+        self._finalize(fr, FrontendRequestState.CANCELLED)
+        return True
+
+    # -- engine callbacks -------------------------------------------------
+
+    def _on_engine_token(self, req: Request, token: int) -> None:
+        fr = self.requests[req.request_id]
+        fr.tokens.append(int(token))
+        fr.waiting_since = None
+        if self.on_token is not None:
+            self.on_token(fr, int(token))
+
+    def _on_engine_finish(self, req: Request) -> None:
+        fr = self.requests[req.request_id]
+        fr.prefix_cached_tokens = req.prefix_cached_tokens
+        self._finalize(fr, FrontendRequestState.FINISHED)
+        if self.on_finish is not None:
+            self.on_finish(fr)
+
+    def _on_engine_timeout(self, req: Request) -> None:
+        fr = self.requests[req.request_id]
+        self._finalize(
+            fr, FrontendRequestState.TIMED_OUT,
+            error=DeadlineExceededError(
+                f"request {fr.request_id} expired at tick "
+                f"{self._tick} (deadline {fr.deadline})"
+            ),
+        )
+
+    # -- tick loop --------------------------------------------------------
+
+    def tick(self) -> int:
+        """One deterministic scheduler round; returns the tick served."""
+        t = self._tick
+        with obs.span("frontend.tick"):
+            self._expire_queued(t)
+            self._admit_arrivals(t)
+            self._admit_retries(t)
+            self._step_replicas(t)
+            self._migrate_stalled(t)
+            self._update_ladder_and_gauges(t)
+        self._tick += 1
+        return t
+
+    def has_work(self) -> bool:
+        return any(not fr.is_terminal for fr in self.requests.values())
+
+    def run(self, *, max_ticks: int | None = None) -> dict[str, Any]:
+        """Tick until every submitted request is terminal."""
+        while self.has_work():
+            if max_ticks is not None and self._tick >= max_ticks:
+                live = [fr.request_id
+                        for fr in self.requests.values()
+                        if not fr.is_terminal]
+                raise StepLimitExceededError(
+                    f"front end exceeded max_ticks={max_ticks} with "
+                    f"{len(live)} live request(s): {live[:5]}"
+                )
+            self.tick()
+        return self.summary()
+
+    # -- chaos hooks ------------------------------------------------------
+
+    def kill_replica(self, replica_id: str) -> bool:
+        """Fail-stop one replica NOW: its engine (pages, caches,
+        in-flight work) is gone; every request assigned to it enters
+        the retry-with-backoff path, streamed tokens preserved."""
+        handle = self._handle(replica_id)
+        if handle is None or not handle.alive:
+            return False
+        victims = sorted(
+            (fr for fr in self.requests.values()
+             if fr.state is FrontendRequestState.ASSIGNED
+             and fr.replica_id == replica_id),
+            key=lambda f: f.seq,
+        )
+        handle.kill()
+        self.router.forget_replica(replica_id)
+        self.counts["replica_kills"] += 1
+        _KILLED.inc()
+        cause = ReplicaDeadError(
+            f"replica {replica_id} died at tick {self._tick}"
+        )
+        for fr in victims:
+            self._requeue(fr, self._tick, cause)
+        return True
+
+    def restart_replica(self, replica_id: str) -> bool:
+        """Bring a dead replica back cold at the current tick."""
+        handle = self._handle(replica_id)
+        if handle is None or handle.alive:
+            return False
+        handle.restart(tick=self._tick)
+        self._apply_ladder_to(handle)
+        self.counts["replica_restarts"] += 1
+        _RESTARTED.inc()
+        return True
+
+    # -- internals --------------------------------------------------------
+
+    def _handle(self, replica_id: str | None) -> ReplicaHandle | None:
+        return next((h for h in self.replicas
+                     if h.replica_id == replica_id), None)
+
+    def _finalize(self, fr: FrontendRequest,
+                  state: FrontendRequestState, *,
+                  error: BaseException | None = None) -> None:
+        fr.transition(state)
+        fr.finish_tick = self._tick
+        fr.next_retry = None
+        fr.waiting_since = None
+        if error is not None:
+            fr.error = error
+        if fr in self._pending:
+            self._pending.remove(fr)
+        if fr in self._retry:
+            self._retry.remove(fr)
+
+    def _expire_queued(self, t: int) -> None:
+        """Deadline sweep over the FRONT-END queues (pending arrivals
+        and the backoff queue); requests live on a replica are swept
+        by that engine's own per-step deadline check."""
+        for fr in [f for f in (*self._pending, *self._retry)
+                   if f.deadline is not None and f.deadline <= t]:
+            self.counts["deadline_expired"] += 1
+            _DEADLINE_EXPIRED.inc()
+            self._finalize(
+                fr, FrontendRequestState.TIMED_OUT,
+                error=DeadlineExceededError(
+                    f"request {fr.request_id} expired at tick {t} "
+                    f"before reaching a replica (deadline "
+                    f"{fr.deadline})"
+                ),
+            )
+
+    def _shed(self, fr: FrontendRequest, t: int, why: str) -> None:
+        self.counts["shed_rejected"] += 1
+        _SHED.inc()
+        self._finalize(
+            fr, FrontendRequestState.SHED,
+            error=RequestShedError(
+                f"request {fr.request_id} shed at tick {t}: {why}"
+            ),
+        )
+
+    def _admit_arrivals(self, t: int) -> None:
+        while self._pending and self._pending[0].arrival <= t:
+            fr = self._pending.pop(0)
+            # admission control: judge against the BEST alive replica
+            # (pressure recomputed per arrival — each admission grows
+            # a queue, so a big burst sheds its own tail)
+            best, _ = pool_pressure(
+                self.replicas, queue_cap=self.config.shed.queue_cap)
+            lowest = fr.priority >= NUM_PRIORITY_CLASSES - 1
+            if lowest and (best >= self.config.shed.shed_pressure
+                           or self.ladder.level >= 3):
+                self._shed(
+                    fr, t,
+                    f"priority-{fr.priority} arrival under pressure "
+                    f"{best:.2f} (ladder level {self.ladder.level})",
+                )
+                continue
+            if (not lowest and fr.priority > 0
+                    and best >= self.config.shed.downclass_pressure):
+                fr.priority += 1
+                fr.downclassed = True
+                self.counts["downclassed"] += 1
+                _DOWNCLASSED.inc()
+            self._assign(fr, t)
+
+    def _admit_retries(self, t: int) -> None:
+        due = sorted(
+            (fr for fr in self._retry if fr.next_retry <= t),
+            key=lambda f: (f.next_retry, f.seq),
+        )
+        for fr in due:
+            self._retry.remove(fr)
+            fr.next_retry = None
+            self._assign(fr, t, exclude=fr.last_replica)
+
+    def _assign(self, fr: FrontendRequest, t: int,
+                exclude: str | None = None) -> None:
+        decision = self.router.route(
+            fr.prompt, self.replicas, session=fr.session,
+            exclude=exclude,
+        )
+        if decision is None:
+            # nothing alive: back off and hope for a restart
+            self._requeue(fr, t, ReplicaDeadError(
+                f"no alive replica for {fr.request_id} at tick {t}"
+            ))
+            return
+        handle = decision.replica
+        deadline_step = handle.local_deadline(fr.deadline)
+        try:
+            if fr.tokens:
+                handle.engine.resume_request(
+                    fr.prompt, fr.sampling,
+                    request_id=fr.request_id,
+                    output_tokens=fr.tokens,
+                    deadline_step=deadline_step,
+                )
+            else:
+                handle.engine.add_request(
+                    fr.prompt, fr.sampling,
+                    request_id=fr.request_id,
+                    deadline_step=deadline_step,
+                )
+        except DeadlineExceededError as e:
+            self.counts["deadline_expired"] += 1
+            _DEADLINE_EXPIRED.inc()
+            self._finalize(fr, FrontendRequestState.TIMED_OUT, error=e)
+            return
+        fr.transition(FrontendRequestState.ASSIGNED)
+        fr.replica_id = handle.replica_id
+        fr.routed_by = decision.reason
+        fr.assigned_tick = t
+        fr.waiting_since = None
+
+    def _requeue(self, fr: FrontendRequest, t: int,
+                 cause: BaseException) -> None:
+        """Retry-with-backoff, or shed when the budget is dry."""
+        fr.attempts += 1
+        fr.last_replica = fr.replica_id
+        fr.replica_id = None
+        fr.waiting_since = None
+        if fr.attempts > self.config.retry.max_retries:
+            self.counts["retries_exhausted"] += 1
+            _RETRY_EXHAUSTED.inc()
+            err = RequestShedError(
+                f"request {fr.request_id}: retry budget "
+                f"({self.config.retry.max_retries}) exhausted; last "
+                f"cause: {type(cause).__name__}: {cause}"
+            )
+            err.__cause__ = cause
+            self.counts["shed_rejected"] += 1
+            _SHED.inc()
+            self._finalize(fr, FrontendRequestState.SHED, error=err)
+            return
+        delay = self.config.retry.delay_ticks(
+            self.config.seed, fr.request_id, fr.attempts)
+        fr.next_retry = t + delay
+        fr.transition(FrontendRequestState.RETRY_WAIT)
+        if fr not in self._retry:
+            self._retry.append(fr)
+        self.counts["retries_scheduled"] += 1
+        _RETRY_SCHED.inc()
+
+    def _step_replicas(self, t: int) -> None:
+        """Step every ALIVE replica exactly once — even idle ones, so
+        engine step counters stay aligned with the tick and deadline
+        translation stays exact."""
+        for handle in self.replicas:
+            if not handle.alive:
+                continue
+            try:
+                handle.step()
+            except OutOfPagesError as e:
+                self._relieve_pressure(handle, t, e)
+
+    def _relieve_pressure(self, handle: ReplicaHandle, t: int,
+                          cause: OutOfPagesError) -> None:
+        """A replica's step failed on capacity: pull its youngest
+        request (the same victim preemption would pick) back to the
+        front end and retry it elsewhere."""
+        eng = handle.engine
+        live = [*eng.scheduler.waiting, *eng.scheduler.running]
+        if not live:
+            return
+        victim = max(live, key=lambda r: (r.arrival, r.seq))
+        fr = self.requests.get(victim.request_id)
+        eng.cancel(victim.request_id)
+        if fr is not None and fr.state is FrontendRequestState.ASSIGNED:
+            self._requeue(fr, t, cause)
+
+    def _migrate_stalled(self, t: int) -> None:
+        """Admission-stall detection: a request that has sat in a
+        replica's waiting queue (injected OOM window, watermark flap,
+        pool too full) for ``stall_ticks`` consecutive ticks migrates
+        to another replica through the retry path."""
+        for handle in self.replicas:
+            if not handle.alive:
+                continue
+            waiting_ids = {r.request_id
+                           for r in handle.engine.scheduler.waiting}
+            assigned = [fr for fr in self.requests.values()
+                        if fr.state is FrontendRequestState.ASSIGNED
+                        and fr.replica_id == handle.replica_id]
+            for fr in sorted(assigned, key=lambda f: f.seq):
+                if fr.request_id not in waiting_ids:
+                    fr.waiting_since = None
+                    continue
+                if fr.waiting_since is None:
+                    fr.waiting_since = t
+                    continue
+                if t - fr.waiting_since + 1 < self.config.stall_ticks:
+                    continue
+                handle.engine.cancel(fr.request_id)
+                self.counts["migrations"] += 1
+                _MIGRATED.inc()
+                self._requeue(fr, t, OutOfPagesError(
+                    f"request {fr.request_id} admission-stalled on "
+                    f"{handle.replica_id} for "
+                    f"{self.config.stall_ticks} ticks"
+                ))
+
+    def _apply_ladder_to(self, handle: ReplicaHandle) -> None:
+        if not handle.alive:
+            return
+        eng = handle.engine
+        level = self.ladder.level
+        base = self.engine_config.token_budget
+        eng.scheduler.token_budget = (
+            base if level < 1
+            else max(1, int(base * self.config.degrade
+                            .token_budget_factor))
+        )
+        eng.scheduler.prefix_admission = level < 2
+
+    def _update_ladder_and_gauges(self, t: int) -> None:
+        _, mean = pool_pressure(
+            self.replicas, queue_cap=self.config.shed.queue_cap)
+        old = self.ladder.level
+        new = self.ladder.observe(mean)
+        if new != old:
+            (_STEP_DOWN if new > old else _RECOVER).inc()
+            for handle in self.replicas:
+                self._apply_ladder_to(handle)
+        if obs.enabled():
+            _LEVEL_G.set(self.ladder.level)
+            _PRESSURE_G.set(mean)
+            for handle in self.replicas:
+                load = handle.load()
+                _R_QUEUE_G.set(load["waiting"] + load["running"],
+                               replica=handle.replica_id)
+                _R_UTIL_G.set(load["page_utilization"],
+                              replica=handle.replica_id)
+
+    # -- reporting --------------------------------------------------------
+
+    def outputs(self) -> dict[str, list[int]]:
+        """Streamed tokens per request, submission order."""
+        return {fr.request_id: list(fr.tokens)
+                for fr in sorted(self.requests.values(),
+                                 key=lambda f: f.seq)}
+
+    def summary(self) -> dict[str, Any]:
+        """Deterministic run aggregate: every field is a pure function
+        of (seed, trace, fault plan) — no wall-clock anywhere, which is
+        what lets the chaos storm pin byte-identical reports."""
+        frs = sorted(self.requests.values(), key=lambda f: f.seq)
+        by_state = {s.value: 0 for s in FrontendRequestState}
+        for fr in frs:
+            by_state[fr.state.value] += 1
+        finished = [fr for fr in frs
+                    if fr.state is FrontendRequestState.FINISHED]
+        fin_prompt = sum(len(fr.prompt) for fr in finished)
+        fin_cached = sum(fr.prefix_cached_tokens for fr in finished)
+        return {
+            "ticks": self._tick,
+            "num_requests": len(frs),
+            "states": by_state,
+            "streamed_tokens": sum(len(fr.tokens) for fr in frs),
+            "finished_output_tokens": sum(len(fr.tokens)
+                                          for fr in finished),
+            "finished_prompt_tokens": fin_prompt,
+            "prefix_cached_tokens": fin_cached,
+            "prefix_cache_hit_rate": round(
+                fin_cached / fin_prompt, 4) if fin_prompt else 0.0,
+            "replica_deaths": sum(h.deaths for h in self.replicas),
+            "alive_replicas": sum(1 for h in self.replicas if h.alive),
+            "degrade_level": self.ladder.level,
+            "degrade_step_downs": self.ladder.step_downs,
+            "degrade_recoveries": self.ladder.recoveries,
+            **self.counts,
+        }
+
+    def to_run_record(self, *, config: str = "frontend-serve",
+                      extra: dict[str, Any] | None = None) -> RunRecord:
+        """The run as the repo's uniform benchmark row.  Deliberately
+        deterministic: timing fields (and the record timestamp) are
+        zero — the front end's unit of time is the tick — so same
+        seed -> byte-identical record."""
+        s = self.summary()
+        record = RunRecord(
+            timestamp=0.0,
+            config=config,
+            backend="frontend",
+            m=s["finished_prompt_tokens"],
+            n=s["finished_output_tokens"],
+            dk=0,
+            dv=0,
+            dtype="",
+            best_us=0.0,
+            median_us=0.0,
+            gflops_per_chip=0.0,
+            utilization=0.0,
+            device_kind="virtual",
+            n_devices=self.config.num_replicas,
+            extra={**s, **(extra or {})},
+        )
+        obs.record_run(record)
+        return record
+
+
+def replay_frontend(frontend: ServingFrontend,
+                    trace: Sequence[dict[str, Any]], *,
+                    max_ticks: int | None = 10000):
+    """Feed a trace (the `engine.sim` JSON schema, plus the optional
+    resilience fields ``session`` / ``priority`` / ``deadline_ticks``)
+    through a front end and run it dry; returns ``(summary, outputs)``
+    like `engine.sim.replay` so single-engine baselines and
+    multi-replica runs compare directly."""
+    for entry in trace:
+        frontend.submit(
+            entry["prompt"], sampling_of(entry),
+            request_id=entry.get("id"),
+            arrival=int(entry.get("arrival", 0)),
+            ttl_ticks=entry.get("deadline_ticks"),
+            priority=int(entry.get("priority", 1)),
+            session=entry.get("session"),
+        )
+    summary = frontend.run(max_ticks=max_ticks)
+    return summary, frontend.outputs()

@@ -1,0 +1,131 @@
+"""One engine replica behind a kill/restart-able handle.
+
+The front end never touches a `ServingEngine` directly: every access
+goes through a :class:`ReplicaHandle`, which is the unit of failure —
+the chaos harness kills a handle mid-storm and the front end must
+recover from its OWN bookkeeping (streamed tokens, retry queue), never
+from the dead engine's internals.  ``kill`` therefore drops the engine
+reference entirely: any later touch raises the typed
+`ReplicaDeadError`, so a resurrection bug reads as a typed error, not
+as silently serving from a corpse.
+
+``restart`` builds a fresh engine (cold caches — a restarted replica
+re-earns its prefix cache) and records the tick it came back, which is
+what keeps deadline translation exact: a replica's engine counts steps
+from ITS OWN birth, so the handle converts front-end ticks to local
+engine steps via ``start_tick``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from attention_tpu.engine.engine import EngineConfig, ServingEngine
+from attention_tpu.engine.errors import ReplicaDeadError
+from attention_tpu.engine.metrics import StepMetrics
+from attention_tpu.engine.request import Request
+
+
+class ReplicaHandle:
+    """One serving replica: engine + liveness + clock translation."""
+
+    def __init__(self, replica_id: str, model, params,
+                 config: EngineConfig, *, start_tick: int = 0,
+                 on_token: Callable[[Request, int], None] | None = None,
+                 on_finish: Callable[[Request], None] | None = None,
+                 on_timeout: Callable[[Request], None] | None = None):
+        self.replica_id = replica_id
+        self.model = model
+        self.params = params
+        self.config = config
+        self.start_tick = start_tick
+        self.deaths = 0
+        self._callbacks = (on_token, on_finish, on_timeout)
+        self._engine: ServingEngine | None = self._fresh_engine()
+
+    def _fresh_engine(self) -> ServingEngine:
+        on_token, on_finish, on_timeout = self._callbacks
+        return ServingEngine(self.model, self.params, self.config,
+                             on_token=on_token, on_finish=on_finish,
+                             on_timeout=on_timeout)
+
+    # -- liveness ---------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._engine is not None
+
+    @property
+    def engine(self) -> ServingEngine:
+        if self._engine is None:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} is dead "
+                f"(death #{self.deaths})"
+            )
+        return self._engine
+
+    def kill(self) -> None:
+        """Simulated fail-stop: the engine (and every page, cache
+        entry, and in-flight request it held) is gone.  Idempotent —
+        killing a corpse changes nothing."""
+        if self._engine is not None:
+            self._engine = None
+            self.deaths += 1
+
+    def restart(self, *, tick: int) -> None:
+        """Bring the replica back with a FRESH engine at ``tick``.
+        Cold start: empty pool, empty prefix cache, step counter 0 —
+        exactly what a real process restart gives you."""
+        if self._engine is not None:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} is already alive; "
+                "kill it before restarting"
+            )
+        self.start_tick = tick
+        self._engine = self._fresh_engine()
+
+    # -- serving ----------------------------------------------------------
+
+    def step(self) -> StepMetrics:
+        """One engine step (raises `ReplicaDeadError` when dead)."""
+        return self.engine.step()
+
+    def has_work(self) -> bool:
+        return self._engine is not None \
+            and self._engine.scheduler.has_work()
+
+    def local_deadline(self, deadline_tick: int | None) -> int | None:
+        """Front-end tick -> this engine's step space.  The handle
+        steps its engine exactly once per front-end tick, so local
+        step s corresponds to tick ``start_tick + s``."""
+        if deadline_tick is None:
+            return None
+        return deadline_tick - self.start_tick
+
+    # -- load probes ------------------------------------------------------
+
+    def load(self) -> dict[str, Any]:
+        """Host-side pressure snapshot (`ServingEngine.health`) plus
+        identity; a dead replica reports infinite pressure so routing
+        and shedding never pick it."""
+        if self._engine is None:
+            return {"replica_id": self.replica_id, "alive": False,
+                    "waiting": 0, "running": 0, "page_utilization": 1.0,
+                    "free_pages": 0, "used_pages": 0}
+        h = self._engine.health()
+        h["replica_id"] = self.replica_id
+        h["alive"] = True
+        return h
+
+    def peek_prefix_pages(self, tokens) -> int:
+        """Side-effect-free probe of this replica's prefix cache (0
+        when dead): the router's affinity signal."""
+        if self._engine is None:
+            return 0
+        return self._engine.allocator.peek_prefix(tokens)
+
+    def queue_len(self) -> int:
+        if self._engine is None:
+            return 0
+        return (len(self._engine.scheduler.waiting)
+                + len(self._engine.scheduler.running))

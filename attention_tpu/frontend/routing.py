@@ -1,0 +1,103 @@
+"""Session-affine, prefix-cache-aware request routing.
+
+The whole point of routing carefully is that the prefix cache is
+per-replica: a shared prompt prefix committed on replica A is worthless
+to a request routed to replica B.  The router therefore decides, in
+strict deterministic priority order:
+
+1. **Prefix affinity** — probe every alive replica's prefix cache
+   (side-effect-free `peek_prefix`) and route to the one holding the
+   longest committed page prefix of this prompt.  Cache hits survive
+   routing by construction.
+2. **Session stickiness** — a request carrying a ``session`` tag
+   follows its predecessors' replica.  This covers the window where a
+   tenant's first request is still PREFILLING: its prefix is not
+   committed yet, so a naive prefix-probe scatters the burst across
+   replicas and the cache never forms.  Stickiness holds the herd
+   together until the prefix lands.
+3. **Least-loaded fallback** — smallest ``(queue_len, used_pages,
+   replica index)`` among alive replicas; the index tiebreak keeps
+   placement deterministic.
+
+``exclude`` lets the retry path requeue AWAY from the replica that
+just failed a request (falling back to it only when nothing else is
+alive).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from attention_tpu import obs
+from attention_tpu.frontend.replica import ReplicaHandle
+
+_ROUTE_PREFIX = obs.counter("frontend.route.prefix_affine",
+                            "requests routed by longest cached prefix")
+_ROUTE_STICKY = obs.counter("frontend.route.sticky_session",
+                            "requests routed by session stickiness")
+_ROUTE_LOAD = obs.counter("frontend.route.least_loaded",
+                          "requests routed by the load fallback")
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteDecision:
+    replica: ReplicaHandle
+    reason: str              # "prefix" | "sticky" | "least_loaded"
+    prefix_pages: int = 0
+
+
+class Router:
+    """Stateless over replicas, stateful over sessions (the sticky
+    map).  One router per front end."""
+
+    def __init__(self):
+        self._sessions: dict[str, str] = {}   # session -> replica_id
+
+    def forget_replica(self, replica_id: str) -> None:
+        """Drop sticky entries pointing at a dead replica so its
+        sessions re-route instead of chasing the corpse."""
+        self._sessions = {s: r for s, r in self._sessions.items()
+                          if r != replica_id}
+
+    def route(self, prompt: Sequence[int],
+              replicas: Sequence[ReplicaHandle], *,
+              session: str | None = None,
+              exclude: str | None = None) -> RouteDecision | None:
+        """Pick a replica for ``prompt`` (None when nothing is alive).
+
+        ``exclude`` names a replica to avoid (the one that just failed
+        this request); it is only used as a last resort when it is the
+        sole survivor."""
+        alive = [r for r in replicas if r.alive]
+        if not alive:
+            return None
+        preferred = [r for r in alive if r.replica_id != exclude] or alive
+
+        best, best_pages = None, 0
+        for r in preferred:
+            pages = r.peek_prefix_pages(prompt)
+            if pages > best_pages:
+                best, best_pages = r, pages
+        if best is not None:
+            decision = RouteDecision(best, "prefix", best_pages)
+            _ROUTE_PREFIX.inc()
+        else:
+            sticky_id = self._sessions.get(session) if session else None
+            sticky = next((r for r in preferred
+                           if r.replica_id == sticky_id), None)
+            if sticky is not None:
+                decision = RouteDecision(sticky, "sticky")
+                _ROUTE_STICKY.inc()
+            else:
+                chosen = min(
+                    preferred,
+                    key=lambda r: (r.queue_len(),
+                                   r.load()["used_pages"],
+                                   r.replica_id),
+                )
+                decision = RouteDecision(chosen, "least_loaded")
+                _ROUTE_LOAD.inc()
+        if session:
+            self._sessions[session] = decision.replica.replica_id
+        return decision

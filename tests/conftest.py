@@ -70,6 +70,7 @@ SMOKE_TESTS = {
                     "test_flash_decode_chunk_equals_sequential_decode",
                     "test_cached_decode_matches_full_forward"],
     "test_engine": ["test_engine_token_parity_prefix_and_mixed_batching"],
+    "test_frontend": ["test_routing_affinity_keeps_prefix_hit_rate"],
     "test_quant": ["test_quantized_decode_close_to_fp",
                    "test_quantized_chunk_equals_sequential_decode"],
     "test_paged": ["test_paged_decode_matches_dense",
@@ -127,6 +128,15 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running campaigns/sweeps excluded from tier-1",
+    )
+    # the resilient-serving tier (tests/test_frontend.py): multi-
+    # replica router, deadlines, retry, shedding, degradation, and
+    # the replica-kill chaos storm; CPU-only, tier-1 fast
+    config.addinivalue_line(
+        "markers",
+        "frontend: resilient multi-replica serving front end "
+        "(attention_tpu/frontend/) — routing, deadlines, retry-with-"
+        "backoff, load shedding, degradation ladder; CPU-only",
     )
     # the static-analysis tier (tests/test_analysis.py): AST passes,
     # baseline round-trips, and the tree-wide-clean gate; jax-free

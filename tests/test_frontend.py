@@ -1,0 +1,568 @@
+"""Resilient multi-replica serving front end (attention_tpu/frontend/).
+
+Tiny CPU shapes throughout.  The flagship is the chaos-storm
+acceptance test: N=3 replicas under a seeded replica-kill + injected
+OOM window + preemption storm — every submitted request reaches
+exactly one of FINISHED / CANCELLED / TIMED_OUT / SHED, finished
+requests are token-for-token identical to a fault-free single-replica
+run, page/refcount conservation holds on every surviving replica, and
+the same seed yields a byte-identical summary/RunRecord.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attention_tpu.engine import (
+    DeadlineExceededError,
+    EngineConfig,
+    ReplicaDeadError,
+    RequestShedError,
+    SamplingParams,
+    ServingEngine,
+    bursty_trace,
+    replay,
+    sampling_of,
+    synthetic_trace,
+)
+from attention_tpu.engine.request import RequestState
+from attention_tpu.frontend import (
+    DegradationLadder,
+    DegradePolicy,
+    FrontendConfig,
+    FrontendRequestState,
+    ReplicaHandle,
+    RetryPolicy,
+    Router,
+    ServingFrontend,
+    ShedPolicy,
+    replay_frontend,
+)
+from attention_tpu.models import TinyDecoder
+
+pytestmark = pytest.mark.frontend
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = TinyDecoder(vocab=43, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), probe)["params"]
+    return model, params
+
+
+def _cfg(**overrides):
+    kw = dict(num_pages=24, page_size=128, max_seq_len=256,
+              max_decode_batch=4, max_prefill_rows=2,
+              prefill_chunk=32, token_budget=80, watermark_pages=1)
+    kw.update(overrides)
+    return EngineConfig(**kw)
+
+
+def _baseline(model, params, trace, config=None):
+    """Fault-free single-replica outputs for the same trace."""
+    engine = ServingEngine(model, params, config or _cfg())
+    _, outputs = replay(engine, trace)
+    return outputs
+
+
+# ----------------------------------------------------------- lifecycle
+
+
+def test_engine_timed_out_state_and_admission_deadline(tiny_model):
+    """Engine-level deadline contract: expired-at-admission raises the
+    typed error; a queued request is swept to TIMED_OUT."""
+    model, params = tiny_model
+    eng = ServingEngine(model, params, _cfg())
+    eng.step()  # step 0 -> 1
+    with pytest.raises(DeadlineExceededError, match="expired before"):
+        eng.add_request([1, 2, 3], SamplingParams(max_tokens=2),
+                        deadline_step=1)
+    timed_out = []
+    eng.on_timeout = timed_out.append
+    req = eng.add_request([1, 2, 3], SamplingParams(max_tokens=64),
+                          deadline_step=3)
+    eng.run(max_steps=50)
+    assert req.state is RequestState.TIMED_OUT
+    assert timed_out == [req]
+    assert req.pages == [] and eng.pool.used_pages <= 1
+
+
+def test_deadline_fires_during_prefill_vs_decode(tiny_model):
+    """A tight TTL expires mid-prefill (zero tokens streamed); a looser
+    one expires mid-decode (some tokens streamed, fewer than asked)."""
+    model, params = tiny_model
+    rng = np.random.default_rng(0)
+    long_prompt = rng.integers(1, 43, 120).tolist()
+
+    fe = ServingFrontend(model, params,
+                         _cfg(prefill_chunk=32, token_budget=32),
+                         FrontendConfig(num_replicas=1, seed=0))
+    # prefill takes ceil(120/32) = 4 chunks at 32-token budget: a TTL
+    # of 3 ticks dies mid-prefill; 9 ticks reaches decode then dies
+    in_prefill = fe.submit(long_prompt, SamplingParams(max_tokens=64),
+                           request_id="prefill-victim", ttl_ticks=3)
+    in_decode = fe.submit(long_prompt, SamplingParams(max_tokens=64),
+                          request_id="decode-victim", arrival=0,
+                          ttl_ticks=9)
+    fe.run(max_ticks=100)
+    assert in_prefill.state is FrontendRequestState.TIMED_OUT
+    assert in_prefill.tokens == []
+    assert isinstance(in_prefill.error, DeadlineExceededError)
+    assert in_decode.state is FrontendRequestState.TIMED_OUT
+    assert 0 < len(in_decode.tokens) < 64
+    assert isinstance(in_decode.error, DeadlineExceededError)
+
+
+def test_frontend_request_transition_guard(tiny_model):
+    model, params = tiny_model
+    fe = ServingFrontend(model, params, _cfg(),
+                         FrontendConfig(num_replicas=1))
+    fr = fe.submit([1, 2, 3], SamplingParams(max_tokens=2))
+    with pytest.raises(ValueError, match="illegal front-end"):
+        fr.transition(FrontendRequestState.FINISHED)  # QUEUED can't
+    with pytest.raises(ValueError, match="duplicate request id"):
+        fe.submit([1, 2], request_id=fr.request_id)
+    with pytest.raises(ValueError, match="priority"):
+        fe.submit([1, 2], priority=9)
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_routing_prefix_affinity_and_least_loaded(tiny_model):
+    """Unit-level router contract: longest committed prefix wins;
+    sticky session covers the pre-commit window; least-loaded (with
+    the replica-index tiebreak) is the fallback; exclusion avoids the
+    failed replica unless it is the sole survivor."""
+    model, params = tiny_model
+    handles = [ReplicaHandle(f"replica-{i}", model, params, _cfg())
+               for i in range(3)]
+    router = Router()
+    prompt = list(range(1, 43)) * 4  # > 1 page
+
+    # cold: least-loaded, index tiebreak -> replica-0
+    d = router.route(prompt, handles, session="s1")
+    assert d.replica.replica_id == "replica-0" \
+        and d.reason == "least_loaded"
+    # sticky: same session follows even though nothing is committed
+    d = router.route(prompt, handles, session="s1")
+    assert d.replica.replica_id == "replica-0" and d.reason == "sticky"
+
+    # commit the prompt's first page on replica-2: prefix beats sticky
+    eng2 = handles[2].engine
+    pages = eng2.allocator.allocate(2)
+    eng2.allocator.commit_prefix(prompt[:129], pages, now=0)
+    d = router.route(prompt, handles, session="s1")
+    assert d.replica.replica_id == "replica-2" and d.reason == "prefix"
+    assert d.prefix_pages == 1
+
+    # exclusion: the prefix holder just failed this request
+    d = router.route(prompt, handles, exclude="replica-2")
+    assert d.replica.replica_id != "replica-2"
+    # sole survivor: exclusion yields to availability
+    handles[0].kill()
+    handles[1].kill()
+    d = router.route(prompt, handles, exclude="replica-2")
+    assert d.replica.replica_id == "replica-2"
+    handles[2].kill()
+    assert router.route(prompt, handles) is None
+
+
+def test_routing_affinity_keeps_prefix_hit_rate(tiny_model):
+    """ISSUE 6 satellite: on a replayed multi-tenant trace with shared
+    per-tenant prefixes, the 3-replica front end's aggregate prefix-
+    cache hit-rate is >= the single-replica engine baseline — affinity
+    means cache hits survive routing."""
+    model, params = tiny_model
+    trace = bursty_trace(8, vocab=43, seed=11, tenants=2,
+                         burst_every=8, burst_size=2,
+                         shared_prefix_len=129, prompt_len_min=4,
+                         prompt_len_max=10, max_tokens=3)
+    engine = ServingEngine(model, params, _cfg())
+    base_summary, base_out = replay(engine, trace)
+
+    fe = ServingFrontend(model, params, _cfg(),
+                         FrontendConfig(num_replicas=3, seed=0))
+    summary, out = replay_frontend(fe, trace)
+    assert summary["states"]["finished"] == len(trace)
+    assert out == base_out  # token parity rides along
+    assert base_summary["prefix_cache_hit_rate"] > 0
+    assert (summary["prefix_cache_hit_rate"]
+            >= base_summary["prefix_cache_hit_rate"])
+    # the affinity actually engaged: some routing was prefix/sticky
+    assert any(fr.routed_by in ("prefix", "sticky")
+               for fr in fe.requests.values())
+
+
+# ------------------------------------------------------ retry/backoff
+
+
+def test_backoff_deterministic_and_bounded():
+    policy = RetryPolicy(max_retries=3, base_delay_ticks=2,
+                         multiplier=2.0, max_delay_ticks=10,
+                         jitter=0.25)
+    a = [policy.delay_ticks(7, "req-x", k) for k in (1, 2, 3, 4)]
+    b = [policy.delay_ticks(7, "req-x", k) for k in (1, 2, 3, 4)]
+    assert a == b  # same seed/request/attempt -> same delay
+    assert a != [policy.delay_ticks(8, "req-x", k) for k in (1, 2, 3, 4)]
+    for k, d in enumerate(a, start=1):
+        raw = min(10.0, 2 * 2.0 ** (k - 1))
+        assert 1 <= d <= round(raw * 1.25) and d >= round(raw * 0.75)
+    with pytest.raises(ValueError, match="attempt"):
+        policy.delay_ticks(0, "r", 0)
+
+
+def test_replica_kill_retry_preserves_streamed_tokens(tiny_model):
+    """Kill the replica serving requests mid-decode: they requeue with
+    backoff, resume on a survivor, and finish with EXACTLY the
+    fault-free token streams (greedy and sampled both)."""
+    model, params = tiny_model
+    trace = synthetic_trace(4, vocab=43, seed=5, prompt_len_min=6,
+                            prompt_len_max=12, max_tokens=8,
+                            temperature=0.8)
+    base = _baseline(model, params, trace)
+
+    fe = ServingFrontend(model, params, _cfg(),
+                         FrontendConfig(num_replicas=2, seed=0))
+    for e in trace:
+        fe.submit(e["prompt"], sampling_of(e), request_id=e["id"],
+                  arrival=int(e["arrival"]))
+    for _ in range(6):
+        fe.tick()
+    mid = [fr for fr in fe.requests.values()
+           if fr.tokens and not fr.is_terminal]
+    assert mid, "no request was mid-decode at the kill point"
+    # kill ONE replica that holds mid-decode work; the other survives
+    # to absorb the requeued victims
+    victim_replica = sorted(fr.replica_id for fr in mid)[0]
+    victims = [fr for fr in mid if fr.replica_id == victim_replica]
+    assert fe.kill_replica(victim_replica)
+    summary = fe.run(max_ticks=400)
+    assert summary["states"]["finished"] == len(trace)
+    assert summary["retries_scheduled"] >= len(victims)
+    assert fe.outputs() == base
+
+
+def test_retry_budget_exhaustion_surfaces_typed_error(tiny_model):
+    """With every replica dead and a tiny retry budget, a request
+    burns its requeues and is SHED carrying a RequestShedError whose
+    cause chain names the replica failure."""
+    model, params = tiny_model
+    fe = ServingFrontend(
+        model, params, _cfg(),
+        FrontendConfig(num_replicas=2, seed=0,
+                       retry=RetryPolicy(max_retries=2,
+                                         base_delay_ticks=1,
+                                         max_delay_ticks=2)),
+    )
+    fr = fe.submit([1, 2, 3, 4], SamplingParams(max_tokens=4))
+    fe.kill_replica("replica-0")
+    fe.kill_replica("replica-1")
+    summary = fe.run(max_ticks=100)
+    assert fr.state is FrontendRequestState.SHED
+    assert isinstance(fr.error, RequestShedError)
+    assert "retry budget" in str(fr.error)
+    assert isinstance(fr.error.__cause__, ReplicaDeadError)
+    assert summary["retries_exhausted"] == 1
+    assert summary["states"]["shed"] == 1
+
+
+# ------------------------------------------------- shed + degradation
+
+
+def test_load_shedding_rejects_and_downclasses(tiny_model):
+    """Saturate a 1-replica pool so pressure crosses both thresholds:
+    a later lowest-class arrival is SHED typed, a normal-class arrival
+    is down-classed but served."""
+    model, params = tiny_model
+    rng = np.random.default_rng(1)
+    fe = ServingFrontend(
+        model, params,
+        _cfg(num_pages=6, token_budget=32),
+        FrontendConfig(num_replicas=1, seed=0,
+                       shed=ShedPolicy(queue_cap=2,
+                                       downclass_pressure=0.5,
+                                       shed_pressure=0.8)),
+    )
+    for i in range(4):  # fill the queue (cap 2 -> pressure 1.0)
+        fe.submit(rng.integers(1, 43, 100).tolist(),
+                  SamplingParams(max_tokens=12), request_id=f"busy-{i}")
+    low = fe.submit(rng.integers(1, 43, 8).tolist(),
+                    SamplingParams(max_tokens=2), request_id="low",
+                    arrival=1, priority=2)
+    norm = fe.submit(rng.integers(1, 43, 8).tolist(),
+                     SamplingParams(max_tokens=2), request_id="norm",
+                     arrival=1, priority=1)
+    summary = fe.run(max_ticks=400)
+    assert low.state is FrontendRequestState.SHED
+    assert isinstance(low.error, RequestShedError)
+    assert norm.downclassed and norm.priority == 2
+    assert norm.state is FrontendRequestState.FINISHED
+    assert summary["shed_rejected"] >= 1
+    assert summary["downclassed"] >= 1
+
+
+def test_degradation_ladder_hysteresis_pinned():
+    """The ladder's exact step-down/recover tick arithmetic: 3 high
+    ticks per level down, 5 low ticks per level up, mid-band resets
+    both streaks, and the level saturates at the top rung."""
+    ladder = DegradationLadder(DegradePolicy(
+        pressure_high=0.8, pressure_low=0.4,
+        step_down_after=3, recover_after=5))
+    levels = [ladder.observe(0.9) for _ in range(3)]
+    assert levels == [0, 0, 1]              # exactly the 3rd high tick
+    ladder.observe(0.9)
+    ladder.observe(0.6)                     # mid-band: streak resets
+    assert ladder.level == 1
+    levels = [ladder.observe(0.95) for _ in range(9)]
+    assert levels == [1, 1, 2, 2, 2, 3, 3, 3, 3]  # saturates at 3
+    levels = [ladder.observe(0.1) for _ in range(10)]
+    assert levels == [3, 3, 3, 3, 2, 2, 2, 2, 2, 1]
+    ladder.observe(0.5)                     # mid-band resets recovery
+    levels = [ladder.observe(0.2) for _ in range(5)]
+    assert levels == [1, 1, 1, 1, 0]
+    assert ladder.step_downs == 3 and ladder.recoveries == 3
+
+
+def test_degradation_ladder_applies_and_recovers_on_engines(tiny_model):
+    """Ladder effects land on the replicas: level 1 shrinks the
+    scheduler token budget, level 2 turns prefix admission off; a
+    recovered front end restores both."""
+    model, params = tiny_model
+    fe = ServingFrontend(
+        model, params, _cfg(token_budget=80),
+        FrontendConfig(num_replicas=2, seed=0,
+                       shed=ShedPolicy(queue_cap=1),
+                       degrade=DegradePolicy(pressure_high=0.6,
+                                             pressure_low=0.3,
+                                             step_down_after=2,
+                                             recover_after=2,
+                                             token_budget_factor=0.5)),
+    )
+    eng = fe.replicas[0].engine
+    assert eng.scheduler.token_budget == 80
+    assert eng.scheduler.prefix_admission
+
+    # force sustained pressure without real load: dead replica #1
+    # (pressure 1.0) drags the mean to 0.5+ while #0 idles... kill one
+    # and park a fat queue on the other
+    rng = np.random.default_rng(2)
+    fe.kill_replica("replica-1")
+    for i in range(3):
+        fe.submit(rng.integers(1, 43, 60).tolist(),
+                  SamplingParams(max_tokens=40), request_id=f"q{i}",
+                  priority=0)
+    fe.tick()
+    fe.tick()  # two high ticks -> level 1
+    assert fe.ladder.level == 1
+    assert eng.scheduler.token_budget == 40
+    fe.tick()
+    fe.tick()  # two more -> level 2: prefix admission off
+    assert fe.ladder.level == 2
+    assert not eng.scheduler.prefix_admission
+    fe.run(max_ticks=400)
+    # queue drained + replica restarted -> pressure collapses -> the
+    # ladder recovered hysteretically and effects were rolled back
+    fe.restart_replica("replica-1")
+    for _ in range(6):
+        fe.tick()
+    assert fe.ladder.level == 0
+    assert eng.scheduler.token_budget == 80
+    assert eng.scheduler.prefix_admission
+    assert fe.ladder.recoveries >= 2
+
+
+# ------------------------------------------------------- chaos storms
+
+
+@pytest.mark.chaos
+def test_chaos_storm_end_to_end_acceptance(tiny_model):
+    """ISSUE 6 acceptance: N=3 replicas under a seeded replica-kill +
+    OOM-window + preemption storm.  Every submitted request reaches a
+    terminal state, finished requests are token-for-token identical to
+    the fault-free single-replica run, page/refcount conservation
+    holds on all surviving replicas, and the same seed produces a
+    byte-identical summary and RunRecord."""
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+    from attention_tpu.chaos import invariants as inv
+
+    model, params = tiny_model
+    trace = bursty_trace(8, vocab=43, seed=3, tenants=2, burst_every=4,
+                         burst_size=3, shared_prefix_len=129,
+                         prompt_len_min=4, prompt_len_max=12,
+                         max_tokens=6, temperature=0.7,
+                         deadline_ticks=60)
+    base = _baseline(model, params, trace)
+    plan = FaultPlan(seed=99, events=(
+        FaultEvent(step=2, kind="oom", arg=2, target="replica-0"),
+        FaultEvent(step=3, kind="preempt", arg=2, target="replica-1"),
+        FaultEvent(step=4, kind="replica_kill", target="replica-1"),
+        FaultEvent(step=6, kind="preempt", arg=1, target="replica-0"),
+        FaultEvent(step=9, kind="replica_restart", target="replica-1"),
+        FaultEvent(step=10, kind="cancel", target="req-5"),
+        FaultEvent(step=12, kind="replica_kill", target="replica-2"),
+    ))
+
+    def storm():
+        fe = ServingFrontend(
+            model, params, _cfg(num_pages=16),
+            FrontendConfig(num_replicas=3, seed=0,
+                           retry=RetryPolicy(max_retries=4),
+                           stall_ticks=3),
+        )
+        injector = FrontendFaultInjector(fe, plan)
+        summary, outputs = replay_frontend(fe, trace, max_ticks=600)
+        return fe, injector, summary, outputs
+
+    fe, injector, summary, outputs = storm()
+    assert injector.injected >= 5
+    assert summary["replica_kills"] == 2
+
+    # 1) no request lost: all terminal, typed causes attached
+    assert inv.no_request_lost_violations(fe) == []
+    states = {fr.request_id: fr.state
+              for fr in fe.requests.values()}
+    assert all(fr.is_terminal for fr in fe.requests.values())
+    # 2) token parity for every FINISHED request vs fault-free run
+    finished = [rid for rid, s in states.items()
+                if s is FrontendRequestState.FINISHED]
+    assert finished, "storm finished nothing — too violent to mean much"
+    for rid in finished:
+        assert outputs[rid] == base[rid], f"{rid} diverged"
+    # the injected cancel really is terminal CANCELLED
+    assert states["req-5"] is FrontendRequestState.CANCELLED
+    # 3) conservation on all surviving replicas
+    assert inv.replica_conservation_violations(fe, drained=True) == []
+    # 4) determinism: same seed -> byte-identical summary + RunRecord
+    _, _, summary2, outputs2 = storm()
+    assert json.dumps(summary, sort_keys=True) == \
+        json.dumps(summary2, sort_keys=True)
+    assert outputs == outputs2
+    rec = fe.to_run_record()
+    assert json.dumps(json.loads(rec.to_json()), sort_keys=True) == \
+        json.dumps(json.loads(storm()[0].to_run_record().to_json()),
+                   sort_keys=True)
+
+
+@pytest.mark.chaos
+def test_frontend_fault_smoke_campaign_green(tiny_model):
+    """Tier-1 smoke storm: a couple of seeded plans through the
+    campaign runner (the `cli chaos faults --replicas 3` core) hold
+    all six invariants."""
+    from attention_tpu.chaos.faults import run_frontend_campaign
+
+    model, params = tiny_model
+    report = run_frontend_campaign(1, num_plans=2, num_requests=5,
+                                   num_replicas=3, events_per_plan=5,
+                                   model=model, params=params)
+    assert report.ok, [r.violations for r in report.reports
+                       if not r.ok]
+    assert report.total_injected >= 1
+    d = report.to_dict()
+    assert d["plans"] == 2 and d["violations"] == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_broad_frontend_storm_campaign(tiny_model):
+    """Broad seeded storm sweep (slow tier): many seeds, heavier
+    plans; zero invariant violations anywhere."""
+    from attention_tpu.chaos.faults import run_frontend_campaign
+
+    model, params = tiny_model
+    for seed in range(8):
+        report = run_frontend_campaign(seed, num_plans=4,
+                                       num_requests=6, num_replicas=3,
+                                       events_per_plan=6,
+                                       temperature=0.7,
+                                       model=model, params=params)
+        assert report.ok, (seed, [r.violations for r in report.reports
+                                  if not r.ok])
+
+
+# ------------------------------------------------------------ engine+
+
+
+def test_resume_request_parity_fresh_engine(tiny_model):
+    """`ServingEngine.resume_request` (the cross-replica retry hook)
+    alone: stream k tokens on engine A, resume on a COLD engine B,
+    concatenated stream equals an uninterrupted run — greedy and
+    sampled (the reconstructed RNG chain)."""
+    model, params = tiny_model
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 43, 40).tolist()
+    for temperature in (0.0, 0.9):
+        sp = SamplingParams(max_tokens=10, temperature=temperature,
+                            seed=21)
+        full = ServingEngine(model, params, _cfg())
+        req = full.add_request(prompt, sp, request_id="full")
+        full.run(max_steps=100)
+        want = req.output_tokens
+
+        half = ServingEngine(model, params, _cfg())
+        streamed = []
+        half.on_token = lambda r, t: streamed.append(t)
+        half.add_request(prompt, sp, request_id="cut")
+        while len(streamed) < 4:
+            half.step()
+        cold = ServingEngine(model, params, _cfg())
+        cold.on_token = lambda r, t: streamed.append(t)
+        r2 = cold.resume_request(prompt, sp, request_id="cut",
+                                 output_tokens=streamed)
+        cold.run(max_steps=100)
+        assert streamed == want, f"temperature {temperature} diverged"
+        assert r2.state is RequestState.FINISHED
+    with pytest.raises(ValueError, match="nothing to resume"):
+        cold.resume_request(prompt, sp, request_id="done",
+                            output_tokens=list(range(1, 11)))
+
+
+def test_serve_sim_cli_frontend_roundtrip(tmp_path, capsys):
+    """`cli serve-sim --replicas N --deadline-ms --chaos-plan` end to
+    end: bursty trace, a kill+restart plan, valid summary JSON, and
+    every request terminal."""
+    from attention_tpu.chaos.faults import FaultEvent, FaultPlan
+    from attention_tpu.cli import main
+
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=2, kind="replica_kill", target="replica-0"),
+        FaultEvent(step=4, kind="replica_restart", target="replica-0"),
+    ))
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(plan.to_json())
+    args = [
+        "serve-sim", "--num-requests", "4", "--max-tokens", "2",
+        "--prompt-len-min", "4", "--prompt-len-max", "8",
+        "--vocab", "32", "--dim", "32", "--depth", "1",
+        "--q-heads", "2", "--kv-heads", "1",
+        "--num-pages", "16", "--max-seq-len", "128",
+        "--max-decode-batch", "2", "--prefill-chunk", "16",
+        "--token-budget", "32", "--watermark-pages", "0",
+        "--bursty", "--tenants", "2", "--burst-every", "3",
+        "--burst-size", "2",
+        "--replicas", "2", "--deadline-ms", "500", "--tick-ms", "1",
+        "--chaos-plan", str(plan_path), "--outputs",
+    ]
+    assert main(args) == 0
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    s = out["summary"]
+    assert s["num_requests"] == 4
+    assert sum(s["states"].values()) == 4
+    live = (s["states"]["queued"] + s["states"]["assigned"]
+            + s["states"]["retry_wait"])
+    assert live == 0
+    assert s["replica_kills"] == 1 and s["replica_restarts"] == 1
+    assert out["run_record"]["backend"] == "frontend"
+    # same invocation replays byte-identically (virtual clocks only)
+    assert main(args) == 0
+    out2 = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out2 == out

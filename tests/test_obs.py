@@ -6,7 +6,9 @@ series and snapshot/reset; the bounded span ring composing with
 parser; the merged host/device Chrome timeline; the mtime-newest and
 truncated-capture behavior of the profiler parser; the
 zero-overhead-when-disabled contract (<5% on a tight loop, byte-
-identical engine outputs); and the `cli obs` report/export family.
+identical engine AND multi-replica front-end outputs — the router hot
+path may not depend on telemetry); and the `cli obs` report/export
+family.
 
 All CPU-safe, tiny shapes.
 """
@@ -375,6 +377,60 @@ def test_engine_outputs_byte_identical_with_obs_on(tiny_model):
         span_names = {e["name"] for e in obs.events()}
         assert {"engine.step", "scheduler.admit",
                 "allocator.alloc"} <= span_names
+    finally:
+        obs.reset()
+        obs.disable()
+    assert out_on == out_off
+
+
+def _run_frontend(tiny_model):
+    """A small multi-replica run over the router hot path: bursty
+    multi-tenant trace, 2 replicas, prefix-affine + sticky routing."""
+    from attention_tpu.engine import bursty_trace
+    from attention_tpu.frontend import (
+        FrontendConfig,
+        ServingFrontend,
+        replay_frontend,
+    )
+
+    model, params = tiny_model
+    trace = bursty_trace(5, vocab=43, seed=7, shared_prefix_len=129,
+                         tenants=2, burst_every=3, burst_size=2,
+                         prompt_len_min=4, prompt_len_max=10,
+                         max_tokens=3)
+    frontend = ServingFrontend(
+        model, params, _engine_config(),
+        FrontendConfig(num_replicas=2, seed=0),
+    )
+    _summary, outputs = replay_frontend(frontend, trace)
+    return outputs
+
+
+def test_frontend_outputs_byte_identical_with_obs_on(tiny_model):
+    """The zero-overhead contract extended over the ROUTER hot path
+    (ISSUE 6): the front end's routing/shedding/ladder decisions read
+    pressure off the replica handles, never the obs registry — so the
+    same trace with telemetry off vs on must route, schedule, and
+    sample identically."""
+    import jax
+
+    assert not obs.is_enabled()
+    out_off = _run_frontend(tiny_model)
+    obs.enable()
+    obs.reset()
+    try:
+        jax.clear_caches()
+        out_on = _run_frontend(tiny_model)
+        snap = obs.REGISTRY.snapshot()
+        counters = {s["name"] for s in snap["counters"]}
+        assert counters & {"frontend.route.prefix_affine",
+                           "frontend.route.sticky_session",
+                           "frontend.route.least_loaded"}
+        gauges = {s["name"] for s in snap["gauges"]}
+        assert {"frontend.degrade.level",
+                "frontend.replica.queue_depth"} <= gauges
+        span_names = {e["name"] for e in obs.events()}
+        assert "frontend.tick" in span_names
     finally:
         obs.reset()
         obs.disable()
