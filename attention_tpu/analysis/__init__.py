@@ -9,7 +9,9 @@ passes that flag, before anything traces or compiles,
 - silent low-precision accumulation (ATP3xx, `precision`),
 - error-taxonomy drift (ATP4xx, `errors`),
 - tree conventions — the absorbed ``scripts/check_*`` lints and the
-  source-only guard (ATP5xx/ATP601, `conventions`).
+  source-only guard (ATP5xx/ATP601, `conventions`),
+- torn-write-prone persistence in the durable modules (ATP701,
+  `durability`).
 
 Entry points: ``cli analyze`` (text/JSON/SARIF, ``--changed``),
 ``scripts/check_all.py`` (the tier-1 gate), and `core.analyze` as a
@@ -32,6 +34,7 @@ from attention_tpu.analysis.core import (  # noqa: F401
 )
 from attention_tpu.analysis import (  # noqa: F401  (pass registration)
     conventions,
+    durability,
     errors,
     pallas,
     precision,

@@ -1,0 +1,113 @@
+"""Durability-hygiene pass: torn-write-prone persistence (ATP701).
+
+ISSUE 9's snapshot/journal layer exists because a process can die at
+ANY byte of a write.  The repo-wide idiom that survives that (already
+used by ``TuningTable.save``, now pinned here) is write-to-temp +
+``os.replace``: the destination path either holds the complete old
+file or the complete new file, never a torn prefix.
+
+ATP701 (error) flags, inside the durable-persistence modules
+(``engine/snapshot.py``, ``engine/journal.py``, ``tuning/cache.py``),
+any ``open``/``os.fdopen`` call with a truncating/creating mode
+(``"w"``/``"x"``) in a function that never calls ``os.replace`` —
+that open either clobbers the destination in place (a crash mid-write
+leaves a torn file where a valid one used to be) or is a temp file
+that never atomically lands.
+
+Append mode (``"a"``/``"ab"``) is exempt: that IS the write-ahead-log
+idiom — a torn appended record is detected by the journal's per-record
+CRC and dropped, while every earlier record stays intact.  Reads are
+exempt.  Deliberate crash-point writes (the chaos hook that simulates
+dying mid-snapshot) carry an inline ``# atp: disable=ATP701``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    register_code,
+)
+
+ATP701 = register_code(
+    "ATP701", "torn-write-prone-persistence", Severity.ERROR,
+    "open(..., 'w*') in a durable-persistence module without "
+    "os.replace in the same function — write to a temp file and "
+    "os.replace it over the destination (append mode is the WAL "
+    "idiom and exempt)")
+
+#: the modules whose files must survive a crash at any byte
+_DURABLE_PATHS = (
+    "attention_tpu/engine/snapshot.py",
+    "attention_tpu/engine/journal.py",
+    "attention_tpu/tuning/cache.py",
+)
+
+
+def _call_mode(node: ast.Call) -> str | None:
+    """The constant mode string of an ``open``/``os.fdopen`` call, or
+    None when the call isn't one / the mode isn't a literal (default
+    mode is read: exempt)."""
+    name = dotted_name(node.func)
+    if name not in ("open", "os.fdopen", "io.open"):
+        return None
+    mode: ast.expr | None = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _scopes(tree: ast.Module):
+    """(scope_node, body_nodes) for every function (nested defs stay
+    part of the enclosing function's scope — a helper closure that
+    does the os.replace still makes the write atomic) plus the
+    module's own top-level statements."""
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    owned = set()
+    for fn in funcs:
+        owned.update(id(n) for n in ast.walk(fn) if n is not fn)
+    yield tree, [n for n in ast.walk(tree)
+                 if id(n) not in owned and n not in funcs]
+    for fn in funcs:
+        if id(fn) not in owned:  # nested defs ride their enclosing scope
+            yield fn, list(ast.walk(fn))
+
+
+@file_pass("durability", [ATP701])
+def check_durability(path: str, tree: ast.Module, src: str):
+    """Truncating opens without os.replace in durable modules."""
+    if path not in _DURABLE_PATHS:
+        return []
+    findings: list[Finding] = []
+    seen: set[tuple[int, int]] = set()
+    for scope, nodes in _scopes(tree):
+        calls = [n for n in nodes if isinstance(n, ast.Call)]
+        has_replace = any(
+            dotted_name(c.func) == "os.replace" for c in calls)
+        if has_replace:
+            continue
+        for call in calls:
+            mode = _call_mode(call)
+            if mode is None or not any(c in mode for c in "wx"):
+                continue
+            loc = (call.lineno, call.col_offset)
+            if loc in seen:
+                continue
+            seen.add(loc)
+            findings.append(Finding(
+                ATP701,
+                f"open(..., {mode!r}) without os.replace in scope — "
+                "a crash mid-write tears the file; write a sibling "
+                "temp file and os.replace it (see TuningTable.save)",
+                path, call.lineno, call.col_offset))
+    return findings

@@ -28,12 +28,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from attention_tpu import obs
 from attention_tpu.chaos import invariants as inv
+from attention_tpu.engine import journal as journal_mod
+from attention_tpu.engine import snapshot as snapshot_mod
 from attention_tpu.engine.engine import EngineConfig, ServingEngine
 from attention_tpu.engine.scheduler import ScheduledStep
 from attention_tpu.engine.sim import replay, synthetic_trace
@@ -363,6 +366,15 @@ def run_campaign(seed: int, *, num_plans: int = 5,
 FRONTEND_FAULT_KINDS = ("replica_kill", "replica_restart", "oom",
                         "preempt", "cancel")
 
+#: the durability crash points (ISSUE 9) — only meaningful against a
+#: snapshot-configured front end, so they live in their own kind set
+#: (plain storms keep their historical sampling sequence)
+CRASH_FAULT_KINDS = FRONTEND_FAULT_KINDS + (
+    "snap_crash",     # arm the next snapshot save to die mid-write
+    "snap_corrupt",   # bit-flip a section of the newest snapshot
+    "journal_tear",   # truncate the newest journal mid-record
+)
+
 
 def random_frontend_plan(seed: int, request_ids: Sequence[str],
                          num_replicas: int, *, num_events: int = 5,
@@ -398,6 +410,72 @@ def random_frontend_plan(seed: int, request_ids: Sequence[str],
                                  target=target))
     events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
     return FaultPlan(seed=seed, events=tuple(events))
+
+
+def random_crash_plan(seed: int, request_ids: Sequence[str],
+                      num_replicas: int, *, num_events: int = 6,
+                      max_tick: int = 24) -> FaultPlan:
+    """Sample one seeded crash-storm plan: the ISSUE 6 storm kinds
+    PLUS the three durability crash points, with kills biased toward
+    warm-recovery coverage.  Every sampled kill still schedules its
+    restart; the crash points target a replica's snapshot directory so
+    the restart is forced through the warm-or-degrade decision."""
+    rng = np.random.default_rng(seed)
+    events = []
+    crash_kinds = ("snap_crash", "snap_corrupt", "journal_tear")
+    for _ in range(num_events):
+        kind = CRASH_FAULT_KINDS[int(rng.integers(len(CRASH_FAULT_KINDS)))]
+        step = int(rng.integers(1, max_tick))
+        arg, target = 1, None
+        if kind == "replica_kill":
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if rng.random() < 0.9:
+                events.append(FaultEvent(
+                    step=step + int(rng.integers(2, 7)),
+                    kind="replica_restart", target=target))
+        elif kind in ("replica_restart", "oom", "preempt") \
+                or kind in crash_kinds:
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if kind in ("oom", "preempt"):
+                arg = int(rng.integers(1, 3))
+            elif kind == "journal_tear":
+                arg = int(rng.integers(0, 4))
+        elif kind == "cancel":
+            target = request_ids[int(rng.integers(len(request_ids)))]
+        events.append(FaultEvent(step=step, kind=kind, arg=arg,
+                                 target=target))
+    # guarantee at least one kill+restart pair per plan: a crash storm
+    # that never kills anything never exercises warm recovery
+    if not any(e.kind == "replica_kill" for e in events):
+        victim = f"replica-{int(rng.integers(num_replicas))}"
+        step = int(rng.integers(2, max_tick))
+        events.append(FaultEvent(step=step, kind="replica_kill",
+                                 target=victim))
+        events.append(FaultEvent(step=step + int(rng.integers(2, 7)),
+                                 kind="replica_restart", target=victim))
+    events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
+def _flip_byte(path: str) -> None:
+    """Bit-flip the middle byte of a file in place — lands inside the
+    (dominant) pools section of a snapshot, so restore must fail its
+    section checksum, never deserialize garbage."""
+    with open(path, "r+b") as f:
+        data = f.read()
+        if not data:
+            return
+        mid = len(data) // 2
+        f.seek(mid)
+        f.write(bytes([data[mid] ^ 0xFF]))
+
+
+def _tear_tail(path: str, arg: int) -> None:
+    """Truncate a journal mid-record: cut at least 3 bytes so the torn
+    line can never still parse (tearing only the trailing newline
+    would leave a VALID record, which is no tear at all)."""
+    size = os.path.getsize(path)
+    os.truncate(path, size - min(size, 3 + arg * 5))
 
 
 class FrontendFaultInjector:
@@ -459,6 +537,32 @@ class FrontendFaultInjector:
                 self._mark("cancel")
             else:
                 self.skipped.append(f"cancel:{ev.target}")
+        elif ev.kind == "snap_crash":
+            handle = self._handle(ev.target)
+            manager = getattr(handle, "_manager", None)
+            if handle is None or not handle.alive or manager is None:
+                self.skipped.append(f"snap_crash:{ev.target}")
+                return
+            manager.crash_next = True
+            self._mark("snap_crash")
+        elif ev.kind == "snap_corrupt":
+            handle = self._handle(ev.target)
+            snaps = snapshot_mod.list_snapshots(handle.snapshot_dir) \
+                if handle is not None and handle.snapshot_dir else []
+            if not snaps:
+                self.skipped.append(f"snap_corrupt:{ev.target}")
+                return
+            _flip_byte(snaps[-1][1])
+            self._mark("snap_corrupt")
+        elif ev.kind == "journal_tear":
+            handle = self._handle(ev.target)
+            journals = journal_mod.list_journals(handle.snapshot_dir) \
+                if handle is not None and handle.snapshot_dir else []
+            if not journals:
+                self.skipped.append(f"journal_tear:{ev.target}")
+                return
+            _tear_tail(journals[-1][1], ev.arg)
+            self._mark("journal_tear")
         else:
             raise ValueError(f"unknown frontend fault kind {ev.kind!r}")
 
@@ -539,12 +643,17 @@ def run_frontend_plan(model, params, config: EngineConfig,
                       frontend_config, trace: list[dict[str, Any]],
                       plan: FaultPlan, *,
                       baseline: dict[str, list[int]] | None = None,
-                      max_ticks: int = 1000) -> FrontendPlanReport:
+                      max_ticks: int = 1000,
+                      snapshot_roundtrip: bool = False,
+                      ) -> FrontendPlanReport:
     """Replay ``trace`` through a fresh front end with ``plan``
     attached; check every invariant that applies — including the two
     ISSUE 6 checkers (no request lost, surviving-replica
     conservation).  ``baseline`` (a fault-free SINGLE-replica run)
-    enables token parity over finished requests."""
+    enables token parity over finished requests.
+    ``snapshot_roundtrip`` additionally pins invariant 7 on every
+    surviving replica of a drained run (``restore(save(engine))``
+    state-identical)."""
     from attention_tpu.frontend import ServingFrontend, replay_frontend
 
     frontend = ServingFrontend(model, params, config, frontend_config)
@@ -581,6 +690,14 @@ def run_frontend_plan(model, params, config: EngineConfig,
     violations += inv.termination_violations(drained, error,
                                              max_steps=max_ticks)
     violations += inv.typed_error_violations(error)
+    if snapshot_roundtrip and drained:
+        for handle in frontend.replicas:
+            if handle.alive:
+                violations += [
+                    f"{handle.replica_id}: {v}"
+                    for v in inv.snapshot_roundtrip_violations(
+                        handle.engine)
+                ]
     return FrontendPlanReport(
         plan=plan, injected=injector.injected,
         cancelled=injector.cancelled, skipped=injector.skipped,
@@ -654,6 +771,63 @@ def run_frontend_campaign(seed: int, *, num_plans: int = 5,
         )
         if log is not None:
             log(f"storm {i} (seed {plan.seed}): injected={r.injected} "
+                f"violations={len(r.violations)} "
+                f"states={sorted(set(r.states.values()))} "
+                f"error={r.surfaced_error or 'none'}")
+        reports.append(r)
+    return FrontendCampaignReport(seed=seed, num_replicas=num_replicas,
+                                  baseline_outputs=baseline,
+                                  reports=reports)
+
+
+def run_crash_campaign(seed: int, snapshot_root: str, *,
+                       num_plans: int = 5, num_requests: int = 6,
+                       num_replicas: int = 2, snapshot_every: int = 2,
+                       temperature: float = 0.0,
+                       events_per_plan: int = 6,
+                       config: EngineConfig | None = None,
+                       model=None, params=None,
+                       log: Callable[[str], None] | None = None,
+                       ) -> FrontendCampaignReport:
+    """The ISSUE 9 crash storm: `run_frontend_campaign` with durable
+    replicas (periodic snapshots + journals under ``snapshot_root``)
+    and the three crash points in the plan mix.  Kills now recover
+    WARM when a valid snapshot survives the plan's corruption; on top
+    of the six storm invariants each drained plan is checked for
+    invariant 7 (round trip on every survivor) and invariant 8
+    (every finished stream token-identical to the fault-free run —
+    crash points may cost warmth, never tokens)."""
+    if model is None or params is None:
+        model, params = build_sim_model()
+    config = config or default_engine_config()
+    trace = synthetic_trace(
+        num_requests, vocab=model.vocab, seed=seed, max_tokens=6,
+        temperature=temperature,
+    )
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    ids = [t["id"] for t in trace]
+    reports = []
+    for i in range(num_plans):
+        plan = random_crash_plan(seed * 5009 + i, ids, num_replicas,
+                                 num_events=events_per_plan)
+        frontend_config = default_frontend_config(
+            num_replicas,
+            snapshot_dir=os.path.join(snapshot_root, f"plan-{i}"),
+            snapshot_every=snapshot_every,
+        )
+        r = run_frontend_plan(
+            model, params, config, frontend_config, trace, plan,
+            baseline=baseline, snapshot_roundtrip=True,
+        )
+        if r.drained:
+            finished = [rid for rid, state in r.states.items()
+                        if state == "finished"]
+            r.violations += inv.warm_recovery_parity_violations(
+                baseline, r.outputs, finished)
+        if log is not None:
+            log(f"crash storm {i} (seed {plan.seed}): "
+                f"injected={r.injected} "
                 f"violations={len(r.violations)} "
                 f"states={sorted(set(r.states.values()))} "
                 f"error={r.surfaced_error or 'none'}")

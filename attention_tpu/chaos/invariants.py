@@ -33,17 +33,35 @@ The multi-replica front end (ISSUE 6) adds two more:
    drained, prefix-cache-only quiescence) holds on every SURVIVING
    replica of a storm; a neighbor's death may not corrupt anyone
    else's pool.
+
+The durability layer (ISSUE 9) adds two more:
+
+7. **Snapshot round trip** — ``restore(save(engine))`` is
+   state-identical: the deterministic serialization fingerprint
+   (`engine.snapshot.state_fingerprint`) of the restored engine equals
+   the original's, so the restored engine's future outputs are
+   byte-identical by construction.
+8. **Warm-recovery parity** — a replica recovered warm (snapshot +
+   journal replay) finishes every stream token-identical to the
+   fault-free run; crash points (kill mid-snapshot, bit-flipped
+   sections, torn journal tails) may cost warmth, never tokens.
 """
 
 from __future__ import annotations
 
+import os
+import shutil
+import tempfile
 from typing import Iterable, Mapping
 
 from attention_tpu import obs
 from attention_tpu.engine.errors import (
     DeadlineExceededError,
     ReplicaDeadError,
+    ReplicaStateError,
     RequestShedError,
+    SnapshotCorruptError,
+    SnapshotError,
 )
 from attention_tpu.ops.paged import OutOfPagesError, PageAccountingError
 
@@ -53,7 +71,8 @@ _VIOLATIONS = obs.counter("chaos.invariant.violations",
 #: everything that may legitimately escape a serving step/tick loop
 TYPED_ERRORS = (OutOfPagesError, PageAccountingError,
                 DeadlineExceededError, ReplicaDeadError,
-                RequestShedError)
+                RequestShedError, SnapshotError, SnapshotCorruptError,
+                ReplicaStateError)
 
 
 def _report(invariant: str, problems: list[str]) -> list[str]:
@@ -230,3 +249,56 @@ def replica_conservation_violations(frontend, *,
             inner += engine_quiescence_violations(handle.engine)
         problems += [f"{handle.replica_id}: {p}" for p in inner]
     return problems
+
+
+def snapshot_roundtrip_violations(engine) -> list[str]:
+    """Invariant 7: ``restore(save(engine))`` is state-identical.
+
+    Saves the live engine to a throwaway file, restores it, and
+    compares deterministic state fingerprints — equal fingerprints
+    mean the restored engine's serialization (pools, page accounting,
+    prefix index, request queues, RNG positions) is byte-identical,
+    so its future outputs are too.  Any `SnapshotError` on a
+    freshly-written snapshot is itself a violation."""
+    from attention_tpu.engine import snapshot as snap
+
+    problems: list[str] = []
+    tmpdir = tempfile.mkdtemp(prefix="atp_snap_inv_")
+    try:
+        path = os.path.join(tmpdir, "snap-00000000.atpsnap")
+        snap.save(engine, path)
+        clone = snap.restore(path, engine.model, engine.params)
+        a = snap.state_fingerprint(engine)
+        b = snap.state_fingerprint(clone)
+        if a != b:
+            problems.append(
+                f"restore(save(engine)) fingerprint mismatch: "
+                f"{a[:16]}... != {b[:16]}..."
+            )
+    except SnapshotError as e:
+        problems.append(f"fresh snapshot failed validation: {e}")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return _report("snapshot_roundtrip", problems)
+
+
+def warm_recovery_parity_violations(
+    baseline: Mapping[str, list[int]],
+    observed: Mapping[str, list[int]],
+    finished: Iterable[str],
+) -> list[str]:
+    """Invariant 8: warm-recovered streams match the fault-free run.
+
+    ``finished`` names the requests that reached FINISHED through the
+    storm (kills, warm restarts, crash points included); each must
+    carry exactly the fault-free baseline's token stream — warm
+    recovery may change WHERE tokens are computed, never WHICH."""
+    problems = []
+    for rid in sorted(finished):
+        if list(observed.get(rid, [])) != list(baseline.get(rid, [])):
+            problems.append(
+                f"request {rid}: recovered stream "
+                f"{list(observed.get(rid, []))} != fault-free "
+                f"{list(baseline.get(rid, []))}"
+            )
+    return _report("warm_recovery_parity", problems)

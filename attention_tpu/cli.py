@@ -262,10 +262,21 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         token_budget=args.token_budget,
         watermark_pages=args.watermark_pages,
     )
+    if (args.snapshot_dir is None) != (args.snapshot_every is None):
+        print("--snapshot-dir and --snapshot-every must be set "
+              "together", file=sys.stderr)
+        return 2
     if args.replicas:
         return _serve_sim_frontend(args, model, params, config, trace)
 
     engine = ServingEngine(model, params, config)
+    if args.snapshot_dir is not None:
+        from attention_tpu.engine import SnapshotManager
+
+        SnapshotManager(engine, args.snapshot_dir,
+                        every=args.snapshot_every)
+        _logger.info("snapshotting every %d steps to %s",
+                     args.snapshot_every, args.snapshot_dir)
     import contextlib
 
     profile_cm = contextlib.nullcontext()
@@ -328,6 +339,8 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
             num_replicas=args.replicas, seed=args.seed,
             retry=RetryPolicy(max_retries=args.max_retries),
             default_ttl_ticks=ttl,
+            snapshot_dir=args.snapshot_dir,
+            snapshot_every=args.snapshot_every,
         ),
     )
     if args.chaos_plan:
@@ -361,6 +374,62 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
         _logger.info("wrote telemetry dump: %s", args.obs_out)
     print(json.dumps(out))
     return 0
+
+
+def _snapshot_paths(path: str) -> list[str]:
+    """A snapshot file as-is; a directory expands to its snapshots,
+    newest first (the order recovery would consider them)."""
+    import os
+
+    if os.path.isdir(path):
+        from attention_tpu.engine.snapshot import list_snapshots
+
+        return [p for _, p in reversed(list_snapshots(path))]
+    return [path]
+
+
+def _cmd_snapshot_inspect(args: argparse.Namespace) -> int:
+    """Print one JSON line per snapshot: manifest + reconstruction
+    metadata, without loading pool payloads into an engine."""
+    import json
+
+    from attention_tpu.engine.errors import SnapshotError
+    from attention_tpu.engine.snapshot import inspect
+
+    paths = _snapshot_paths(args.path)
+    if not paths:
+        print(f"no snapshots under {args.path}", file=sys.stderr)
+        return 1
+    rc = 0
+    for p in paths:
+        try:
+            print(json.dumps(inspect(p), sort_keys=True))
+        except SnapshotError as e:
+            print(json.dumps({"path": p, "error": str(e)},
+                             sort_keys=True))
+            rc = 1
+    return rc
+
+
+def _cmd_snapshot_verify(args: argparse.Namespace) -> int:
+    """Validate snapshot integrity (magic, version, section table,
+    per-section checksums); exit 0 iff every snapshot is restorable."""
+    paths = _snapshot_paths(args.path)
+    if not paths:
+        print(f"no snapshots under {args.path}", file=sys.stderr)
+        return 1
+    from attention_tpu.engine.snapshot import verify
+
+    rc = 0
+    for p in paths:
+        problems = verify(p)
+        if problems:
+            rc = 1
+            for problem in problems:
+                print(f"{p}: {problem}")
+        else:
+            print(f"{p}: ok")
+    return rc
 
 
 def _add_serve_sim_args(ss) -> None:
@@ -409,6 +478,14 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--chaos-plan", default=None,
                     help="frontend fault-plan JSON (chaos.faults."
                          "FaultPlan) to attach to the run")
+    # crash-consistent durability (attention_tpu.engine.snapshot)
+    ss.add_argument("--snapshot-dir", default=None,
+                    help="persist checksummed engine snapshots + "
+                         "journals here (per-replica subdirs on the "
+                         "front-end path); requires --snapshot-every")
+    ss.add_argument("--snapshot-every", type=int, default=None,
+                    help="snapshot period in engine steps / front-end "
+                         "ticks; requires --snapshot-dir")
     # model knobs (deterministic from --model-seed)
     ss.add_argument("--vocab", type=int, default=64)
     ss.add_argument("--dim", type=int, default=64)
@@ -915,6 +992,22 @@ def main(argv: list[str] | None = None) -> int:
                      help="include per-request token streams in the "
                           "report JSON")
     cfa.set_defaults(fn=_cmd_chaos_faults)
+
+    sn = sub.add_parser(
+        "snapshot",
+        help="crash-consistency tooling (attention_tpu.engine."
+             "snapshot): inspect / verify serve-sim snapshot files",
+    )
+    snsub = sn.add_subparsers(dest="snapshot_cmd", required=True)
+    si = snsub.add_parser("inspect", help="print manifest + metadata "
+                                          "JSON per snapshot")
+    si.add_argument("path", help=".atpsnap file or a --snapshot-dir")
+    si.set_defaults(fn=_cmd_snapshot_inspect)
+    sv = snsub.add_parser("verify", help="check integrity (checksums, "
+                                         "version, section table); "
+                                         "exit 0 iff restorable")
+    sv.add_argument("path", help=".atpsnap file or a --snapshot-dir")
+    sv.set_defaults(fn=_cmd_snapshot_verify)
 
     an = sub.add_parser(
         "analyze",

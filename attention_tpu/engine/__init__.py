@@ -17,7 +17,9 @@ concurrent requests in, batched `paged_append(_chunk)` +
 Modules: `request` (lifecycle + sampling params), `allocator` (pages +
 prefix cache), `scheduler` (iteration-level batch composition),
 `engine` (the step loop), `metrics` (TTFT/TPOT/page-utilization
-records), `sim` (JSON traces + replay — `cli serve-sim`'s core).
+records), `sim` (JSON traces + replay — `cli serve-sim`'s core),
+`snapshot` + `journal` (crash-consistent durability: checksummed
+atomic snapshots, write-ahead log, warm recovery).
 """
 
 from attention_tpu.engine.allocator import (  # noqa: F401
@@ -32,7 +34,14 @@ from attention_tpu.engine.engine import (  # noqa: F401
 from attention_tpu.engine.errors import (  # noqa: F401
     DeadlineExceededError,
     ReplicaDeadError,
+    ReplicaStateError,
     RequestShedError,
+    SnapshotCorruptError,
+    SnapshotError,
+)
+from attention_tpu.engine.journal import (  # noqa: F401
+    Journal,
+    apply_journal,
 )
 from attention_tpu.engine.metrics import (  # noqa: F401
     EngineMetrics,
@@ -56,4 +65,9 @@ from attention_tpu.engine.sim import (  # noqa: F401
     sampling_of,
     save_trace,
     synthetic_trace,
+)
+from attention_tpu.engine.snapshot import (  # noqa: F401
+    SnapshotManager,
+    recover_engine,
+    state_fingerprint,
 )

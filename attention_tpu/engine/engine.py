@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import itertools
 import time
 from typing import Any, Callable
 
@@ -159,10 +158,15 @@ class ServingEngine:
         )
         self.metrics = EngineMetrics()
         self._step = 0
-        self._seq = itertools.count()
+        # plain int (not itertools.count) so snapshots can persist the
+        # position: auto request-ids and FCFS tiebreaks survive restore
+        self._next_seq = 0
         self._finished_in_step = 0
         self._rng_keys: dict[str, jax.Array] = {}
         self._wall: dict[str, dict[str, float]] = {}
+        # write-ahead log between snapshots; attached by SnapshotManager
+        # (engine/snapshot.py), None when durability is off
+        self.journal: Any = None
 
     # -- request intake ---------------------------------------------------
 
@@ -210,7 +214,8 @@ class ServingEngine:
         `DeadlineExceededError` here instead of enqueueing."""
         sampling = sampling or SamplingParams()
         prompt = self._validate_intake(prompt, sampling, deadline_step)
-        seq = next(self._seq)
+        seq = self._next_seq
+        self._next_seq += 1
         req = Request(
             request_id=request_id or f"req-{seq}",
             prompt=prompt,
@@ -221,6 +226,8 @@ class ServingEngine:
         )
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
+        if self.journal is not None:
+            self.journal.record_admit(req)
         return req
 
     def resume_request(self, prompt, sampling: SamplingParams, *,
@@ -248,7 +255,8 @@ class ServingEngine:
                 f"leave nothing to resume (max_tokens "
                 f"{sampling.max_tokens})"
             )
-        seq = next(self._seq)
+        seq = self._next_seq
+        self._next_seq += 1
         req = Request(
             request_id=request_id,
             prompt=prompt,
@@ -271,6 +279,8 @@ class ServingEngine:
                 self._rng_keys[request_id] = key
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
+        if self.journal is not None:
+            self.journal.record_admit(req)
         return req
 
     def cancel(self, request_id: str) -> bool:
@@ -294,6 +304,8 @@ class ServingEngine:
                 req.transition(RequestState.CANCELLED)
                 self._rng_keys.pop(req.request_id, None)
                 self._wall.pop(req.request_id, None)
+                if self.journal is not None:
+                    self.journal.record_cancel(request_id)
                 return True
         return False
 
@@ -313,6 +325,8 @@ class ServingEngine:
         req.finish_step = self._step
         self._rng_keys.pop(req.request_id, None)
         self._wall.pop(req.request_id, None)
+        if self.journal is not None:
+            self.journal.record_timeout(req.request_id)
         if self.on_timeout is not None:
             self.on_timeout(req)
 
@@ -514,6 +528,8 @@ class ServingEngine:
 
     def _emit(self, req: Request, token: int) -> None:
         done = req.emit(token)
+        if self.journal is not None:
+            self.journal.record_token(req.request_id, token)
         if req.first_token_step < 0:
             req.first_token_step = self._step
             self._wall[req.request_id]["first_token"] = time.perf_counter()
@@ -525,6 +541,8 @@ class ServingEngine:
     def _finish(self, req: Request) -> None:
         req.transition(RequestState.FINISHED)
         req.finish_step = self._step
+        if self.journal is not None:
+            self.journal.record_finish(req.request_id)
         if req.pages:
             self.allocator.free(req.pages)
         req.pages = []

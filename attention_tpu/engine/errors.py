@@ -18,7 +18,22 @@ failure modes a *resilient* serving layer must distinguish:
   budget).  Stored on the shed request so callers see a typed cause,
   never a bare RuntimeError.
 
-All three subclass RuntimeError, the `OutOfPagesError` lineage — the
+The snapshot/journal subsystem (PR 9) adds the durability half:
+
+* :class:`SnapshotError` — base for anything wrong with persisted
+  serving state.  Callers that want "warm if possible, cold
+  otherwise" catch this one class.
+* :class:`SnapshotCorruptError` — a snapshot or journal file failed
+  validation (bad magic, stale version, truncated section, checksum
+  mismatch).  Recovery code treats it as "this file does not count",
+  never as a crash: `ReplicaHandle.restart(warm_from=...)` falls back
+  to the cold `resume_request` path.
+* :class:`ReplicaStateError` — a lifecycle operation was applied to a
+  replica in the wrong state (e.g. `restart` on a live replica).
+  Distinct from :class:`ReplicaDeadError`, which covers work routed
+  *at* a dead replica.
+
+All subclass RuntimeError, the `OutOfPagesError` lineage — the
 ATP401 contract (attention_tpu/analysis/errors.py) extends over
 ``frontend/`` so generic raises cannot creep back in.
 """
@@ -50,3 +65,30 @@ class RequestShedError(RuntimeError):
     always deliberate policy, recorded on the request's ``error``
     field so clients can distinguish "shed, retry later" from a
     serving bug."""
+
+
+class SnapshotError(RuntimeError):
+    """Base class for serving-state durability failures.
+
+    `recover_engine` and `ReplicaHandle.restart(warm_from=...)` catch
+    this class: any subclass means "warm recovery unavailable, take
+    the cold path", never a crash."""
+
+
+class SnapshotCorruptError(SnapshotError):
+    """A snapshot or journal file failed validation.
+
+    Bad magic, unsupported version, truncated section, per-section
+    CRC mismatch, or a model fingerprint that does not match the
+    engine being restored.  Raised by `engine.snapshot.restore` (and
+    by `recover_engine` when *no* candidate validates); a torn journal
+    *tail* is tolerated silently instead — the valid prefix is used."""
+
+
+class ReplicaStateError(RuntimeError):
+    """A replica lifecycle operation was applied in the wrong state.
+
+    E.g. `ReplicaHandle.restart` on a replica that is still alive:
+    the caller must `kill()` first.  Kept distinct from
+    :class:`ReplicaDeadError` (work routed at a *dead* replica) so
+    chaos invariants can tell misuse from expected fail-stop."""

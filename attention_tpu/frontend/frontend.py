@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import os
 from typing import Any, Callable, Sequence
 
 from attention_tpu import obs
@@ -189,6 +190,11 @@ class FrontendConfig:
         default_factory=DegradePolicy)
     default_ttl_ticks: int | None = None  # applied when submit has none
     stall_ticks: int = 4   # un-admitted for this long -> migrate
+    # durability (engine.snapshot): when BOTH are set each replica
+    # snapshots every N of its own steps into
+    # <snapshot_dir>/<replica_id>/ and restart_replica recovers warm
+    snapshot_dir: str | None = None
+    snapshot_every: int | None = None
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -204,6 +210,14 @@ class FrontendConfig:
             raise ValueError(
                 f"default_ttl_ticks must be >= 1, got "
                 f"{self.default_ttl_ticks}"
+            )
+        if (self.snapshot_dir is None) != (self.snapshot_every is None):
+            raise ValueError(
+                "snapshot_dir and snapshot_every must be set together"
+            )
+        if self.snapshot_every is not None and self.snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {self.snapshot_every}"
             )
         self.retry.validate()
         self.shed.validate()
@@ -231,6 +245,10 @@ class ServingFrontend:
         self.replicas = [
             ReplicaHandle(
                 f"replica-{i}", model, params, engine_config,
+                snapshot_dir=(os.path.join(config.snapshot_dir,
+                                           f"replica-{i}")
+                              if config.snapshot_dir else None),
+                snapshot_every=config.snapshot_every,
                 on_token=self._on_engine_token,
                 on_finish=self._on_engine_finish,
                 on_timeout=self._on_engine_timeout,
@@ -249,6 +267,7 @@ class ServingFrontend:
             "retries_scheduled": 0, "retries_exhausted": 0,
             "migrations": 0, "deadline_expired": 0,
             "replica_kills": 0, "replica_restarts": 0,
+            "warm_restarts": 0, "warm_adoptions": 0,
         }
 
     # -- intake -----------------------------------------------------------
@@ -405,16 +424,67 @@ class ServingFrontend:
             self._requeue(fr, self._tick, cause)
         return True
 
-    def restart_replica(self, replica_id: str) -> bool:
-        """Bring a dead replica back cold at the current tick."""
+    def restart_replica(self, replica_id: str, *,
+                        warm: bool | None = None) -> bool:
+        """Bring a dead replica back at the current tick.
+
+        ``warm`` defaults to "whenever the replica has a snapshot
+        directory": the handle recovers from its newest valid snapshot
+        + journal replay and the front end then *reconciles* the
+        restored in-flight requests against its own bookkeeping —
+        requests whose restored token position matches the streamed
+        prefix are adopted in place (no re-prefill, no retry delay);
+        anything stale, torn, or already re-homed is cancelled on the
+        engine and left to the cold `resume_request` route.  A corrupt
+        or missing snapshot degrades to a plain cold restart."""
         handle = self._handle(replica_id)
         if handle is None or handle.alive:
             return False
-        handle.restart(tick=self._tick)
+        want_warm = handle.snapshot_dir is not None \
+            if warm is None else warm
+        mode = handle.restart(
+            tick=self._tick,
+            warm_from=handle.snapshot_dir if want_warm else None,
+        )
+        if mode == "warm":
+            self.counts["warm_restarts"] += 1
+            self._reconcile_restored(handle)
         self._apply_ladder_to(handle)
         self.counts["replica_restarts"] += 1
         _RESTARTED.inc()
         return True
+
+    def _reconcile_restored(self, handle: ReplicaHandle) -> None:
+        """Square a warm-restored engine with front-end bookkeeping.
+
+        The snapshot+journal reconstruct the engine's view of its
+        in-flight requests; the front end is the source of truth for
+        what the CLIENT saw.  A restored request is adopted only when
+        it is still wanted (in RETRY_WAIT after the kill-time requeue)
+        and its restored output position exactly matches the tokens
+        already streamed — a torn journal tail shows up here as a
+        position mismatch and falls back to the cold path, preserving
+        token parity."""
+        eng = handle.engine
+        t = self._tick
+        for req in (*eng.scheduler.waiting, *eng.scheduler.running):
+            fr = self.requests.get(req.request_id)
+            if (fr is None
+                    or fr.state is not FrontendRequestState.RETRY_WAIT
+                    or list(req.output_tokens) != list(fr.tokens)):
+                eng.cancel(req.request_id)
+                continue
+            if fr in self._retry:
+                self._retry.remove(fr)
+            fr.next_retry = None
+            fr.transition(FrontendRequestState.ASSIGNED)
+            fr.replica_id = handle.replica_id
+            fr.routed_by = "warm-restore"
+            fr.assigned_tick = t
+            fr.waiting_since = None
+            # deadline in the restarted replica's own step space
+            req.deadline_step = handle.local_deadline(fr.deadline)
+            self.counts["warm_adoptions"] += 1
 
     # -- internals --------------------------------------------------------
 

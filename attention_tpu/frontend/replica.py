@@ -9,11 +9,24 @@ reference entirely: any later touch raises the typed
 `ReplicaDeadError`, so a resurrection bug reads as a typed error, not
 as silently serving from a corpse.
 
-``restart`` builds a fresh engine (cold caches — a restarted replica
-re-earns its prefix cache) and records the tick it came back, which is
-what keeps deadline translation exact: a replica's engine counts steps
-from ITS OWN birth, so the handle converts front-end ticks to local
-engine steps via ``start_tick``.
+``restart`` brings the replica back one of two ways:
+
+* **warm** (``warm_from=`` a snapshot directory): the newest valid
+  snapshot + journal replay reconstruct the dead engine's full state
+  (`engine.snapshot.recover_engine`) — pages, prefix cache, in-flight
+  requests, RNG positions — so recovery cost is bounded by snapshot
+  lag.  Any `SnapshotError` (corrupt, missing, version-skewed) falls
+  through to the cold path; durability failures degrade, never crash.
+* **cold** (default, and the warm fallback): a fresh engine — empty
+  pool, empty prefix cache, step counter 0, exactly what a real
+  process restart gives you; in-flight work re-enters via the front
+  end's retry machinery (`resume_request`, full re-prefill).
+
+Either way ``restart`` records the tick the replica came back, which
+is what keeps deadline translation exact: a replica's engine counts
+steps from ITS OWN birth (warm restore keeps the restored step), so
+the handle converts front-end ticks to local engine steps via
+``start_tick``.
 """
 
 from __future__ import annotations
@@ -21,9 +34,14 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from attention_tpu.engine.engine import EngineConfig, ServingEngine
-from attention_tpu.engine.errors import ReplicaDeadError
+from attention_tpu.engine.errors import (
+    ReplicaDeadError,
+    ReplicaStateError,
+    SnapshotError,
+)
 from attention_tpu.engine.metrics import StepMetrics
 from attention_tpu.engine.request import Request
+from attention_tpu.engine.snapshot import SnapshotManager, recover_engine
 
 
 class ReplicaHandle:
@@ -31,6 +49,8 @@ class ReplicaHandle:
 
     def __init__(self, replica_id: str, model, params,
                  config: EngineConfig, *, start_tick: int = 0,
+                 snapshot_dir: str | None = None,
+                 snapshot_every: int | None = None,
                  on_token: Callable[[Request, int], None] | None = None,
                  on_finish: Callable[[Request], None] | None = None,
                  on_timeout: Callable[[Request], None] | None = None):
@@ -40,14 +60,27 @@ class ReplicaHandle:
         self.config = config
         self.start_tick = start_tick
         self.deaths = 0
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = snapshot_every
+        #: "warm" | "cold" | None — how the last restart came back
+        self.last_restart_mode: str | None = None
+        self._manager: SnapshotManager | None = None
         self._callbacks = (on_token, on_finish, on_timeout)
         self._engine: ServingEngine | None = self._fresh_engine()
 
     def _fresh_engine(self) -> ServingEngine:
         on_token, on_finish, on_timeout = self._callbacks
-        return ServingEngine(self.model, self.params, self.config,
-                             on_token=on_token, on_finish=on_finish,
-                             on_timeout=on_timeout)
+        engine = ServingEngine(self.model, self.params, self.config,
+                               on_token=on_token, on_finish=on_finish,
+                               on_timeout=on_timeout)
+        self._attach_snapshots(engine)
+        return engine
+
+    def _attach_snapshots(self, engine: ServingEngine) -> None:
+        if self.snapshot_dir and self.snapshot_every:
+            self._manager = SnapshotManager(
+                engine, self.snapshot_dir, every=self.snapshot_every,
+            )
 
     # -- liveness ---------------------------------------------------------
 
@@ -67,22 +100,51 @@ class ReplicaHandle:
     def kill(self) -> None:
         """Simulated fail-stop: the engine (and every page, cache
         entry, and in-flight request it held) is gone.  Idempotent —
-        killing a corpse changes nothing."""
+        killing a corpse changes nothing.  Snapshots and journals on
+        disk survive by construction: that is the durability contract
+        ``restart(warm_from=...)`` recovers from."""
         if self._engine is not None:
             self._engine = None
+            self._manager = None
             self.deaths += 1
 
-    def restart(self, *, tick: int) -> None:
-        """Bring the replica back with a FRESH engine at ``tick``.
-        Cold start: empty pool, empty prefix cache, step counter 0 —
-        exactly what a real process restart gives you."""
+    def restart(self, *, tick: int,
+                warm_from: str | None = None) -> str:
+        """Bring the replica back at ``tick``; returns ``"warm"`` or
+        ``"cold"``.
+
+        With ``warm_from`` set, attempt `recover_engine` on that
+        snapshot directory first; a typed `SnapshotError` (corrupt or
+        missing snapshot — including every crash-point chaos injects)
+        silently degrades to the cold path.  Cold start: empty pool,
+        empty prefix cache, step counter 0."""
         if self._engine is not None:
-            raise ReplicaDeadError(
+            raise ReplicaStateError(
                 f"replica {self.replica_id} is already alive; "
                 "kill it before restarting"
             )
+        if warm_from is not None:
+            on_token, on_finish, on_timeout = self._callbacks
+            try:
+                engine, _ = recover_engine(
+                    self.model, self.params, warm_from,
+                    on_token=on_token, on_finish=on_finish,
+                    on_timeout=on_timeout,
+                )
+            except SnapshotError:
+                engine = None
+            if engine is not None:
+                # the restored engine keeps its own step counter, so
+                # anchor the clock translation at its restored step
+                self.start_tick = tick - engine.current_step
+                self._engine = engine
+                self._attach_snapshots(engine)
+                self.last_restart_mode = "warm"
+                return "warm"
         self.start_tick = tick
         self._engine = self._fresh_engine()
+        self.last_restart_mode = "cold"
+        return "cold"
 
     # -- serving ----------------------------------------------------------
 

@@ -4,15 +4,30 @@ The reference has no checkpointing (single-shot kernel, SURVEY §5); a
 training framework needs it.  Thin orbax wrappers: save/restore the
 (params, opt_state, step) triple; restored arrays are placed back onto
 the caller's mesh sharding by orbax when ``template`` state is provided.
+
+Crash safety (ISSUE 9): a process dying mid-save leaves a partially
+written step directory that LOOKS like the newest checkpoint.  Orbax
+only writes its finalization markers (``_CHECKPOINT_METADATA``) after
+every array has landed, so ``latest_step`` filters to *complete* step
+dirs and ``restore_checkpoint`` walks newest-to-oldest past any step
+that fails to restore — resume-after-crash picks up the last durable
+step instead of exploding on the torn one.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+_logger = logging.getLogger(__name__)
+
+#: files orbax writes only at checkpoint finalization — a step dir
+#: missing all of them is a torn (or foreign) write, not a checkpoint
+_COMPLETE_MARKERS = ("_CHECKPOINT_METADATA", "_METADATA")
 
 
 def save_checkpoint(ckpt_dir: str | os.PathLike, step: int, params: Any,
@@ -26,25 +41,58 @@ def save_checkpoint(ckpt_dir: str | os.PathLike, step: int, params: Any,
     return path
 
 
-def latest_step(ckpt_dir: str | os.PathLike) -> int | None:
+def _is_complete(path: str) -> bool:
+    return os.path.isdir(path) and any(
+        os.path.exists(os.path.join(path, m)) for m in _COMPLETE_MARKERS)
+
+
+def complete_steps(ckpt_dir: str | os.PathLike) -> list[int]:
+    """All finalized step numbers under ``ckpt_dir``, ascending.
+    Digit-named dirs without orbax's finalization markers (a crash
+    mid-save) are excluded."""
     ckpt_dir = os.fspath(ckpt_dir)
     if not os.path.isdir(ckpt_dir):
-        return None
-    steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
-    return max(steps) if steps else None
+        return []
+    return sorted(
+        int(d) for d in os.listdir(ckpt_dir)
+        if d.isdigit() and _is_complete(os.path.join(ckpt_dir, d)))
+
+
+def latest_step(ckpt_dir: str | os.PathLike) -> int | None:
+    """The newest COMPLETE step (None when there is none) — a torn
+    newest dir must not shadow the last durable checkpoint."""
+    steps = complete_steps(ckpt_dir)
+    return steps[-1] if steps else None
 
 
 def restore_checkpoint(ckpt_dir: str | os.PathLike, params_template: Any,
                        opt_state_template: Any, *, step: int | None = None):
     """Restore (params, opt_state, step); templates carry shape/dtype/
-    sharding so arrays land back on the mesh."""
+    sharding so arrays land back on the mesh.
+
+    With ``step=None``, tries complete steps newest-to-oldest: a step
+    that passes the marker check but still fails to restore (markers
+    landed, arrays torn) is skipped with a warning.  An explicit
+    ``step`` is restored as-asked — failures propagate."""
     ckpt_dir = os.path.abspath(os.fspath(ckpt_dir))
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    path = os.path.join(ckpt_dir, str(step))
     ckptr = ocp.StandardCheckpointer()
     template = {"params": params_template, "opt_state": opt_state_template}
-    restored = ckptr.restore(path, template)
-    return restored["params"], restored["opt_state"], step
+    if step is not None:
+        restored = ckptr.restore(os.path.join(ckpt_dir, str(step)), template)
+        return restored["params"], restored["opt_state"], step
+    candidates = complete_steps(ckpt_dir)
+    if not candidates:
+        raise FileNotFoundError(f"no complete checkpoints under {ckpt_dir}")
+    last_error: Exception | None = None
+    for cand in reversed(candidates):
+        try:
+            restored = ckptr.restore(
+                os.path.join(ckpt_dir, str(cand)), template)
+            return restored["params"], restored["opt_state"], cand
+        except Exception as e:  # noqa: BLE001 - orbax raises assorted types
+            last_error = e
+            _logger.warning("checkpoint step %d unrestorable (%s); "
+                            "falling back", cand, e)
+    raise FileNotFoundError(
+        f"no restorable checkpoint under {ckpt_dir} "
+        f"(last error: {last_error})")

@@ -147,6 +147,16 @@ def pytest_configure(config):
         "— ATP### passes, suppressions, baseline, renderers; tier-1 "
         "fast",
     )
+    # the durability tier (tests/test_snapshot.py): checksummed atomic
+    # snapshots, write-ahead journal, warm recovery; CPU-only and
+    # tier-1 fast except the crash-storm sweep (also carries slow)
+    config.addinivalue_line(
+        "markers",
+        "snapshot: crash-consistent durability (attention_tpu/engine/"
+        "snapshot.py + journal.py) — save/restore round trips, "
+        "corruption table, journal replay, warm recovery parity; "
+        "CPU-only",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

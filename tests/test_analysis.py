@@ -322,6 +322,56 @@ def test_errors_flags_generic_raises_in_typed_paths():
     assert run_pass(src, "errors", path="attention_tpu/ops/x.py") == []
 
 
+# ---------------------- durability (ATP701) ----------------------
+
+def test_durability_flags_truncating_open_without_replace():
+    src = """
+        import os
+
+        def save_torn(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+
+        def save_atomic(path, blob):
+            import tempfile
+            fd, tmp = tempfile.mkstemp(dir=".")
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+
+        def append_wal(path, line):
+            with open(path, "ab") as f:
+                f.write(line)
+
+        def read(path):
+            with open(path, "rb") as f:
+                return f.read()
+        """
+    fs = run_pass(src, "durability",
+                  path="attention_tpu/engine/snapshot.py")
+    assert codes(fs) == ["ATP701"]
+    assert fs[0].line == 5
+    # only the three durable-persistence modules are in scope
+    assert run_pass(src, "durability",
+                    path="attention_tpu/engine/engine.py") == []
+
+
+def test_durability_inline_suppression_and_module_level():
+    src = """
+        import os
+
+        with open("state.json", "w") as f:  # atp: disable=ATP701
+            f.write("{}")
+
+        with open("torn.json", "w") as f:
+            f.write("{}")
+        """
+    fs = run_pass(src, "durability",
+                  path="attention_tpu/tuning/cache.py")
+    assert codes(fs) == ["ATP701"]
+    assert fs[0].line == 7
+
+
 # ---------------------- conventions (ATP5xx/ATP601) ----------------------
 
 def test_obs_naming_pass_literal_vs_dynamic():
@@ -451,14 +501,16 @@ def test_text_render_clean_and_dirty():
 def test_every_registered_pass_has_codes_and_stable_ids():
     assert set(core.PASSES) == {"purity", "pallas", "precision",
                                 "errors", "obs-naming", "shipped-table",
-                                "tolerance-ledger", "source-only-tree"}
+                                "tolerance-ledger", "source-only-tree",
+                                "durability"}
     for p in core.PASSES.values():
         assert p.codes, p.name
         assert p.scope in ("file", "project")
     # stable public ids: retiring/renumbering any of these is a break
     assert {"ATP001", "ATP101", "ATP102", "ATP103", "ATP201", "ATP202",
             "ATP203", "ATP204", "ATP301", "ATP302", "ATP401", "ATP402",
-            "ATP501", "ATP502", "ATP503", "ATP601"} <= set(core.CODES)
+            "ATP501", "ATP502", "ATP503", "ATP601",
+            "ATP701"} <= set(core.CODES)
 
 
 # ---------------------- CLI + wrappers + the tier-1 gate ----------------

@@ -45,3 +45,31 @@ def test_checkpoint_roundtrip_resumes_training(tmp_path, rng):
 
 def test_latest_step_empty(tmp_path):
     assert latest_step(tmp_path / "nope") is None
+
+
+def test_interrupted_save_falls_back_to_complete_step(tmp_path):
+    """ISSUE 9 regression: a crash mid-save leaves a digit-named step
+    dir without orbax's finalization markers.  It must not shadow the
+    last durable checkpoint — `latest_step` skips it and the default
+    `restore_checkpoint` lands on the newest COMPLETE step."""
+    mesh = make_mesh_3d(8)
+    model = TinyDecoder(vocab=32, dim=32, depth=1, num_q_heads=2,
+                        num_kv_heads=1, impl="xla", dtype=jnp.float32)
+    params, _, opt_state = init_sharded(model, mesh, batch=4, seq=16)
+    ckpt = tmp_path / "ckpts"
+    save_checkpoint(ckpt, 3, params, opt_state)
+
+    # simulate a crash mid-save of step 7: array payload started
+    # landing but the finalization markers never did
+    torn = ckpt / "7"
+    (torn / "d").mkdir(parents=True)
+    (torn / "d" / "partial.bin").write_bytes(b"\x00" * 64)
+    (torn / "manifest.ocdbt").write_bytes(b"torn")
+
+    assert latest_step(ckpt) == 3
+    params2, _, opt_state2 = init_sharded(model, mesh, batch=4, seq=16)
+    r_params, _, step = restore_checkpoint(ckpt, params2, opt_state2)
+    assert step == 3
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(r_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
