@@ -13,16 +13,20 @@ scratch; see `frontend.ReplicaHandle.restart`).
 
 Format: append-only JSONL, one record per line, each carrying a
 ``crc`` of its own canonical serialization.  Append-only is what makes
-the write-path crash-safe without the tmp+``os.replace`` idiom the
+the *record* path crash-safe without the tmp+``os.replace`` idiom the
 snapshot needs (ATP701 in `analysis/durability.py` enforces exactly
 this split): a crash can tear at most the final line, and
 :meth:`Journal.read` stops at the first record that fails to parse or
 checksum — the valid prefix is used, a torn tail is silently dropped,
-never an exception.  Files are named ``journal-<step:08d>.wal`` after
-the snapshot step they extend and are rotated by `SnapshotManager`
-*after* the next snapshot lands, so a corrupt newest snapshot can
-still chain-replay from an older one through the complete journals in
-between.
+never an exception.  The file itself, though, is created FRESH and
+atomically (tmp + ``os.replace`` of the ``begin`` record): a journal
+extends exactly the snapshot it is named for, so a same-named file
+left by a dead incarnation holds records already baked into that
+snapshot — appending across incarnations would replay them twice.
+Files are named ``journal-<step:08d>.wal`` after the snapshot step
+they extend and are rotated by `SnapshotManager` *after* the next
+snapshot lands, so a corrupt newest snapshot can still chain-replay
+from an older one through the complete journals in between.
 
 Replay (`apply_journal`) applies the *net effect* per request rather
 than re-executing events: requests that reached a terminal state after
@@ -42,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import tempfile
 import zlib
 
 import jax
@@ -100,7 +105,27 @@ class Journal:
         self.path = path
         self.snapshot_step = snapshot_step
         self.records_written = 0
-        self._append({"kind": "begin", "snapshot_step": snapshot_step})
+        # The journal extends the snapshot just taken at
+        # ``snapshot_step``: a same-named file on disk belongs to a
+        # dead incarnation and its records are already baked into that
+        # snapshot, so the file is created fresh — atomically, via a
+        # sibling temp + os.replace, never truncate-in-place — and a
+        # crash here leaves either no journal (reads as empty) or a
+        # complete begin record, never a stale or torn head.
+        directory = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(_record_line({"kind": "begin",
+                                      "snapshot_step": snapshot_step}))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.records_written = 1
 
     def _append(self, rec: dict) -> None:
         with open(self.path, "ab") as f:

@@ -29,9 +29,11 @@ Serialization is deterministic (sorted keys, ordered queues), so
 chaos invariant ``restore(save(engine))`` compares exactly that.
 
 Durability discipline (pinned by ATP701, `analysis/durability.py`):
-the snapshot file appears atomically via ``tempfile.mkstemp`` in the
-target directory + ``os.replace`` — a reader (or a recovery scan)
-never observes a torn snapshot, only the previous one.  Any validation
+the snapshot file appears atomically AND durably via
+``tempfile.mkstemp`` in the target directory, ``os.fsync`` of the
+temp fd, ``os.replace``, then an fsync of the directory — a reader
+(or a recovery scan) never observes a torn snapshot, only the
+previous one, and a landed file survives power loss.  Any validation
 failure — bad magic, stale version, truncated or bit-flipped section,
 model mismatch — raises the typed `SnapshotCorruptError`; recovery
 code treats that as "this candidate does not count" and falls back,
@@ -281,9 +283,23 @@ def state_fingerprint(engine: ServingEngine) -> str:
     return hashlib.sha256(serialize(engine)).hexdigest()
 
 
+def _fsync_dir(directory: str) -> None:
+    """fsync a directory so a just-landed ``os.replace`` survives power
+    loss (no-op on platforms without directory fds)."""
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def save(engine: ServingEngine, path: str) -> dict:
-    """Write one snapshot atomically (tmp in the target dir +
-    ``os.replace``); returns ``{path, nbytes, step}``."""
+    """Write one snapshot durably and atomically (tmp in the target
+    dir, fsync, ``os.replace``, fsync the directory); returns
+    ``{path, nbytes, step}``."""
     t0 = time.perf_counter()
     blob = serialize(engine)
     directory = os.path.dirname(os.path.abspath(path))
@@ -292,7 +308,14 @@ def save(engine: ServingEngine, path: str) -> dict:
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(blob)
+            f.flush()
+            # a rename can land while the data blocks are still dirty:
+            # without this fsync a power loss can leave the final path
+            # holding an empty/partial file, and _prune may by then
+            # have dropped the journals an older snapshot needs
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(directory)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -555,6 +578,18 @@ class SnapshotManager:
     always has a base.  Keeps the ``keep`` newest snapshots plus every
     journal needed to chain-replay from the oldest kept one.
 
+    Attach starts a new INCARNATION: every ``snap-*``/``journal-*``
+    (and torn ``.tmp``) left by a previous manager of this directory
+    is deleted before the genesis lands.  The genesis is a full state
+    cut, so those files are pure supersession debris — and because
+    their names are keyed by step, leaving them would poison recovery:
+    a dead incarnation's journal replays records the genesis already
+    contains (duplicated tokens), and after a cold restart its
+    higher-step snapshots would outrank the genesis and resurrect
+    pre-restart state.  Clearing first keeps every crash window of
+    attach safe: a kill before the genesis lands degrades to a cold
+    recovery, never to wrong tokens.
+
     ``crash_next`` is the chaos crash-point: when armed, the next save
     dies "mid-write" — a partial ``.tmp`` file is left behind and the
     final path is never touched, proving the atomic-replace discipline
@@ -578,11 +613,25 @@ class SnapshotManager:
         self.last_snapshot_step = -1
         self._inner_step = engine.step
         engine.step = self._step
-        engine.journal = Journal(
-            journal_path(directory, engine.current_step),
-            snapshot_step=engine.current_step,
-        )
+        self._clear_stale()
+        # the genesis snapshot() below owns journal creation (rotation
+        # after the snapshot lands), so nothing is journaled — and the
+        # lag gauge reads 0 — until recovery has a base to extend
+        engine.journal = None
         self.snapshot()
+
+    def _clear_stale(self) -> None:
+        """Delete a dead incarnation's files (see class docstring)."""
+        stale = [p for _, p in list_snapshots(self.directory)]
+        stale += [p for _, p in list_journals(self.directory)]
+        stale += [os.path.join(self.directory, name)
+                  for name in os.listdir(self.directory)
+                  if name.endswith(".tmp")]
+        for path in stale:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def _step(self):
         metrics = self._inner_step()
@@ -610,9 +659,10 @@ class SnapshotManager:
             return None
         path = snapshot_path(self.directory, step)
         save(engine, path)
-        # rotate AFTER the snapshot lands: the outgoing journal file
-        # stays complete on disk, so replay can chain from an older
-        # snapshot if this one is later damaged
+        # rotate AFTER the snapshot lands (the genesis call creates the
+        # incarnation's first journal): the outgoing journal file stays
+        # complete on disk, so replay can chain from an older snapshot
+        # if this one is later damaged
         engine.journal = Journal(journal_path(self.directory, step),
                                  snapshot_step=step)
         self.saves += 1

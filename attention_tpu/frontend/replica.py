@@ -117,7 +117,14 @@ class ReplicaHandle:
         snapshot directory first; a typed `SnapshotError` (corrupt or
         missing snapshot — including every crash-point chaos injects)
         silently degrades to the cold path.  Cold start: empty pool,
-        empty prefix cache, step counter 0."""
+        empty prefix cache, step counter 0.
+
+        Either way, re-attaching the `SnapshotManager` starts a new
+        incarnation: recovery reads the dead incarnation's files
+        first, then the manager clears them and writes a genesis
+        snapshot of the engine that actually came back — so a cold
+        restart can never be warm-recovered into the PRE-restart
+        state, and step-keyed filenames never mix incarnations."""
         if self._engine is not None:
             raise ReplicaStateError(
                 f"replica {self.replica_id} is already alive; "

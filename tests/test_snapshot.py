@@ -34,7 +34,11 @@ from attention_tpu.engine import (
     sampling_of,
     synthetic_trace,
 )
-from attention_tpu.engine.journal import Journal, list_journals
+from attention_tpu.engine.journal import (
+    Journal,
+    journal_path,
+    list_journals,
+)
 from attention_tpu.engine.snapshot import (
     SNAPSHOT_VERSION,
     SnapshotManager,
@@ -237,6 +241,25 @@ def test_corruption_is_typed_refusal(tiny_model, tmp_path, mode):
     assert verify(good) == []
 
 
+def test_save_fsyncs_file_and_directory_around_replace(
+        tiny_model, tmp_path, monkeypatch):
+    """Durability of a landed snapshot: `save` fsyncs the temp fd
+    BEFORE the atomic rename and the directory after it, so a power
+    loss can't leave an empty/partial file at the final path."""
+    model, params = tiny_model
+    eng = ServingEngine(model, params, _cfg())
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync",
+        lambda fd: (events.append("fsync"), real_fsync(fd))[1])
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b))[1])
+    save(eng, str(tmp_path / "snap.atpsnap"))
+    assert events == ["fsync", "replace", "fsync"]
+
+
 def test_restore_rejects_model_fingerprint_mismatch(tiny_model, tmp_path):
     model, params = tiny_model
     eng = ServingEngine(model, params, _cfg())
@@ -352,6 +375,107 @@ def test_recover_engine_raises_typed_when_nothing_valid(
     model, params = tiny_model
     with pytest.raises(SnapshotCorruptError):
         recover_engine(model, params, str(tmp_path / "empty"))
+
+
+def test_manager_attach_starts_fresh_incarnation(tiny_model, tmp_path):
+    """Attach clears a dead incarnation's step-keyed files and the
+    genesis journal is created fresh — exactly one ``begin`` record,
+    never an append onto stale pre-crash records."""
+    model, params = tiny_model
+    d = tmp_path / "snaps"
+    d.mkdir()
+    # debris from a "dead incarnation": a stale journal at the genesis
+    # step, a stale higher-step snapshot, and a torn save
+    stale = Journal(journal_path(str(d), 0), snapshot_step=0)
+    stale.record_token("ghost", 7)
+    (d / "snap-00000009.atpsnap").write_bytes(b"not a snapshot")
+    (d / "tmpdead.tmp").write_bytes(b"torn")
+
+    eng = ServingEngine(model, params, _cfg())
+    SnapshotManager(eng, str(d), every=4)
+    assert [s for s, _ in list_snapshots(str(d))] == [0]
+    assert [s for s, _ in list_journals(str(d))] == [0]
+    assert not (d / "tmpdead.tmp").exists()
+    recs = Journal.read(journal_path(str(d), 0))
+    assert [r["kind"] for r in recs] == ["begin"]
+
+
+# --------------------------------------- incarnation / re-crash parity
+
+
+def test_warm_restart_then_second_crash_token_parity(
+        tiny_model, tmp_path):
+    """Review regression (high): after a warm restart the manager's
+    genesis snapshot already contains the replayed journal records, so
+    a SECOND crash before the next periodic snapshot must not replay
+    the dead incarnation's records again (duplicated tokens).  Two
+    kill → warm-restart cycles stay token-identical to the fault-free
+    run."""
+    model, params = tiny_model
+    trace = synthetic_trace(6, vocab=model.vocab, seed=53, max_tokens=6,
+                            temperature=0.7)
+    base_engine = ServingEngine(model, params, _cfg())
+    _, baseline = replay(base_engine, trace)
+
+    outs: dict[str, list[int]] = {}
+    d = str(tmp_path / "snaps")
+    handle = ReplicaHandle(
+        "replica-0", model, params, _cfg(), snapshot_dir=d,
+        snapshot_every=4,
+        on_finish=lambda r: outs.__setitem__(
+            r.request_id, list(r.output_tokens)))
+    _admit_all(handle.engine, trace)
+    for _ in range(6):
+        handle.step()
+
+    handle.kill()
+    assert handle.restart(tick=6, warm_from=d) == "warm"
+    assert handle.engine.scheduler.has_work()
+    # fewer steps than snapshot_every: the second crash lands before
+    # any periodic snapshot, so recovery leans on the genesis + the
+    # incarnation's own journal alone
+    for _ in range(2):
+        handle.step()
+
+    handle.kill()
+    assert handle.restart(tick=8, warm_from=d) == "warm"
+    steps = 0
+    while handle.has_work():
+        handle.step()
+        steps += 1
+        assert steps < 500, "replica failed to drain"
+    assert set(outs) == set(baseline)
+    for rid, toks in outs.items():
+        assert toks == baseline[rid], rid
+
+
+def test_cold_restart_cannot_resurrect_dead_incarnation(
+        tiny_model, tmp_path):
+    """Review regression (medium): a cold restart keeps the snapshot
+    dir but must not leave the dead incarnation's higher-step files
+    behind — a later kill + warm restart recovers the COLD
+    incarnation's (empty) state, never the pre-restart one."""
+    model, params = tiny_model
+    d = str(tmp_path / "snaps")
+    handle = ReplicaHandle("replica-0", model, params, _cfg(),
+                           snapshot_dir=d, snapshot_every=2)
+    _admit_all(handle.engine,
+               synthetic_trace(3, vocab=model.vocab, seed=13,
+                               max_tokens=6))
+    for _ in range(5):
+        handle.step()
+    assert max(s for s, _ in list_snapshots(d)) > 0
+
+    handle.kill()
+    assert handle.restart(tick=10) == "cold"
+    # the cold incarnation's genesis is now the ONLY recovery base
+    assert [s for s, _ in list_snapshots(d)] == [0]
+    assert [s for s, _ in list_journals(d)] == [0]
+
+    handle.kill()
+    assert handle.restart(tick=12, warm_from=d) == "warm"
+    assert handle.engine.current_step == 0
+    assert not handle.engine.scheduler.has_work()
 
 
 # ----------------------------------------------- frontend warm recovery
