@@ -38,6 +38,8 @@ from attention_tpu.chaos import invariants as inv
 from attention_tpu.engine import journal as journal_mod
 from attention_tpu.engine import snapshot as snapshot_mod
 from attention_tpu.engine.engine import EngineConfig, ServingEngine
+from attention_tpu.engine.errors import StepInterruptedError
+from attention_tpu.engine.metrics import StepMetrics
 from attention_tpu.engine.scheduler import ScheduledStep
 from attention_tpu.engine.sim import replay, synthetic_trace
 from attention_tpu.ops.paged import OutOfPagesError
@@ -375,6 +377,16 @@ CRASH_FAULT_KINDS = FRONTEND_FAULT_KINDS + (
     "journal_tear",   # truncate the newest journal mid-record
 )
 
+#: the gray failures (ISSUE 10) — a replica that is sick but not dead:
+#: each arms a WINDOW of ``arg`` affected steps on the target replica's
+#: CURRENT engine, exactly the shapes the `ReplicaSupervisor` detects
+GRAY_FAULT_KINDS = (
+    "slow_step",      # inflate the engine's virtual step cost
+    "flaky_step",     # typed StepInterruptedError before the step runs
+    "stall",          # silently swallow the step (counter freezes)
+    "nan",            # poison the model's output logits with NaN
+)
+
 
 def random_frontend_plan(seed: int, request_ids: Sequence[str],
                          num_replicas: int, *, num_events: int = 5,
@@ -453,6 +465,42 @@ def random_crash_plan(seed: int, request_ids: Sequence[str],
                                  target=victim))
         events.append(FaultEvent(step=step + int(rng.integers(2, 7)),
                                  kind="replica_restart", target=victim))
+    events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
+def random_gray_plan(seed: int, request_ids: Sequence[str],
+                     num_replicas: int, *, num_events: int = 6,
+                     max_tick: int = 24) -> FaultPlan:
+    """Sample one seeded gray storm: sick-but-not-dead windows
+    (`GRAY_FAULT_KINDS`) plus the occasional client cancel, with one
+    guaranteed slow-step window, one flaky-step window, and one
+    fail-stop kill per plan — the acceptance mix (detection, live
+    migration, AND standby promotion all get exercised)."""
+    rng = np.random.default_rng(seed)
+    kinds = GRAY_FAULT_KINDS + ("cancel",)
+    events = []
+    for _ in range(num_events):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        step = int(rng.integers(1, max_tick))
+        arg, target = 1, None
+        if kind in GRAY_FAULT_KINDS:
+            arg = int(rng.integers(2, 6))   # window length in steps
+            target = f"replica-{int(rng.integers(num_replicas))}"
+        else:
+            target = request_ids[int(rng.integers(len(request_ids)))]
+        events.append(FaultEvent(step=step, kind=kind, arg=arg,
+                                 target=target))
+    for kind in ("slow_step", "flaky_step"):
+        if not any(e.kind == kind for e in events):
+            events.append(FaultEvent(
+                step=int(rng.integers(1, max_tick)), kind=kind,
+                arg=int(rng.integers(2, 6)),
+                target=f"replica-{int(rng.integers(num_replicas))}"))
+    if not any(e.kind == "replica_kill" for e in events):
+        events.append(FaultEvent(
+            step=int(rng.integers(2, max_tick)), kind="replica_kill",
+            target=f"replica-{int(rng.integers(num_replicas))}"))
     events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
     return FaultPlan(seed=seed, events=tuple(events))
 
@@ -563,8 +611,67 @@ class FrontendFaultInjector:
                 return
             _tear_tail(journals[-1][1], ev.arg)
             self._mark("journal_tear")
+        elif ev.kind in GRAY_FAULT_KINDS:
+            handle = self._handle(ev.target)
+            if handle is None or not handle.alive:
+                self.skipped.append(f"{ev.kind}:{ev.target}")
+                return
+            self._arm_gray(handle, ev.kind, max(1, ev.arg))
         else:
             raise ValueError(f"unknown frontend fault kind {ev.kind!r}")
+
+    def _arm_gray(self, handle, kind: str, count: int) -> None:
+        """Arm a gray-failure window of ``count`` steps on the target
+        replica's CURRENT engine (like `_arm_oom`, a restart sheds the
+        fault state — a fresh process is healthy until proven sick).
+
+        * ``slow_step`` — the step runs normally, then its virtual
+          cost is inflated; only the supervisor's EWMA notices.
+        * ``flaky_step`` — typed `StepInterruptedError` raised BEFORE
+          the inner step, so no request state mutates.
+        * ``stall`` — the step is silently swallowed (a fake metrics
+          row, frozen step counter): the gray failure with no error.
+        * ``nan`` — the model's output logits come back NaN; the
+          engine's finite guard must skip sampling (never emit
+          garbage) and count the event.
+        """
+        eng = handle.engine
+        state = {"left": count}
+        if kind == "nan":
+            orig_apply = eng._apply
+
+            def poisoned(*args, **kwargs):
+                out = orig_apply(*args, **kwargs)
+                if state["left"] > 0:
+                    state["left"] -= 1
+                    self._mark("nan")
+                    out = np.full_like(np.asarray(out), np.nan)
+                return out
+
+            eng._apply = poisoned
+            return
+        orig_step = eng.step
+
+        def wrapped_step():
+            if state["left"] > 0 and kind == "flaky_step":
+                state["left"] -= 1
+                self._mark("flaky_step")
+                raise StepInterruptedError(
+                    f"chaos: injected step interruption on "
+                    f"{handle.replica_id}"
+                )
+            if state["left"] > 0 and kind == "stall":
+                state["left"] -= 1
+                self._mark("stall")
+                return StepMetrics(step=eng.current_step)
+            metrics = orig_step()
+            if state["left"] > 0 and kind == "slow_step":
+                state["left"] -= 1
+                self._mark("slow_step")
+                eng.last_step_virtual_cost = 4.0
+            return metrics
+
+        eng.step = wrapped_step
 
     def _arm_oom(self, handle, count: int) -> None:
         """The next ``count`` admission-path allocations on this
@@ -687,6 +794,13 @@ def run_frontend_plan(model, params, config: EngineConfig,
                  if rid in finished},
                 outputs,
             )
+    # the gray-failure trio (ISSUE 10): all three are no-ops on a
+    # front end whose supervisor never issued a verdict
+    violations += inv.no_double_serve_violations(frontend)
+    violations += inv.supervisor_consistency_violations(frontend)
+    if drained and baseline is not None:
+        violations += inv.migration_parity_violations(frontend,
+                                                      baseline)
     violations += inv.termination_violations(drained, error,
                                              max_steps=max_ticks)
     violations += inv.typed_error_violations(error)
@@ -827,6 +941,69 @@ def run_crash_campaign(seed: int, snapshot_root: str, *,
                 baseline, r.outputs, finished)
         if log is not None:
             log(f"crash storm {i} (seed {plan.seed}): "
+                f"injected={r.injected} "
+                f"violations={len(r.violations)} "
+                f"states={sorted(set(r.states.values()))} "
+                f"error={r.surfaced_error or 'none'}")
+        reports.append(r)
+    return FrontendCampaignReport(seed=seed, num_replicas=num_replicas,
+                                  baseline_outputs=baseline,
+                                  reports=reports)
+
+
+def run_gray_campaign(seed: int, snapshot_root: str, *,
+                      num_plans: int = 5, num_requests: int = 6,
+                      num_replicas: int = 2, standbys: int = 1,
+                      snapshot_every: int = 2,
+                      temperature: float = 0.0,
+                      events_per_plan: int = 6,
+                      config: EngineConfig | None = None,
+                      model=None, params=None,
+                      log: Callable[[str], None] | None = None,
+                      ) -> FrontendCampaignReport:
+    """The ISSUE 10 gray storm: seeded slow-step / flaky-step / stall /
+    NaN windows (plus one guaranteed kill) against a supervised front
+    end with ``standbys`` warm spares and durable replicas.  On top of
+    the storm and durability invariants each plan is checked for the
+    gray trio: migration token parity, no double serve, and supervisor
+    consistency — a detected-and-drained replica costs re-prefills,
+    never tokens, and never serves after its verdict."""
+    from attention_tpu.frontend import SupervisorPolicy
+
+    if model is None or params is None:
+        model, params = build_sim_model()
+    config = config or default_engine_config()
+    trace = synthetic_trace(
+        num_requests, vocab=model.vocab, seed=seed, max_tokens=6,
+        temperature=temperature,
+    )
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    ids = [t["id"] for t in trace]
+    reports = []
+    for i in range(num_plans):
+        plan = random_gray_plan(seed * 7019 + i, ids, num_replicas,
+                                num_events=events_per_plan)
+        frontend_config = default_frontend_config(
+            num_replicas,
+            standbys=standbys,
+            snapshot_dir=os.path.join(snapshot_root, f"plan-{i}"),
+            snapshot_every=snapshot_every,
+            supervisor=SupervisorPolicy(suspect_after=2,
+                                        degrade_after=2, dead_after=2,
+                                        stall_ticks=2),
+        )
+        r = run_frontend_plan(
+            model, params, config, frontend_config, trace, plan,
+            baseline=baseline,
+        )
+        if r.drained:
+            finished = [rid for rid, state in r.states.items()
+                        if state == "finished"]
+            r.violations += inv.warm_recovery_parity_violations(
+                baseline, r.outputs, finished)
+        if log is not None:
+            log(f"gray storm {i} (seed {plan.seed}): "
                 f"injected={r.injected} "
                 f"violations={len(r.violations)} "
                 f"states={sorted(set(r.states.values()))} "

@@ -45,6 +45,22 @@ The durability layer (ISSUE 9) adds two more:
    journal replay) finishes every stream token-identical to the
    fault-free run; crash points (kill mid-snapshot, bit-flipped
    sections, torn journal tails) may cost warmth, never tokens.
+
+The gray-failure layer (ISSUE 10) adds three more:
+
+9.  **Migration token parity** — every stream live-migrated off a
+    SUSPECT replica (and every stream finished on a promoted standby)
+    is token-identical to the fault-free run; migration costs a
+    re-prefill, never a token.
+10. **No double serve** — after a migration cut, the SOURCE replica
+    never emits another token for the moved request (unless a later
+    legitimate re-admission hands it back).  Checked against the
+    per-token emitter attribution the front end records.
+11. **Supervisor consistency** — once a replica's verdict is
+    SUSPECT/DEGRADED/DEAD, no NEW admission routes to it until a
+    recovery or restart verdict.  Checked by replaying the front
+    end's unified event log (append order = global order, so
+    within-tick phase ordering is handled by construction).
 """
 
 from __future__ import annotations
@@ -62,6 +78,7 @@ from attention_tpu.engine.errors import (
     RequestShedError,
     SnapshotCorruptError,
     SnapshotError,
+    StepInterruptedError,
 )
 from attention_tpu.ops.paged import OutOfPagesError, PageAccountingError
 
@@ -72,7 +89,7 @@ _VIOLATIONS = obs.counter("chaos.invariant.violations",
 TYPED_ERRORS = (OutOfPagesError, PageAccountingError,
                 DeadlineExceededError, ReplicaDeadError,
                 RequestShedError, SnapshotError, SnapshotCorruptError,
-                ReplicaStateError)
+                ReplicaStateError, StepInterruptedError)
 
 
 def _report(invariant: str, problems: list[str]) -> list[str]:
@@ -249,6 +266,102 @@ def replica_conservation_violations(frontend, *,
             inner += engine_quiescence_violations(handle.engine)
         problems += [f"{handle.replica_id}: {p}" for p in inner]
     return problems
+
+
+def migration_parity_violations(
+    frontend,
+    baseline: Mapping[str, list[int]],
+) -> list[str]:
+    """Invariant 9: live-migrated streams match the fault-free run.
+
+    Every request the migration machinery actually MOVED (a
+    `MigrationRecord` with a destination) that went on to FINISH must
+    carry exactly the baseline's tokens — the cut preserved the
+    streamed prefix and the RNG chain, so divergence means the resume
+    path dropped or resampled something."""
+    from attention_tpu.frontend.frontend import FrontendRequestState
+
+    problems = []
+    moved = sorted({m.request_id
+                    for m in getattr(frontend, "migrations", [])
+                    if m.dest is not None})
+    for rid in moved:
+        fr = frontend.requests.get(rid)
+        if fr is None or fr.state is not FrontendRequestState.FINISHED:
+            continue
+        if list(fr.tokens) != list(baseline.get(rid, [])):
+            problems.append(
+                f"request {rid}: migrated stream {list(fr.tokens)} != "
+                f"fault-free {list(baseline.get(rid, []))}"
+            )
+    return _report("migration_parity", problems)
+
+
+def no_double_serve_violations(frontend) -> list[str]:
+    """Invariant 10: after a migration cut the source replica never
+    emits another token for the moved request.
+
+    Evidence: ``FrontendRequest.emitters`` (which engine emitted each
+    token, recorded at stream time) against the front end's
+    `MigrationRecord`s and admission history.  A token from the source
+    at an index >= the cut position is a double serve — the request
+    lived on two engines at once — unless a LATER admit event
+    legitimately handed the request back to the source (retry or
+    warm-restore)."""
+    problems = []
+    admits: dict[str, list[tuple[int, str]]] = {}
+    for ev in getattr(frontend, "events_log", []):
+        if ev[0] == "admit":
+            admits.setdefault(ev[2], []).append((ev[1], ev[3]))
+    for m in getattr(frontend, "migrations", []):
+        if m.dest is None:
+            continue
+        fr = frontend.requests.get(m.request_id)
+        if fr is None:
+            continue
+        seq = admits.get(m.request_id, [])
+        # locate the cut's own admission (at most one drain per
+        # request per tick, so (tick, dest) pins it exactly); any
+        # admit to the source AFTER it makes source tokens legal again
+        cut_idx = next((i for i, (tk, rid) in enumerate(seq)
+                        if tk == m.tick and rid == m.dest),
+                       len(seq) - 1)
+        if any(rid == m.source for _, rid in seq[cut_idx + 1:]):
+            continue
+        offenders = [i for i, rid in enumerate(fr.emitters)
+                     if i >= m.tokens_at_cut and rid == m.source]
+        if offenders:
+            problems.append(
+                f"request {m.request_id}: source {m.source} emitted "
+                f"token(s) at index {offenders[:3]} after the cut at "
+                f"{m.tokens_at_cut} (tick {m.tick})"
+            )
+    return _report("no_double_serve", problems)
+
+
+def supervisor_consistency_violations(frontend) -> list[str]:
+    """Invariant 11: no admission to a non-HEALTHY replica.
+
+    Replays the front end's unified event log in append order —
+    verdict events move a replica's supervisor state, admit events
+    must only ever name a replica currently HEALTHY (the default for
+    never-judged replicas).  Because the log is appended in the exact
+    order actions happened, within-tick ordering (kills before phases,
+    verdicts after admissions) needs no special cases."""
+    problems = []
+    state: dict[str, str] = {}
+    for ev in getattr(frontend, "events_log", []):
+        if ev[0] == "verdict":
+            _, _, rid, _, new, _ = ev
+            state[rid] = new
+        elif ev[0] == "admit":
+            _, tick, req_id, rid = ev
+            if state.get(rid, "healthy") != "healthy":
+                problems.append(
+                    f"request {req_id} admitted to {rid} at tick "
+                    f"{tick} while its verdict was {state[rid]}"
+                )
+    return _report("supervisor_consistency", problems)
 
 
 def snapshot_roundtrip_violations(engine) -> list[str]:

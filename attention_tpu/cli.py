@@ -21,6 +21,7 @@ Usage:
       [--num-requests 8 --shared-prefix-len 129 --shared-count 4 ...]
       [--replicas 3 --deadline-ms 40 --tick-ms 1 --max-retries 3
        --chaos-plan plan.json --bursty --tenants 2]
+      [--standbys 1 --suspect-after 2 --gray-plan gray.json]
       [--obs --obs-out run_dir [--obs-profile]]
       # continuous-batching engine over a request trace; prints
       # per-step (--per-step) and summary metrics JSON; --obs-out
@@ -28,7 +29,11 @@ Usage:
       # through the resilient multi-replica front end
       # (attention_tpu.frontend: deadlines, retry-with-backoff, load
       # shedding, graceful degradation) and --chaos-plan attaches a
-      # replica-kill storm
+      # replica-kill storm; --gray-plan attaches a gray-failure storm
+      # (slow/flaky/stall/NaN windows) against the replica supervisor,
+      # --standbys keeps warm spares for DEAD-verdict promotion, and
+      # --trace-out embeds the gray plan so the run replays
+      # byte-identically from the trace file alone
   python -m attention_tpu.cli analyze [paths ...] [--changed]
       [--format text|json|sarif] [--baseline FILE | --no-baseline]
       [--list-codes]
@@ -247,10 +252,21 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
             shared_count=args.shared_count,
             temperature=args.temperature,
         )
+    # resolve the gray plan early: an explicit --gray-plan wins, else a
+    # --trace file's embedded annotation attaches automatically (the
+    # gray storm replays from the trace file alone)
+    gray_plan_doc = None
+    if args.gray_plan:
+        with open(args.gray_plan) as f:
+            gray_plan_doc = json.load(f)
+    elif args.trace:
+        from attention_tpu.engine.sim import load_gray_plan
+
+        gray_plan_doc = load_gray_plan(args.trace)
     if args.trace_out:
         from attention_tpu.engine import save_trace
 
-        save_trace(args.trace_out, trace)
+        save_trace(args.trace_out, trace, gray_plan=gray_plan_doc)
         _logger.info("wrote trace: %s", args.trace_out)
 
     config = EngineConfig(
@@ -267,7 +283,11 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
               "together", file=sys.stderr)
         return 2
     if args.replicas:
-        return _serve_sim_frontend(args, model, params, config, trace)
+        return _serve_sim_frontend(args, model, params, config, trace,
+                                   gray_plan=gray_plan_doc)
+    if gray_plan_doc is not None:
+        _logger.info("gray plan ignored on the single-engine path "
+                     "(gray failures need --replicas)")
 
     engine = ServingEngine(model, params, config)
     if args.snapshot_dir is not None:
@@ -317,22 +337,27 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
 
 
 def _serve_sim_frontend(args: argparse.Namespace, model, params,
-                        config, trace) -> int:
+                        config, trace, *,
+                        gray_plan: dict | None = None) -> int:
     """serve-sim through the resilient multi-replica front end
     (attention_tpu.frontend): N engine replicas, deadlines, retry,
-    shedding, optional chaos storm plan."""
+    shedding, optional chaos storm and gray-failure plans."""
     import json
 
     from attention_tpu.frontend import (
         FrontendConfig,
         RetryPolicy,
         ServingFrontend,
+        SupervisorPolicy,
         replay_frontend,
     )
 
     ttl = None
     if args.deadline_ms is not None:
         ttl = max(1, int(round(args.deadline_ms / args.tick_ms)))
+    supervisor = (SupervisorPolicy(suspect_after=args.suspect_after)
+                  if args.suspect_after is not None
+                  else SupervisorPolicy())
     frontend = ServingFrontend(
         model, params, config,
         FrontendConfig(
@@ -341,19 +366,27 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
             default_ttl_ticks=ttl,
             snapshot_dir=args.snapshot_dir,
             snapshot_every=args.snapshot_every,
+            supervisor=supervisor,
+            standbys=args.standbys,
         ),
     )
-    if args.chaos_plan:
+    if args.chaos_plan or gray_plan is not None:
         from attention_tpu.chaos.faults import (
             FaultPlan,
             FrontendFaultInjector,
         )
 
-        with open(args.chaos_plan) as f:
-            plan = FaultPlan.from_json(f.read())
-        FrontendFaultInjector(frontend, plan)
-        _logger.info("attached chaos plan: %s (%d events)",
-                     args.chaos_plan, len(plan.events))
+        if args.chaos_plan:
+            with open(args.chaos_plan) as f:
+                plan = FaultPlan.from_json(f.read())
+            FrontendFaultInjector(frontend, plan)
+            _logger.info("attached chaos plan: %s (%d events)",
+                         args.chaos_plan, len(plan.events))
+        if gray_plan is not None:
+            plan = FaultPlan.from_json(json.dumps(gray_plan))
+            FrontendFaultInjector(frontend, plan)
+            _logger.info("attached gray plan (%d events)",
+                         len(plan.events))
     summary, outputs = replay_frontend(frontend, trace,
                                        max_ticks=args.max_steps)
     record = frontend.to_run_record(
@@ -478,6 +511,20 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--chaos-plan", default=None,
                     help="frontend fault-plan JSON (chaos.faults."
                          "FaultPlan) to attach to the run")
+    # gray-failure supervision (attention_tpu.frontend.supervisor)
+    ss.add_argument("--standbys", type=int, default=0,
+                    help="warm spare replicas promoted on a DEAD "
+                         "supervisor verdict (front-end path only)")
+    ss.add_argument("--suspect-after", type=int, default=None,
+                    help="supervisor hysteresis: consecutive bad ticks "
+                         "before HEALTHY -> SUSPECT (default: policy "
+                         "default)")
+    ss.add_argument("--gray-plan", default=None,
+                    help="gray-failure fault-plan JSON (slow_step/"
+                         "flaky_step/stall/nan windows) to attach; a "
+                         "--trace file's embedded gray_plan annotation "
+                         "attaches automatically, and --trace-out "
+                         "embeds the active plan")
     # crash-consistent durability (attention_tpu.engine.snapshot)
     ss.add_argument("--snapshot-dir", default=None,
                     help="persist checksummed engine snapshots + "

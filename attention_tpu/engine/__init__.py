@@ -38,6 +38,7 @@ from attention_tpu.engine.errors import (  # noqa: F401
     RequestShedError,
     SnapshotCorruptError,
     SnapshotError,
+    StepInterruptedError,
 )
 from attention_tpu.engine.journal import (  # noqa: F401
     Journal,

@@ -53,6 +53,13 @@ _CANCELLED = obs.counter("engine.requests.cancelled",
 _TIMED_OUT = obs.counter("engine.requests.timed_out",
                          "requests expired by the deadline sweep")
 
+#: consecutive non-finite-logits steps a request is held back before
+#: the finite guard gives up and samples anyway — must exceed any
+#: transient nan-injector window (random_gray_plan caps at 5 steps) so
+#: gray storms keep token parity, while permanently NaN-corrupted KV
+#: pages still terminate instead of wedging the step loop
+_NONFINITE_SKIP_LIMIT = 8
+
 
 class StepLimitExceededError(RuntimeError):
     """`run(max_steps=...)` hit its cap before the queue drained.
@@ -164,6 +171,24 @@ class ServingEngine:
         self._finished_in_step = 0
         self._rng_keys: dict[str, jax.Array] = {}
         self._wall: dict[str, dict[str, float]] = {}
+        # health signals the replica supervisor reads (frontend/
+        # supervisor.py).  ``last_step_virtual_cost`` is the seeded
+        # virtual duration of the most recent step — 1.0 unless a
+        # chaos slow-step injector inflates it — so slowness detection
+        # stays deterministic where real wall time (StepMetrics.wall_s)
+        # cannot.  ``nonfinite_events`` counts logits rows the finite
+        # guard rejected before sampling.
+        self.last_step_virtual_cost = 1.0
+        self.nonfinite_events = 0
+        # consecutive finite-guard skips per request: a TRANSIENT
+        # non-finite window (the chaos nan injector poisons returned
+        # logits for a few steps) must never emit, but PERMANENTLY
+        # poisoned logits (NaN-corrupted KV pages — the chaos
+        # ``corrupt`` fault) would livelock the step loop if held back
+        # forever; past the limit the request falls through to the
+        # documented garbage-but-terminating contract (the checkers
+        # exclude corrupted targets from parity)
+        self._nonfinite_skips: dict[str, int] = {}
         # write-ahead log between snapshots; attached by SnapshotManager
         # (engine/snapshot.py), None when durability is off
         self.journal: Any = None
@@ -351,6 +376,7 @@ class ServingEngine:
         the paged kernels, stream out sampled tokens."""
         t0 = time.perf_counter()
         self._finished_in_step = 0
+        self.last_step_virtual_cost = 1.0
         with obs.span("engine.step"):
             timed_out = self._expire_deadlines()
             sched = self.scheduler.schedule(self._step)
@@ -426,6 +452,8 @@ class ServingEngine:
             / self.pool.num_pages,
             "cached_pages": self.allocator.cached_pages,
             "preemptions": self.scheduler.num_preemptions,
+            "nonfinite_events": self.nonfinite_events,
+            "step_virtual_cost": self.last_step_virtual_cost,
         }
 
     def drain(self, *, max_steps: int | None = None) -> dict[str, Any]:
@@ -471,6 +499,22 @@ class ServingEngine:
             tables[i, : len(req.pages)] = req.pages
         logits = self._apply(tokens, tables, lens)
         for i, req in enumerate(reqs):
+            if not np.isfinite(logits[i, 0]).all():
+                # poisoned logits must never reach sampling: a garbage
+                # token would break parity with the fault-free run.
+                # Un-feed the pending token (its KV slot is simply
+                # overwritten on retry) so the request makes no
+                # progress this step, and count the event — the
+                # replica supervisor's NaN signal.  Bounded: see
+                # _NONFINITE_SKIP_LIMIT.
+                self.nonfinite_events += 1
+                skips = self._nonfinite_skips.get(req.request_id, 0) + 1
+                self._nonfinite_skips[req.request_id] = skips
+                if skips <= _NONFINITE_SKIP_LIMIT:
+                    req.pending_token = req.tokens.pop()
+                    continue
+            else:
+                self._nonfinite_skips.pop(req.request_id, None)
             req.computed_tokens = len(req.tokens)
             self._emit(req, self._sample(req, logits[i, 0]))
 
@@ -487,6 +531,18 @@ class ServingEngine:
             lens[i] = c
         logits = self._apply(tokens, tables, lens)
         for i, (req, real) in enumerate(items):
+            if (req.computed_tokens + real >= len(req.tokens)
+                    and not req.output_tokens
+                    and not np.isfinite(logits[i, real - 1]).all()):
+                # the final chunk samples the first token; with
+                # non-finite logits, skip the whole chunk (the KV it
+                # wrote is recomputed in place next step) rather than
+                # emit garbage.  Bounded: see _NONFINITE_SKIP_LIMIT.
+                self.nonfinite_events += 1
+                skips = self._nonfinite_skips.get(req.request_id, 0) + 1
+                self._nonfinite_skips[req.request_id] = skips
+                if skips <= _NONFINITE_SKIP_LIMIT:
+                    continue
             req.computed_tokens += real
             if req.computed_tokens < len(req.tokens):
                 continue  # more chunks to go
@@ -541,6 +597,7 @@ class ServingEngine:
     def _finish(self, req: Request) -> None:
         req.transition(RequestState.FINISHED)
         req.finish_step = self._step
+        self._nonfinite_skips.pop(req.request_id, None)
         if self.journal is not None:
             self.journal.record_finish(req.request_id)
         if req.pages:

@@ -33,6 +33,13 @@ The snapshot/journal subsystem (PR 9) adds the durability half:
   Distinct from :class:`ReplicaDeadError`, which covers work routed
   *at* a dead replica.
 
+The gray-failure work (ISSUE 10) adds the transient half:
+
+* :class:`StepInterruptedError` — one engine step aborted before any
+  state mutation (an intermittent, non-fail-stop fault).  The front
+  end records it on the replica's error streak and retries next tick;
+  the `ReplicaSupervisor` escalates only when the streak persists.
+
 All subclass RuntimeError, the `OutOfPagesError` lineage — the
 ATP401 contract (attention_tpu/analysis/errors.py) extends over
 ``frontend/`` so generic raises cannot creep back in.
@@ -65,6 +72,18 @@ class RequestShedError(RuntimeError):
     always deliberate policy, recorded on the request's ``error``
     field so clients can distinguish "shed, retry later" from a
     serving bug."""
+
+
+class StepInterruptedError(RuntimeError):
+    """An engine step aborted before mutating any request state.
+
+    The gray-failure chaos injector raises this from a wrapped
+    ``engine.step`` BEFORE the inner step runs, modelling a transient
+    host-side fault (driver hiccup, runtime retry) that costs a
+    scheduler round but corrupts nothing.  The front end notes it on
+    the replica's error streak — the `ReplicaSupervisor`'s
+    consecutive-typed-step-errors signal — and simply tries again next
+    tick; it is never a reason to cancel or requeue work."""
 
 
 class SnapshotError(RuntimeError):

@@ -51,7 +51,10 @@ import zlib
 
 import jax
 
-from attention_tpu.engine.errors import DeadlineExceededError
+from attention_tpu.engine.errors import (
+    DeadlineExceededError,
+    SnapshotError,
+)
 from attention_tpu.engine.request import RequestState, SamplingParams
 
 JOURNAL_SUFFIX = ".wal"
@@ -96,9 +99,13 @@ class Journal:
 
     The engine calls the ``record_*`` hooks (guarded on
     ``engine.journal is not None``, so the no-durability path costs one
-    attribute check per event).  Each append opens/writes/closes — no
-    long-lived handle to leak through a replica kill, and the only
-    torn state a crash can leave is the final line.
+    attribute check per event).  Appends go through ONE long-lived
+    ``"ab"`` handle, flushed per record so readers of the path always
+    see every completed line; the only torn state a crash can leave is
+    the final line.  The handle's lifetime is explicit: ``close()`` is
+    called by `SnapshotManager` on journal rotation and on ``detach``
+    (which `ReplicaHandle.kill` invokes), so a kill/restart storm
+    leaks neither file descriptors nor ResourceWarnings.
     """
 
     def __init__(self, path: str, *, snapshot_step: int):
@@ -126,10 +133,30 @@ class Journal:
                 pass
             raise
         self.records_written = 1
+        # O_APPEND handle: a concurrent truncate (the chaos
+        # journal_tear point) cannot strand the write position
+        self._file = open(path, "ab")
+
+    @property
+    def closed(self) -> bool:
+        return self._file is None
+
+    def close(self) -> None:
+        """Release the append handle.  Idempotent; appending to a
+        closed journal is a typed error (the engine's ``journal``
+        reference must be dropped alongside)."""
+        if self._file is not None:
+            self._file.close()
+            self._file = None
 
     def _append(self, rec: dict) -> None:
-        with open(self.path, "ab") as f:
-            f.write(_record_line(rec))
+        if self._file is None:
+            raise SnapshotError(
+                f"journal {self.path} is closed (detached manager or "
+                "rotated-out file); records must not land here"
+            )
+        self._file.write(_record_line(rec))
+        self._file.flush()
         self.records_written += 1
 
     def record_admit(self, req) -> None:

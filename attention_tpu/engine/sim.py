@@ -144,9 +144,17 @@ def bursty_trace(
     return trace
 
 
-def save_trace(path: str, trace: list[dict[str, Any]]) -> None:
+def save_trace(path: str, trace: list[dict[str, Any]], *,
+               gray_plan: dict[str, Any] | None = None) -> None:
+    """Persist a trace; ``gray_plan`` (the `chaos.FaultPlan` JSON dict)
+    rides along as a top-level annotation so a gray storm replays
+    byte-identically from the trace file ALONE — no side-channel plan
+    file to lose."""
+    doc: dict[str, Any] = {"requests": trace}
+    if gray_plan is not None:
+        doc["gray_plan"] = gray_plan
     with open(path, "w") as f:
-        json.dump({"requests": trace}, f, indent=1)
+        json.dump(doc, f, indent=1)
         f.write("\n")
 
 
@@ -160,6 +168,21 @@ def load_trace(path: str) -> list[dict[str, Any]]:
         if "prompt" not in r or not r["prompt"]:
             raise ValueError(f"{path}: request {r.get('id')} has no prompt")
     return reqs
+
+
+def load_gray_plan(path: str) -> dict[str, Any] | None:
+    """The trace file's embedded gray-plan annotation (see
+    `save_trace`), or None.  Returned as the raw JSON dict — the
+    chaos layer (`chaos.FaultPlan.from_json`) owns the typed form, and
+    this module must not import it."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        return None
+    plan = data.get("gray_plan")
+    if plan is not None and not isinstance(plan, dict):
+        raise ValueError(f"{path}: gray_plan must be a JSON object")
+    return plan
 
 
 def sampling_of(entry: dict[str, Any]) -> SamplingParams:

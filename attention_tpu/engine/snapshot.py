@@ -662,7 +662,10 @@ class SnapshotManager:
         # rotate AFTER the snapshot lands (the genesis call creates the
         # incarnation's first journal): the outgoing journal file stays
         # complete on disk, so replay can chain from an older snapshot
-        # if this one is later damaged
+        # if this one is later damaged.  Close the outgoing handle —
+        # the file is immutable history from here on.
+        if engine.journal is not None:
+            engine.journal.close()
         engine.journal = Journal(journal_path(self.directory, step),
                                  snapshot_step=step)
         self.saves += 1
@@ -688,6 +691,12 @@ class SnapshotManager:
                     pass
 
     def detach(self) -> None:
-        """Unhook from the engine (journal stops, step unwrapped)."""
+        """Unhook from the engine: step unwrapped, the journal's
+        append handle closed and dropped.  `ReplicaHandle.kill` calls
+        this so a kill/restart storm cannot leak file descriptors
+        (pinned by the ResourceWarning test in tests/
+        test_supervisor.py).  Idempotent."""
         self.engine.step = self._inner_step
+        if self.engine.journal is not None:
+            self.engine.journal.close()
         self.engine.journal = None

@@ -19,7 +19,10 @@ provide: surviving a replica dying mid-decode.
 
 Modules: `replica` (the fail-stop unit), `routing` (cache-aware
 placement), `backoff` (deterministic retry schedule), `degrade`
-(shedding thresholds + ladder), `frontend` (the tick loop and the
+(shedding thresholds + ladder), `supervisor` (per-tick gray-failure
+detection: HEALTHY -> SUSPECT -> DEGRADED -> DEAD with hysteresis),
+`migrate` (live draining of in-flight requests off a SUSPECT replica,
+token-identical by construction), `frontend` (the tick loop and the
 terminal-state invariant).  Typed failures live in the ENGINE taxonomy
 (`attention_tpu.engine.errors`) so one import site covers both layers.
 """
@@ -42,8 +45,18 @@ from attention_tpu.frontend.frontend import (  # noqa: F401
     ServingFrontend,
     replay_frontend,
 )
+from attention_tpu.frontend.migrate import (  # noqa: F401
+    MigrationRecord,
+    drain_replica,
+)
 from attention_tpu.frontend.replica import ReplicaHandle  # noqa: F401
 from attention_tpu.frontend.routing import (  # noqa: F401
     RouteDecision,
     Router,
+)
+from attention_tpu.frontend.supervisor import (  # noqa: F401
+    ReplicaSupervisor,
+    SupervisorPolicy,
+    SupervisorState,
+    Verdict,
 )

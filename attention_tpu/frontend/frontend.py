@@ -48,6 +48,7 @@ from attention_tpu.engine.errors import (
     DeadlineExceededError,
     ReplicaDeadError,
     RequestShedError,
+    StepInterruptedError,
 )
 from attention_tpu.engine.request import Request, SamplingParams
 from attention_tpu.engine.sim import sampling_of
@@ -59,8 +60,14 @@ from attention_tpu.frontend.degrade import (
     ShedPolicy,
     pool_pressure,
 )
+from attention_tpu.frontend.migrate import MigrationRecord, drain_replica
 from attention_tpu.frontend.replica import ReplicaHandle
 from attention_tpu.frontend.routing import Router
+from attention_tpu.frontend.supervisor import (
+    ReplicaSupervisor,
+    SupervisorPolicy,
+    SupervisorState,
+)
 from attention_tpu.ops.paged import OutOfPagesError
 from attention_tpu.utils.profiling import RunRecord
 
@@ -91,6 +98,8 @@ _R_QUEUE_G = obs.gauge("frontend.replica.queue_depth",
                        "per-replica waiting+running requests")
 _R_UTIL_G = obs.gauge("frontend.replica.page_util",
                       "per-replica page-pool utilization")
+_PROMOTED = obs.counter("frontend.replica.promoted",
+                        "warm standbys promoted on a DEAD verdict")
 
 
 class FrontendRequestState(enum.Enum):
@@ -153,6 +162,9 @@ class FrontendRequest:
 
     state: FrontendRequestState = FrontendRequestState.QUEUED
     tokens: list[int] = dataclasses.field(default_factory=list)
+    #: which replica emitted each token (parallel to ``tokens``) — the
+    #: no-double-serve invariant's evidence trail
+    emitters: list[str] = dataclasses.field(default_factory=list)
     replica_id: str | None = None
     last_replica: str | None = None
     routed_by: str | None = None
@@ -195,11 +207,21 @@ class FrontendConfig:
     # <snapshot_dir>/<replica_id>/ and restart_replica recovers warm
     snapshot_dir: str | None = None
     snapshot_every: int | None = None
+    # proactive failure handling (frontend.supervisor / .migrate):
+    # detection thresholds, plus N spare engine-less handles promoted
+    # warm on a DEAD verdict
+    supervisor: SupervisorPolicy = dataclasses.field(
+        default_factory=SupervisorPolicy)
+    standbys: int = 0
 
     def validate(self) -> None:
         if self.num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {self.num_replicas}"
+            )
+        if self.standbys < 0:
+            raise ValueError(
+                f"standbys must be >= 0, got {self.standbys}"
             )
         if self.stall_ticks < 1:
             raise ValueError(
@@ -222,6 +244,7 @@ class FrontendConfig:
         self.retry.validate()
         self.shed.validate()
         self.degrade.validate()
+        self.supervisor.validate()
 
 
 class ServingFrontend:
@@ -242,24 +265,29 @@ class ServingFrontend:
 
         self.router = Router()
         self.ladder = DegradationLadder(config.degrade)
+        self.supervisor = ReplicaSupervisor(config.supervisor)
         self.replicas = [
-            ReplicaHandle(
-                f"replica-{i}", model, params, engine_config,
-                snapshot_dir=(os.path.join(config.snapshot_dir,
-                                           f"replica-{i}")
-                              if config.snapshot_dir else None),
-                snapshot_every=config.snapshot_every,
-                on_token=self._on_engine_token,
-                on_finish=self._on_engine_finish,
-                on_timeout=self._on_engine_timeout,
-            )
+            self._make_handle(f"replica-{i}")
             for i in range(config.num_replicas)
+        ]
+        #: engine-less spares, promoted (in order) on a DEAD verdict
+        self.standby_pool = [
+            self._make_handle(f"standby-{k}", spare=True)
+            for k in range(config.standbys)
         ]
         self._tick = 0
         self._seq = itertools.count()
         self.requests: dict[str, FrontendRequest] = {}
         self._pending: list[FrontendRequest] = []  # (arrival, seq) order
         self._retry: list[FrontendRequest] = []
+        #: unified append-ordered event log — ("verdict", tick, replica,
+        #: old, new, signals) and ("admit", tick, request, replica) in
+        #: the exact order they happened; the supervisor-consistency
+        #: checker replays it (append order IS the global order, which
+        #: sidesteps within-tick phase ordering entirely)
+        self.events_log: list[tuple] = []
+        #: every drain decision, in order (`frontend.migrate`)
+        self.migrations: list[MigrationRecord] = []
         # deterministic mirrors of the obs counters (telemetry is off
         # by default; the summary must not depend on it)
         self.counts = {
@@ -268,7 +296,29 @@ class ServingFrontend:
             "migrations": 0, "deadline_expired": 0,
             "replica_kills": 0, "replica_restarts": 0,
             "warm_restarts": 0, "warm_adoptions": 0,
+            "live_migrations": 0, "migrations_stranded": 0,
+            "standby_promotions": 0, "supervisor_suspects": 0,
+            "supervisor_degraded": 0, "supervisor_dead": 0,
+            "supervisor_recoveries": 0,
         }
+
+    def _make_handle(self, replica_id: str, *,
+                     spare: bool = False) -> ReplicaHandle:
+        # the token callback closes over the replica id so every
+        # streamed token records WHICH engine emitted it — the
+        # no-double-serve invariant's raw evidence
+        return ReplicaHandle(
+            replica_id, self.model, self.params, self.engine_config,
+            snapshot_dir=(os.path.join(self.config.snapshot_dir,
+                                       replica_id)
+                          if self.config.snapshot_dir else None),
+            snapshot_every=self.config.snapshot_every,
+            on_token=(lambda req, tok, _rid=replica_id:
+                      self._on_engine_token(_rid, req, tok)),
+            on_finish=self._on_engine_finish,
+            on_timeout=self._on_engine_timeout,
+            spare=spare,
+        )
 
     # -- intake -----------------------------------------------------------
 
@@ -342,9 +392,11 @@ class ServingFrontend:
 
     # -- engine callbacks -------------------------------------------------
 
-    def _on_engine_token(self, req: Request, token: int) -> None:
+    def _on_engine_token(self, replica_id: str, req: Request,
+                         token: int) -> None:
         fr = self.requests[req.request_id]
         fr.tokens.append(int(token))
+        fr.emitters.append(replica_id)
         fr.waiting_since = None
         if self.on_token is not None:
             self.on_token(fr, int(token))
@@ -376,6 +428,7 @@ class ServingFrontend:
             self._admit_arrivals(t)
             self._admit_retries(t)
             self._step_replicas(t)
+            self._supervise(t)
             self._migrate_stalled(t)
             self._update_ladder_and_gauges(t)
         self._tick += 1
@@ -446,6 +499,15 @@ class ServingFrontend:
             tick=self._tick,
             warm_from=handle.snapshot_dir if want_warm else None,
         )
+        # fresh engine -> fresh judgement (and the recovery verdict
+        # lands in the event log BEFORE any adoption re-admissions)
+        verdict = self.supervisor.reset(self._tick, replica_id)
+        if verdict is not None:
+            self.events_log.append((
+                "verdict", self._tick, replica_id,
+                verdict.old.value, verdict.new.value,
+                list(verdict.signals)))
+            self.counts["supervisor_recoveries"] += 1
         if mode == "warm":
             self.counts["warm_restarts"] += 1
             self._reconcile_restored(handle)
@@ -485,6 +547,8 @@ class ServingFrontend:
             # deadline in the restarted replica's own step space
             req.deadline_step = handle.local_deadline(fr.deadline)
             self.counts["warm_adoptions"] += 1
+            self.events_log.append(
+                ("admit", t, fr.request_id, handle.replica_id))
 
     # -- internals --------------------------------------------------------
 
@@ -573,11 +637,14 @@ class ServingFrontend:
         decision = self.router.route(
             fr.prompt, self.replicas, session=fr.session,
             exclude=exclude,
+            eligible=self.supervisor.eligible_ids(self.replicas),
         )
         if decision is None:
-            # nothing alive: back off and hope for a restart
+            # nothing admissible (dead, or gated by the supervisor):
+            # back off and hope for a restart or a recovery verdict
             self._requeue(fr, t, ReplicaDeadError(
-                f"no alive replica for {fr.request_id} at tick {t}"
+                f"no alive HEALTHY replica for {fr.request_id} "
+                f"at tick {t}"
             ))
             return
         handle = decision.replica
@@ -606,6 +673,8 @@ class ServingFrontend:
         fr.routed_by = decision.reason
         fr.assigned_tick = t
         fr.waiting_since = None
+        self.events_log.append(
+            ("admit", t, fr.request_id, handle.replica_id))
 
     def _requeue(self, fr: FrontendRequest, t: int,
                  cause: BaseException) -> None:
@@ -646,7 +715,16 @@ class ServingFrontend:
             try:
                 handle.step()
             except OutOfPagesError as e:
+                # capacity failure: relieve AND note it — a replica
+                # that can't step is sick until proven otherwise
+                handle.note_step_error(e)
                 self._relieve_pressure(handle, t, e)
+            except StepInterruptedError as e:
+                # transient, pre-mutation abort: nothing to clean up,
+                # nothing to requeue — just feed the error streak
+                handle.note_step_error(e)
+            else:
+                handle.note_step_ok()
 
     def _relieve_pressure(self, handle: ReplicaHandle, t: int,
                           cause: OutOfPagesError) -> None:
@@ -662,6 +740,96 @@ class ServingFrontend:
         eng.cancel(victim.request_id)
         if fr is not None and fr.state is FrontendRequestState.ASSIGNED:
             self._requeue(fr, t, cause)
+
+    def _supervise(self, t: int) -> None:
+        """Score the fleet, act on the verdicts: drain a replica the
+        moment it turns SUSPECT (and again on DEGRADED — destinations
+        may have freed up), kill + promote a standby on DEAD.  The
+        supervisor judges; this method is the only place that acts."""
+        verdicts = self.supervisor.observe(t, self.replicas)
+        # log EVERY verdict before acting on ANY: observe() moved all
+        # the states atomically, so in append order the tick's state
+        # changes precede the actions they trigger (a drain routed to
+        # a replica whose recovery verdict sits later in the batch
+        # must not read as an admission to a sick replica)
+        for v in verdicts:
+            self.events_log.append((
+                "verdict", t, v.replica_id,
+                v.old.value, v.new.value, list(v.signals)))
+            if v.is_recovery:
+                self.counts["supervisor_recoveries"] += 1
+            elif v.new is SupervisorState.SUSPECT:
+                self.counts["supervisor_suspects"] += 1
+            elif v.new is SupervisorState.DEGRADED:
+                self.counts["supervisor_degraded"] += 1
+            elif v.new is SupervisorState.DEAD:
+                self.counts["supervisor_dead"] += 1
+        for v in verdicts:
+            if v.is_recovery:
+                continue
+            handle = self._handle(v.replica_id)
+            if v.new is SupervisorState.DEAD:
+                if handle is not None and handle.alive:
+                    # gray failure crossed the line: treat it as
+                    # fail-stop (requeues whatever drain left behind)
+                    self.kill_replica(v.replica_id)
+                self._promote_standby(t, handle)
+            elif handle is not None:
+                self.migrations.extend(drain_replica(
+                    self, handle, tick=t,
+                    eligible=self.supervisor.eligible_ids(
+                        self.replicas)))
+
+    def _promote_standby(self, t: int,
+                         failed: ReplicaHandle | None) -> bool:
+        """Replace a DEAD replica with a warm standby: the spare boots
+        from the FAILED replica's snapshot directory (its own manager
+        then starts a fresh incarnation in the spare's directory), so
+        promotion recovers the dead engine's in-flight state just like
+        a warm restart — then reconciliation adopts whatever still
+        matches the streamed prefixes."""
+        if not self.standby_pool:
+            return False
+        spare = self.standby_pool.pop(0)
+        warm_from = failed.snapshot_dir if failed is not None else None
+        mode = spare.restart(tick=t, warm_from=warm_from)
+        self.replicas.append(spare)
+        self.supervisor.reset(t, spare.replica_id)
+        self.counts["standby_promotions"] += 1
+        _PROMOTED.inc()
+        if mode == "warm":
+            self.counts["warm_restarts"] += 1
+            self._reconcile_restored(spare)
+        self._apply_ladder_to(spare)
+        return True
+
+    # -- migration hooks (called by frontend.migrate.drain_replica) -------
+
+    def note_migrated(self, fr: FrontendRequest, dest: ReplicaHandle,
+                      t: int) -> None:
+        """Bookkeeping for one completed cut: the request now lives on
+        ``dest`` and nowhere else."""
+        fr.last_replica = fr.replica_id
+        fr.replica_id = dest.replica_id
+        fr.routed_by = "migrated"
+        fr.assigned_tick = t
+        fr.waiting_since = None
+        self.counts["live_migrations"] += 1
+        self.events_log.append(
+            ("admit", t, fr.request_id, dest.replica_id))
+
+    def note_migration_stranded(self, fr: FrontendRequest) -> None:
+        """No HEALTHY destination: the request stays on the sick
+        replica (which keeps serving what it already holds)."""
+        self.counts["migrations_stranded"] += 1
+
+    def note_migration_timeout(self, fr: FrontendRequest,
+                               e: DeadlineExceededError) -> None:
+        """The cut found the request already past its deadline in the
+        destination's clock; finalize truthfully."""
+        self.counts["deadline_expired"] += 1
+        _DEADLINE_EXPIRED.inc()
+        self._finalize(fr, FrontendRequestState.TIMED_OUT, error=e)
 
     def _migrate_stalled(self, t: int) -> None:
         """Admission-stall detection: a request that has sat in a
@@ -759,6 +927,11 @@ class ServingFrontend:
                 fin_cached / fin_prompt, 4) if fin_prompt else 0.0,
             "replica_deaths": sum(h.deaths for h in self.replicas),
             "alive_replicas": sum(1 for h in self.replicas if h.alive),
+            "warm_fallbacks": sum(
+                h.warm_fallbacks
+                for h in (*self.replicas, *self.standby_pool)),
+            "standbys_remaining": len(self.standby_pool),
+            "supervisor_states": self.supervisor.states(),
             "degrade_level": self.ladder.level,
             "degrade_step_downs": self.ladder.step_downs,
             "degrade_recoveries": self.ladder.recoveries,

@@ -1,0 +1,139 @@
+"""Live draining migration: move in-flight requests off a sick replica.
+
+When the `ReplicaSupervisor` turns a replica SUSPECT the front end
+does not wait for it to die — it *drains* it: every in-flight request
+is serialized in the PR 9 per-request snapshot section format
+(`engine.snapshot._request_to_dict` — the exact dict a crash snapshot
+would have persisted), cancelled on the source engine, and re-admitted
+on a HEALTHY replica through `resume_request`.  Because the resume
+path feeds back every streamed token and rebuilds the RNG chain
+arithmetically (one split per sampled token), a migrated stream is
+token-identical to a fault-free run — migration costs a re-prefill,
+never a token.
+
+The cut is strict: the source-side cancel happens BEFORE the
+destination admission, so at no point can two engines hold the same
+live request (the no-double-serve invariant in `chaos.invariants`
+checks the emitted-token attribution against the recorded cuts).
+Requests with no HEALTHY destination are left in place — a DEGRADED
+replica stops taking new admissions but keeps serving what migration
+could not move, which beats shedding it.
+
+Determinism: iteration is in engine-seq order, destination choice goes
+through the front end's seeded router, and every record carries the
+tick — same seed, same storm, same migration sequence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Any
+
+from attention_tpu import obs
+from attention_tpu.engine.errors import DeadlineExceededError
+from attention_tpu.engine.request import SamplingParams
+from attention_tpu.engine.snapshot import _request_to_dict
+from attention_tpu.frontend.replica import ReplicaHandle
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard
+    from attention_tpu.frontend.frontend import ServingFrontend
+
+_MIGRATED = obs.counter("frontend.migrate.moved",
+                        "requests drained off a SUSPECT replica")
+_TOKENS = obs.counter("frontend.migrate.tokens_preserved",
+                      "already-streamed tokens carried across a cut")
+_STRANDED = obs.counter("frontend.migrate.stranded",
+                        "drain candidates with no HEALTHY destination")
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationRecord:
+    """One drain decision (kept on the front end for the chaos
+    checkers; ``record`` is the serialized PR 9 request section)."""
+
+    tick: int
+    request_id: str
+    source: str
+    dest: str | None          # None = stranded (left on the source)
+    tokens_at_cut: int        # streamed tokens at the moment of the cut
+    record: dict[str, Any]
+
+
+def drain_replica(frontend: "ServingFrontend", handle: ReplicaHandle,
+                  *, tick: int,
+                  eligible: set[str]) -> list[MigrationRecord]:
+    """Drain every front-end-owned in-flight request off ``handle``.
+
+    ``eligible`` is the supervisor's HEALTHY set; the source is never
+    a destination.  Returns one record per candidate, moved or not.
+    """
+    records: list[MigrationRecord] = []
+    if not handle.alive:
+        return records
+    eng = handle.engine
+    dest_ids = set(eligible) - {handle.replica_id}
+    live = sorted(
+        [("waiting", r) for r in eng.scheduler.waiting]
+        + [("running", r) for r in eng.scheduler.running],
+        key=lambda item: item[1].seq,
+    )
+    from attention_tpu.frontend.frontend import FrontendRequestState
+
+    for queue, req in live:
+        fr = frontend.requests.get(req.request_id)
+        if (fr is None
+                or fr.state is not FrontendRequestState.ASSIGNED
+                or fr.replica_id != handle.replica_id):
+            continue
+        rec = _request_to_dict(req, queue)
+        decision = frontend.router.route(
+            fr.prompt, frontend.replicas, session=fr.session,
+            exclude=handle.replica_id, eligible=dest_ids,
+        ) if dest_ids else None
+        if decision is None:
+            _STRANDED.inc()
+            frontend.note_migration_stranded(fr)
+            records.append(MigrationRecord(
+                tick=tick, request_id=fr.request_id,
+                source=handle.replica_id, dest=None,
+                tokens_at_cut=len(fr.tokens), record=rec))
+            continue
+        dest = decision.replica
+        # THE CUT: source first, destination second — between the two
+        # calls the request lives only in front-end bookkeeping, and
+        # after them exactly one engine holds it
+        eng.cancel(req.request_id)
+        outs = [int(t) for t in rec["output_tokens"]]
+        sampling = SamplingParams(**rec["sampling"])
+        deadline_step = dest.local_deadline(fr.deadline)
+        try:
+            if outs:
+                dest.engine.resume_request(
+                    rec["prompt"], sampling,
+                    request_id=fr.request_id, output_tokens=outs,
+                    deadline_step=deadline_step,
+                )
+            else:
+                dest.engine.add_request(
+                    rec["prompt"], sampling,
+                    request_id=fr.request_id,
+                    deadline_step=deadline_step,
+                )
+        except DeadlineExceededError as e:
+            # expired relative to the destination clock: the request
+            # was already doomed; record the terminal truthfully
+            frontend.note_migration_timeout(fr, e)
+            records.append(MigrationRecord(
+                tick=tick, request_id=fr.request_id,
+                source=handle.replica_id, dest=None,
+                tokens_at_cut=len(fr.tokens), record=rec))
+            continue
+        frontend.note_migrated(fr, dest, tick)
+        _MIGRATED.inc()
+        if outs:
+            _TOKENS.inc(len(outs))
+        records.append(MigrationRecord(
+            tick=tick, request_id=fr.request_id,
+            source=handle.replica_id, dest=dest.replica_id,
+            tokens_at_cut=len(fr.tokens), record=rec))
+    return records

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+from attention_tpu import obs
 from attention_tpu.engine.engine import EngineConfig, ServingEngine
 from attention_tpu.engine.errors import (
     ReplicaDeadError,
@@ -42,6 +43,11 @@ from attention_tpu.engine.errors import (
 from attention_tpu.engine.metrics import StepMetrics
 from attention_tpu.engine.request import Request
 from attention_tpu.engine.snapshot import SnapshotManager, recover_engine
+
+_WARM_FALLBACK = obs.counter(
+    "frontend.replica.warm_fallbacks",
+    "warm restarts that degraded to the cold path (typed cause kept "
+    "on the handle)")
 
 
 class ReplicaHandle:
@@ -53,7 +59,8 @@ class ReplicaHandle:
                  snapshot_every: int | None = None,
                  on_token: Callable[[Request, int], None] | None = None,
                  on_finish: Callable[[Request], None] | None = None,
-                 on_timeout: Callable[[Request], None] | None = None):
+                 on_timeout: Callable[[Request], None] | None = None,
+                 spare: bool = False):
         self.replica_id = replica_id
         self.model = model
         self.params = params
@@ -64,9 +71,20 @@ class ReplicaHandle:
         self.snapshot_every = snapshot_every
         #: "warm" | "cold" | None — how the last restart came back
         self.last_restart_mode: str | None = None
+        #: why the last warm restart fell back cold (None after a
+        #: successful warm restart); ``warm_fallbacks`` counts them
+        self.last_warm_fallback: SnapshotError | None = None
+        self.warm_fallbacks = 0
+        #: consecutive typed step errors — the supervisor's error
+        #: signal; the front end calls note_step_error/note_step_ok
+        self.step_error_streak = 0
+        self.last_step_error: BaseException | None = None
         self._manager: SnapshotManager | None = None
         self._callbacks = (on_token, on_finish, on_timeout)
-        self._engine: ServingEngine | None = self._fresh_engine()
+        # a SPARE (warm standby) is born without an engine — it costs
+        # nothing until a DEAD verdict promotes it via restart()
+        self._engine: ServingEngine | None = (
+            None if spare else self._fresh_engine())
 
     def _fresh_engine(self) -> ServingEngine:
         on_token, on_finish, on_timeout = self._callbacks
@@ -104,9 +122,15 @@ class ReplicaHandle:
         disk survive by construction: that is the durability contract
         ``restart(warm_from=...)`` recovers from."""
         if self._engine is not None:
+            if self._manager is not None:
+                # release the journal's append handle before dropping
+                # the references — a kill must not leak an open fd
+                self._manager.detach()
             self._engine = None
             self._manager = None
             self.deaths += 1
+            self.step_error_streak = 0
+            self.last_step_error = None
 
     def restart(self, *, tick: int,
                 warm_from: str | None = None) -> str:
@@ -138,8 +162,14 @@ class ReplicaHandle:
                     on_token=on_token, on_finish=on_finish,
                     on_timeout=on_timeout,
                 )
-            except SnapshotError:
+            except SnapshotError as e:
+                # keep the typed cause: "why did this restart cost a
+                # full re-prefill" is the first question an operator
+                # asks, and the summary surfaces the count
                 engine = None
+                self.last_warm_fallback = e
+                self.warm_fallbacks += 1
+                _WARM_FALLBACK.inc()
             if engine is not None:
                 # the restored engine keeps its own step counter, so
                 # anchor the clock translation at its restored step
@@ -147,6 +177,7 @@ class ReplicaHandle:
                 self._engine = engine
                 self._attach_snapshots(engine)
                 self.last_restart_mode = "warm"
+                self.last_warm_fallback = None
                 return "warm"
         self.start_tick = tick
         self._engine = self._fresh_engine()
@@ -158,6 +189,14 @@ class ReplicaHandle:
     def step(self) -> StepMetrics:
         """One engine step (raises `ReplicaDeadError` when dead)."""
         return self.engine.step()
+
+    def note_step_error(self, exc: BaseException) -> None:
+        """Record one typed step failure (supervisor error signal)."""
+        self.step_error_streak += 1
+        self.last_step_error = exc
+
+    def note_step_ok(self) -> None:
+        self.step_error_streak = 0
 
     def has_work(self) -> bool:
         return self._engine is not None \

@@ -21,7 +21,11 @@ strict deterministic priority order:
 
 ``exclude`` lets the retry path requeue AWAY from the replica that
 just failed a request (falling back to it only when nothing else is
-alive).
+alive).  ``eligible`` is the supervisor's admission gate: when given,
+only the named replicas are considered AT ALL — a SUSPECT/DEGRADED/
+DEAD replica must never receive a new admission after its verdict
+tick (the supervisor-consistency invariant), so unlike ``exclude``
+there is no last-resort fallback through it.
 """
 
 from __future__ import annotations
@@ -63,13 +67,19 @@ class Router:
     def route(self, prompt: Sequence[int],
               replicas: Sequence[ReplicaHandle], *,
               session: str | None = None,
-              exclude: str | None = None) -> RouteDecision | None:
+              exclude: str | None = None,
+              eligible: frozenset[str] | set[str] | None = None,
+              ) -> RouteDecision | None:
         """Pick a replica for ``prompt`` (None when nothing is alive).
 
         ``exclude`` names a replica to avoid (the one that just failed
         this request); it is only used as a last resort when it is the
-        sole survivor."""
-        alive = [r for r in replicas if r.alive]
+        sole survivor.  ``eligible``, when given, is a hard admission
+        gate (no fallback through it): replicas outside the set are
+        invisible to this decision."""
+        alive = [r for r in replicas
+                 if r.alive and (eligible is None
+                                 or r.replica_id in eligible)]
         if not alive:
             return None
         preferred = [r for r in alive if r.replica_id != exclude] or alive

@@ -1,0 +1,304 @@
+"""`ReplicaSupervisor`: deterministic gray-failure detection.
+
+PR 6's front end only learns about a sick replica when it is already a
+corpse (`ReplicaDeadError` on touch).  Real replicas rarely die that
+politely — they get *slow*, they error *intermittently*, they *stall*
+silently, their numerics go non-finite — and a front end that waits
+for fail-stop serves garbage latency in the meantime.  The supervisor
+closes that gap: every tick it scores each replica from four signals
+the stack already emits, and drives a per-replica state machine
+
+    HEALTHY ──bad──> SUSPECT ──bad──> DEGRADED ──bad──> DEAD
+       ▲               │                 │
+       └──recover──────┘ <───recover─────┘
+
+with hysteresis on both edges (``*_after`` consecutive bad ticks to
+step down, ``recover_after`` consecutive clean ticks to step back up
+ONE level), so a single hiccup never triggers a migration and a
+genuinely sick replica cannot flap back to HEALTHY on one good tick.
+
+Signals (all host-side, all deterministic under the seeded virtual
+clock — no wall time anywhere):
+
+* **slow step** — per-replica EWMA of the engine's *virtual* step cost
+  (`ServingEngine.last_step_virtual_cost`; 1.0 unless a chaos
+  slow-step injector inflates it) at least ``slow_factor`` × the fleet
+  median.  Real ``StepMetrics.wall_s`` is deliberately NOT used: it
+  would make verdicts nondeterministic.
+* **error streak** — ``ReplicaHandle.step_error_streak`` (consecutive
+  typed step errors noted by the front end) ≥ ``error_streak``.
+* **stall** — the engine's step counter unchanged for ``stall_ticks``
+  consecutive observations.  The front end steps every alive replica
+  every tick, so an idle-but-healthy engine still advances; a frozen
+  counter means the step is being swallowed.
+* **non-finite logits** — ``ServingEngine.nonfinite_events`` grew
+  since the last observation (the engine's finite guard rejected a
+  logits row before sampling).
+
+The supervisor only *judges*; the front end *acts* on the verdicts it
+returns (migrate on SUSPECT, bar admissions from anything non-HEALTHY,
+kill + promote a standby on DEAD).  A fail-stop kill shows up here as
+an immediate DEAD verdict (signal ``fail_stop``) so standby promotion
+covers both gray and fail-stop deaths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence
+
+from attention_tpu import obs
+from attention_tpu.frontend.replica import ReplicaHandle
+
+_VERDICTS = obs.counter("frontend.supervisor.verdicts",
+                        "replica state-machine transitions")
+_SIGNALS = obs.counter("frontend.supervisor.signals",
+                       "bad-tick signals observed per kind")
+_STATE_G = obs.gauge("frontend.supervisor.state",
+                     "per-replica supervisor state (0=healthy..3=dead)")
+
+
+class SupervisorState(enum.Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    DEGRADED = "degraded"
+    DEAD = "dead"
+
+
+#: severity order, used for the gauge and the one-level recovery step
+_SEVERITY = {
+    SupervisorState.HEALTHY: 0,
+    SupervisorState.SUSPECT: 1,
+    SupervisorState.DEGRADED: 2,
+    SupervisorState.DEAD: 3,
+}
+
+#: recovery steps UP one level at a time (DEAD only leaves via restart)
+_RECOVER_TO = {
+    SupervisorState.SUSPECT: SupervisorState.HEALTHY,
+    SupervisorState.DEGRADED: SupervisorState.SUSPECT,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorPolicy:
+    """Detection thresholds; every time-like field is in ticks."""
+
+    suspect_after: int = 2    # consecutive bad ticks HEALTHY -> SUSPECT
+    degrade_after: int = 2    # further bad ticks SUSPECT -> DEGRADED
+    dead_after: int = 3       # further bad ticks DEGRADED -> DEAD
+    recover_after: int = 3    # consecutive clean ticks to step back up
+    slow_factor: float = 3.0  # EWMA >= factor * fleet median -> slow
+    ewma_alpha: float = 0.5   # virtual-step-cost EWMA weight
+    stall_ticks: int = 3      # frozen step counter for this long
+    error_streak: int = 2     # consecutive typed step errors
+
+    def validate(self) -> None:
+        if min(self.suspect_after, self.degrade_after, self.dead_after,
+               self.recover_after, self.stall_ticks,
+               self.error_streak) < 1:
+            raise ValueError(
+                "supervisor thresholds (suspect_after, degrade_after, "
+                "dead_after, recover_after, stall_ticks, error_streak) "
+                "must all be >= 1"
+            )
+        if self.slow_factor <= 1.0:
+            raise ValueError(
+                f"slow_factor must be > 1 (a replica at the fleet "
+                f"median is not slow), got {self.slow_factor}"
+            )
+        if not (0.0 < self.ewma_alpha <= 1.0):
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {self.ewma_alpha}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One state-machine transition, as the front end receives it."""
+
+    tick: int
+    replica_id: str
+    old: SupervisorState
+    new: SupervisorState
+    signals: tuple[str, ...]  # the bad signals active at the verdict
+
+    @property
+    def is_recovery(self) -> bool:
+        return _SEVERITY[self.new] < _SEVERITY[self.old]
+
+
+class _Track:
+    """Per-replica detection state (plain mutable bag)."""
+
+    __slots__ = ("state", "ewma", "last_step", "stall_count",
+                 "last_nonfinite", "bad_streak", "ok_streak")
+
+    def __init__(self):
+        self.state = SupervisorState.HEALTHY
+        self.ewma = 1.0
+        self.last_step: int | None = None
+        self.stall_count = 0
+        self.last_nonfinite = 0
+        self.bad_streak = 0
+        self.ok_streak = 0
+
+
+class ReplicaSupervisor:
+    """Per-tick failure detector over a fleet of `ReplicaHandle`s.
+
+    Pure judgement: ``observe`` returns the tick's verdicts and the
+    caller (the front end) performs migration / admission-gating /
+    promotion.  Everything is integer-and-float arithmetic over
+    deterministic inputs, so same seed -> same verdict sequence."""
+
+    def __init__(self, policy: SupervisorPolicy | None = None):
+        self.policy = policy or SupervisorPolicy()
+        self.policy.validate()
+        self._tracks: dict[str, _Track] = {}
+        #: every transition ever issued, in order (chaos checkers read
+        #: the front end's unified event log; this is the local copy)
+        self.history: list[Verdict] = []
+
+    # -- state access ------------------------------------------------------
+
+    def state(self, replica_id: str) -> SupervisorState:
+        track = self._tracks.get(replica_id)
+        return track.state if track is not None else \
+            SupervisorState.HEALTHY
+
+    def states(self) -> dict[str, str]:
+        return {rid: t.state.value
+                for rid, t in sorted(self._tracks.items())}
+
+    def eligible_ids(self, replicas: Sequence[ReplicaHandle]
+                     ) -> set[str]:
+        """Replicas new admissions may route to: alive AND HEALTHY."""
+        return {h.replica_id for h in replicas
+                if h.alive
+                and self.state(h.replica_id) is SupervisorState.HEALTHY}
+
+    def _track(self, replica_id: str) -> _Track:
+        track = self._tracks.get(replica_id)
+        if track is None:
+            track = self._tracks[replica_id] = _Track()
+        return track
+
+    def reset(self, tick: int, replica_id: str) -> Verdict | None:
+        """A replica came back (restart or standby promotion): fresh
+        engine, fresh judgement.  Returns the recovery verdict when
+        the tracked state actually changes."""
+        track = self._track(replica_id)
+        old = track.state
+        self._tracks[replica_id] = _Track()
+        if old is SupervisorState.HEALTHY:
+            return None
+        verdict = Verdict(tick=tick, replica_id=replica_id, old=old,
+                          new=SupervisorState.HEALTHY,
+                          signals=("restart",))
+        self.history.append(verdict)
+        _VERDICTS.inc(state="healthy")
+        return verdict
+
+    # -- the per-tick judgement --------------------------------------------
+
+    def _signals_for(self, handle: ReplicaHandle, track: _Track,
+                     fleet_median: float) -> tuple[str, ...]:
+        p = self.policy
+        engine = handle.engine
+        signals = []
+        if (fleet_median > 0.0
+                and track.ewma >= p.slow_factor * fleet_median):
+            signals.append("slow_step")
+        if handle.step_error_streak >= p.error_streak:
+            signals.append("error_streak")
+        cur = engine.current_step
+        if track.last_step is not None and cur == track.last_step:
+            track.stall_count += 1
+        else:
+            track.stall_count = 0
+        track.last_step = cur
+        if track.stall_count >= p.stall_ticks:
+            signals.append("stall")
+        if engine.nonfinite_events > track.last_nonfinite:
+            signals.append("nonfinite_logits")
+        track.last_nonfinite = engine.nonfinite_events
+        return tuple(signals)
+
+    def observe(self, tick: int,
+                replicas: Sequence[ReplicaHandle]) -> list[Verdict]:
+        """Score every replica once; returns this tick's transitions
+        in replica order."""
+        p = self.policy
+        alive = [h for h in replicas if h.alive]
+        # fleet view first: EWMA update for everyone, then the median
+        # the slow signal compares against (lower median — with two
+        # replicas, one slow outlier must not drag the baseline up)
+        for handle in alive:
+            track = self._track(handle.replica_id)
+            cost = float(handle.engine.last_step_virtual_cost)
+            track.ewma = (p.ewma_alpha * cost
+                          + (1.0 - p.ewma_alpha) * track.ewma)
+        ewmas = sorted(self._tracks[h.replica_id].ewma for h in alive)
+        fleet_median = ewmas[(len(ewmas) - 1) // 2] if ewmas else 0.0
+
+        verdicts: list[Verdict] = []
+        for handle in replicas:
+            track = self._track(handle.replica_id)
+            if not handle.alive:
+                if track.state is not SupervisorState.DEAD:
+                    verdicts.append(self._transit(
+                        tick, handle.replica_id, track,
+                        SupervisorState.DEAD, ("fail_stop",)))
+                continue
+            if track.state is SupervisorState.DEAD:
+                # a DEAD verdict on a live replica means the front end
+                # is about to kill it; nothing more to judge until a
+                # restart resets the track
+                continue
+            signals = self._signals_for(handle, track, fleet_median)
+            for s in signals:
+                _SIGNALS.inc(signal=s)
+            if signals:
+                track.bad_streak += 1
+                track.ok_streak = 0
+            else:
+                track.ok_streak += 1
+                track.bad_streak = 0
+            down_after = {
+                SupervisorState.HEALTHY: p.suspect_after,
+                SupervisorState.SUSPECT: p.degrade_after,
+                SupervisorState.DEGRADED: p.dead_after,
+            }[track.state]
+            down_to = {
+                SupervisorState.HEALTHY: SupervisorState.SUSPECT,
+                SupervisorState.SUSPECT: SupervisorState.DEGRADED,
+                SupervisorState.DEGRADED: SupervisorState.DEAD,
+            }[track.state]
+            if track.bad_streak >= down_after:
+                verdicts.append(self._transit(
+                    tick, handle.replica_id, track, down_to, signals))
+            elif (track.state in _RECOVER_TO
+                    and track.ok_streak >= p.recover_after):
+                verdicts.append(self._transit(
+                    tick, handle.replica_id, track,
+                    _RECOVER_TO[track.state], signals))
+        if obs.enabled():
+            for handle in replicas:
+                _STATE_G.set(
+                    _SEVERITY[self.state(handle.replica_id)],
+                    replica=handle.replica_id)
+        return verdicts
+
+    def _transit(self, tick: int, replica_id: str, track: _Track,
+                 new: SupervisorState,
+                 signals: tuple[str, ...]) -> Verdict:
+        verdict = Verdict(tick=tick, replica_id=replica_id,
+                          old=track.state, new=new, signals=signals)
+        track.state = new
+        track.bad_streak = 0
+        track.ok_streak = 0
+        self.history.append(verdict)
+        _VERDICTS.inc(state=new.value)
+        return verdict

@@ -157,6 +157,16 @@ def pytest_configure(config):
         "corruption table, journal replay, warm recovery parity; "
         "CPU-only",
     )
+    # the gray-failure tier (tests/test_supervisor.py): supervisor
+    # state machine, live migration, standby promotion, gray storms;
+    # CPU-only and tier-1 fast except the broad sweep (also slow)
+    config.addinivalue_line(
+        "markers",
+        "supervisor: gray-failure detection + live migration "
+        "(attention_tpu/frontend/supervisor.py + migrate.py) — "
+        "hysteresis state machine, drain parity, warm-standby "
+        "promotion, gray-storm campaigns; CPU-only",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

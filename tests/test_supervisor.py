@@ -1,0 +1,580 @@
+"""Gray-failure detection, live migration, and standby promotion
+(ISSUE 10): the `ReplicaSupervisor` state machine under stubbed
+signals, drain-off-a-SUSPECT-replica token parity, warm-standby
+promotion on DEAD verdicts, the fd-hygiene of the journal's persistent
+handle, deadline translation across warm restarts, and the seeded
+gray-storm acceptance run with the three new invariants
+(migration parity, no double serve, supervisor consistency)."""
+
+import gc
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from attention_tpu.engine import (
+    EngineConfig,
+    ServingEngine,
+    SnapshotError,
+    StepInterruptedError,
+)
+from attention_tpu.engine.sim import replay, synthetic_trace
+from attention_tpu.frontend import (
+    FrontendConfig,
+    ReplicaSupervisor,
+    RetryPolicy,
+    ServingFrontend,
+    SupervisorPolicy,
+    SupervisorState,
+    replay_frontend,
+)
+from attention_tpu.models import TinyDecoder
+
+pytestmark = pytest.mark.supervisor
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = TinyDecoder(vocab=43, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), probe)["params"]
+    return model, params
+
+
+def _cfg(**overrides):
+    kw = dict(num_pages=24, page_size=128, max_seq_len=256,
+              max_decode_batch=4, max_prefill_rows=2,
+              prefill_chunk=32, token_budget=80, watermark_pages=1)
+    kw.update(overrides)
+    return EngineConfig(**kw)
+
+
+def _baseline(model, params, trace, config=None):
+    """Fault-free single-replica outputs for the same trace."""
+    engine = ServingEngine(model, params, config or _cfg())
+    _, outputs = replay(engine, trace)
+    return outputs
+
+
+# ------------------------------------------------- state-machine units
+
+
+class _StubEngine:
+    def __init__(self):
+        self.last_step_virtual_cost = 1.0
+        self.current_step = 0
+        self.nonfinite_events = 0
+
+
+class _StubHandle:
+    """The exact surface `ReplicaSupervisor` reads off a replica."""
+
+    def __init__(self, rid):
+        self.replica_id = rid
+        self.alive = True
+        self.step_error_streak = 0
+        self.engine = _StubEngine()
+
+    def tick(self, cost=1.0):
+        self.engine.last_step_virtual_cost = cost
+        self.engine.current_step += 1
+
+
+def test_policy_validation():
+    SupervisorPolicy().validate()
+    with pytest.raises(ValueError, match="thresholds"):
+        SupervisorPolicy(suspect_after=0).validate()
+    with pytest.raises(ValueError, match="slow_factor"):
+        SupervisorPolicy(slow_factor=1.0).validate()
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        SupervisorPolicy(ewma_alpha=0.0).validate()
+    with pytest.raises(ValueError, match="thresholds"):
+        FrontendConfig(supervisor=SupervisorPolicy(
+            recover_after=0)).validate()
+    with pytest.raises(ValueError, match="standbys"):
+        FrontendConfig(standbys=-1).validate()
+
+
+def test_slow_step_hysteresis_and_one_level_recovery():
+    """One slow tick is NOT a verdict (hysteresis); ``suspect_after``
+    consecutive slow ticks are; recovery steps back ONE level after
+    ``recover_after`` clean ticks."""
+    sup = ReplicaSupervisor(SupervisorPolicy(
+        suspect_after=2, recover_after=3, slow_factor=3.0,
+        ewma_alpha=1.0))
+    a, b = _StubHandle("a"), _StubHandle("b")
+
+    a.tick(1.0)
+    b.tick(9.0)
+    assert sup.observe(0, [a, b]) == []     # bad streak 1 < 2
+    assert sup.state("b") is SupervisorState.HEALTHY
+    a.tick(1.0)
+    b.tick(9.0)
+    (v,) = sup.observe(1, [a, b])
+    assert (v.replica_id, v.new) == ("b", SupervisorState.SUSPECT)
+    assert "slow_step" in v.signals
+    assert sup.eligible_ids([a, b]) == {"a"}
+
+    # three clean ticks -> exactly one recovery, back to HEALTHY
+    verdicts = []
+    for t in range(2, 6):
+        a.tick(1.0)
+        b.tick(1.0)
+        verdicts += sup.observe(t, [a, b])
+    assert [(v.new, v.is_recovery) for v in verdicts] == [
+        (SupervisorState.HEALTHY, True)]
+    assert sup.eligible_ids([a, b]) == {"a", "b"}
+
+
+def test_descent_to_dead_and_error_stall_nonfinite_signals():
+    """SUSPECT -> DEGRADED -> DEAD takes the full per-level streaks;
+    the error-streak, frozen-step-counter, and non-finite signals each
+    register."""
+    sup = ReplicaSupervisor(SupervisorPolicy(
+        suspect_after=1, degrade_after=1, dead_after=1,
+        stall_ticks=2, error_streak=2, ewma_alpha=1.0))
+    a, b = _StubHandle("a"), _StubHandle("b")
+
+    b.step_error_streak = 2      # typed step errors, streak at threshold
+    a.tick()
+    b.tick()
+    (v1,) = sup.observe(0, [a, b])
+    assert (v1.new, v1.signals) == (SupervisorState.SUSPECT,
+                                    ("error_streak",))
+    # frozen step counter: b stops advancing -> stall after 2 frozen
+    # observations (stall_ticks=2)
+    b.step_error_streak = 0
+    a.tick()
+    assert sup.observe(1, [a, b]) == []  # frozen once: not yet a stall
+    a.tick()
+    (v2,) = sup.observe(2, [a, b])
+    assert v2.new is SupervisorState.DEGRADED
+    assert "stall" in v2.signals
+    a.tick()
+    b.engine.nonfinite_events += 1        # NaN logits surfaced
+    (v3,) = sup.observe(3, [a, b])
+    assert v3.new is SupervisorState.DEAD
+    assert "nonfinite_logits" in v3.signals
+    # DEAD is terminal for the tracker: only reset() leaves it
+    a.tick()
+    assert sup.observe(4, [a, b]) == []
+    rec = sup.reset(5, "b")
+    assert rec is not None and rec.new is SupervisorState.HEALTHY
+
+
+def test_fail_stop_is_immediate_dead_verdict():
+    sup = ReplicaSupervisor()
+    a = _StubHandle("a")
+    a.alive = False
+    (v,) = sup.observe(0, [a])
+    assert (v.new, v.signals) == (SupervisorState.DEAD, ("fail_stop",))
+
+
+# ------------------------------------------------ migration + promotion
+
+
+def test_suspect_replica_drains_token_identical(tiny_model):
+    """A slow-step window turns a replica SUSPECT; its in-flight
+    requests migrate live to the healthy replica and finish
+    token-identical to the fault-free run, with the source never
+    emitting past the cut."""
+    from attention_tpu.chaos import invariants as inv
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+
+    model, params = tiny_model
+    trace = synthetic_trace(num_requests=6, seed=11, vocab=43,
+                            max_tokens=6, arrival_every=1)
+    baseline = _baseline(model, params, trace)
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0,
+        supervisor=SupervisorPolicy(suspect_after=2)))
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=3, kind="slow_step", arg=8, target="replica-1"),
+    ))
+    FrontendFaultInjector(fe, plan)
+    summary, outputs = replay_frontend(fe, trace, max_ticks=400)
+
+    assert summary["supervisor_suspects"] >= 1
+    assert summary["live_migrations"] >= 1
+    moved = [m for m in fe.migrations if m.dest is not None]
+    assert moved and all(m.source == "replica-1" for m in moved)
+    assert summary["states"]["finished"] == 6
+    assert outputs == baseline
+    assert inv.migration_parity_violations(fe, baseline) == []
+    assert inv.no_double_serve_violations(fe) == []
+    assert inv.supervisor_consistency_violations(fe) == []
+    # a mid-stream migration preserved already-streamed tokens: the
+    # emitter trail switches replicas at the cut, tokens don't change
+    cut = next((m for m in moved if m.tokens_at_cut > 0), None)
+    if cut is not None:
+        fr = fe.requests[cut.request_id]
+        assert fr.emitters[cut.tokens_at_cut - 1] == cut.source
+        assert cut.dest in fr.emitters[cut.tokens_at_cut:]
+
+
+def test_flaky_steps_feed_error_streak_without_cancelling(tiny_model):
+    """Typed `StepInterruptedError`s raised before the step mutate
+    nothing: requests keep their tokens, the streak feeds the
+    supervisor, and the error is in the typed taxonomy."""
+    from attention_tpu.chaos import invariants as inv
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+
+    assert issubclass(StepInterruptedError, RuntimeError)
+    assert StepInterruptedError in inv.TYPED_ERRORS
+    model, params = tiny_model
+    trace = synthetic_trace(num_requests=4, seed=5, vocab=43,
+                            max_tokens=5)
+    baseline = _baseline(model, params, trace)
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0,
+        supervisor=SupervisorPolicy(suspect_after=2, error_streak=2)))
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=2, kind="flaky_step", arg=4,
+                   target="replica-0"),
+    ))
+    FrontendFaultInjector(fe, plan)
+    summary, outputs = replay_frontend(fe, trace, max_ticks=400)
+    assert summary["states"]["finished"] == 4
+    assert outputs == baseline
+    assert summary["supervisor_suspects"] >= 1
+
+
+def test_nan_window_never_emits_garbage(tiny_model):
+    """NaN-poisoned logits: the engine's finite guard skips sampling
+    (parity holds), counts the events, and the supervisor sees the
+    signal."""
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+
+    model, params = tiny_model
+    trace = synthetic_trace(num_requests=4, seed=7, vocab=43,
+                            max_tokens=5)
+    baseline = _baseline(model, params, trace)
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0))
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=4, kind="nan", arg=3, target="replica-0"),
+    ))
+    FrontendFaultInjector(fe, plan)
+    summary, outputs = replay_frontend(fe, trace, max_ticks=400)
+    assert summary["states"]["finished"] == 4
+    assert outputs == baseline
+    handle = fe.replicas[0]
+    assert handle.engine.nonfinite_events > 0
+    assert all(0 <= t < 43
+               for toks in outputs.values() for t in toks)
+
+
+def test_dead_verdict_promotes_warm_standby(tiny_model, tmp_path):
+    """A fail-stop kill with no scheduled restart: the supervisor's
+    DEAD verdict promotes the warm standby from the FAILED replica's
+    snapshots; adopted requests keep their streams and the fleet
+    finishes token-identical."""
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+
+    model, params = tiny_model
+    trace = synthetic_trace(num_requests=6, seed=3, vocab=43,
+                            max_tokens=6, arrival_every=1)
+    baseline = _baseline(model, params, trace)
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0, standbys=1,
+        retry=RetryPolicy(max_retries=4, base_delay_ticks=1,
+                          max_delay_ticks=8),
+        snapshot_dir=str(tmp_path / "snaps"), snapshot_every=2))
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=7, kind="replica_kill", target="replica-1"),
+    ))
+    FrontendFaultInjector(fe, plan)
+    summary, outputs = replay_frontend(fe, trace, max_ticks=400)
+
+    assert summary["standby_promotions"] == 1
+    assert summary["standbys_remaining"] == 0
+    assert summary["supervisor_dead"] == 1
+    assert any(h.replica_id == "standby-0" for h in fe.replicas)
+    spare = next(h for h in fe.replicas
+                 if h.replica_id == "standby-0")
+    assert spare.alive and spare.last_restart_mode == "warm"
+    assert summary["warm_restarts"] == 1
+    assert summary["states"]["finished"] == 6
+    assert outputs == baseline
+    # the promoted spare actually served: it emitted tokens
+    assert any("standby-0" in fr.emitters
+               for fr in fe.requests.values())
+
+
+def test_degraded_replica_barred_from_admissions(tiny_model):
+    """Once SUSPECT/DEGRADED, a replica receives no NEW admissions
+    (the router's hard ``eligible`` gate) — pinned by replaying the
+    unified event log."""
+    from attention_tpu.chaos import invariants as inv
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+
+    model, params = tiny_model
+    trace = synthetic_trace(num_requests=8, seed=9, vocab=43,
+                            max_tokens=5, arrival_every=2)
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0,
+        supervisor=SupervisorPolicy(suspect_after=2, degrade_after=2)))
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=2, kind="slow_step", arg=12,
+                   target="replica-1"),
+    ))
+    FrontendFaultInjector(fe, plan)
+    summary, _ = replay_frontend(fe, trace, max_ticks=400)
+    assert summary["supervisor_suspects"] >= 1
+    assert inv.supervisor_consistency_violations(fe) == []
+    # every admit logged after replica-1's suspect verdict (and before
+    # any recovery) names another replica
+    bad_window = False
+    for ev in fe.events_log:
+        if ev[0] == "verdict" and ev[2] == "replica-1":
+            bad_window = ev[4] != "healthy"
+        elif ev[0] == "admit" and bad_window:
+            assert ev[3] != "replica-1"
+
+
+# --------------------------------------------------------- satellites
+
+
+def test_warm_fallback_keeps_typed_cause(tiny_model, tmp_path):
+    """Satellite 1: a warm restart that degrades to cold keeps WHY —
+    the typed `SnapshotError` on the handle, the counter, and the run
+    summary's ``warm_fallbacks``."""
+    model, params = tiny_model
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0,
+        snapshot_dir=str(tmp_path / "snaps"), snapshot_every=2))
+    fe.submit([1, 2, 3], arrival=0)
+    for _ in range(4):
+        fe.tick()
+    handle = fe.replicas[0]
+    # vaporize the snapshot directory: warm recovery MUST fall back
+    for name in os.listdir(handle.snapshot_dir):
+        os.unlink(os.path.join(handle.snapshot_dir, name))
+    fe.kill_replica("replica-0")
+    assert fe.restart_replica("replica-0")
+    assert handle.last_restart_mode == "cold"
+    assert isinstance(handle.last_warm_fallback, SnapshotError)
+    assert handle.warm_fallbacks == 1
+    fe.run(max_ticks=400)
+    assert fe.summary()["warm_fallbacks"] == 1
+    # a SUCCESSFUL warm restart clears the cause
+    fe.kill_replica("replica-0")
+    assert fe.restart_replica("replica-0")
+    assert handle.last_restart_mode == "warm"
+    assert handle.last_warm_fallback is None
+    assert fe.summary()["warm_fallbacks"] == 1
+
+
+def test_journal_handles_closed_on_kill_storm(tiny_model, tmp_path):
+    """Satellite 2: the journal's persistent append handle is released
+    by `SnapshotManager.detach` on every kill — a kill/restart storm
+    leaks neither fds nor ResourceWarnings."""
+    model, params = tiny_model
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=2, seed=0,
+        retry=RetryPolicy(max_retries=6, base_delay_ticks=1),
+        snapshot_dir=str(tmp_path / "snaps"), snapshot_every=2))
+    root = str(tmp_path / "snaps")
+
+    def open_journal_fds():
+        out = []
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                target = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                continue
+            if root in target:
+                out.append(target)
+        return out
+
+    fe.submit([1, 2, 3, 4], arrival=0)
+    gc.collect()   # flush other tests' garbage before recording
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for round_ in range(3):
+            for _ in range(3):
+                fe.tick()
+            fe.kill_replica("replica-0")
+            assert open_journal_fds() == [] or all(
+                "replica-0" not in p for p in open_journal_fds())
+            fe.restart_replica("replica-0")
+        for h in fe.replicas:
+            fe.kill_replica(h.replica_id)
+        # every engine dead -> every journal handle closed
+        assert open_journal_fds() == []
+        gc.collect()
+    # only THIS test's files count: gc may also surface warnings from
+    # unrelated earlier tests' garbage
+    assert [w for w in caught
+            if issubclass(w.category, ResourceWarning)
+            and root in str(w.message)] == []
+
+
+def test_deadline_survives_warm_restart(tiny_model, tmp_path):
+    """Satellite 3: a deadline set pre-crash expires at the SAME
+    front-end tick post-recovery — the warm-restored engine keeps its
+    own step counter and the handle re-anchors ``start_tick``, so the
+    translated ``deadline_step`` lands on the identical tick."""
+    model, params = tiny_model
+    fe = ServingFrontend(model, params, _cfg(), FrontendConfig(
+        num_replicas=1, seed=0,
+        retry=RetryPolicy(max_retries=4, base_delay_ticks=1),
+        snapshot_dir=str(tmp_path / "snaps"), snapshot_every=2))
+    fr = fe.submit([1, 2, 3], arrival=0, ttl_ticks=30,
+                   request_id="ttl-req")
+    for _ in range(6):
+        fe.tick()
+    handle = fe.replicas[0]
+    eng_req = next(r for r in (*handle.engine.scheduler.running,
+                               *handle.engine.scheduler.waiting)
+                   if r.request_id == "ttl-req")
+    # pre-crash: deadline translates to the engine step that happens
+    # at front-end tick fr.deadline
+    assert handle.start_tick + eng_req.deadline_step == fr.deadline
+    fe.kill_replica("replica-0")
+    fe.tick()  # let a tick pass while dead: counters now skewed
+    assert fe.restart_replica("replica-0")
+    assert handle.last_restart_mode == "warm"
+    assert fr.state.value == "assigned"    # warm-adopted
+    eng_req2 = next(r for r in (*handle.engine.scheduler.running,
+                                *handle.engine.scheduler.waiting)
+                    if r.request_id == "ttl-req")
+    # post-recovery: the translated deadline still lands on the SAME
+    # absolute front-end tick
+    assert handle.start_tick + eng_req2.deadline_step == fr.deadline
+
+
+def test_trace_embeds_gray_plan_roundtrip(tiny_model, tmp_path):
+    """Satellite 6: `save_trace(gray_plan=...)` + `load_gray_plan`
+    round-trip the chaos plan through the trace file, and the typed
+    `FaultPlan` survives JSON-identically."""
+    from attention_tpu.chaos.faults import random_gray_plan
+    from attention_tpu.engine.sim import (
+        load_gray_plan,
+        load_trace,
+        save_trace,
+    )
+
+    trace = synthetic_trace(num_requests=3, seed=1, vocab=43)
+    plan = random_gray_plan(42, [t["id"] for t in trace], 2)
+    path = str(tmp_path / "trace.json")
+    save_trace(path, trace, gray_plan=json.loads(plan.to_json()))
+    assert load_trace(path) == trace
+    embedded = load_gray_plan(path)
+    from attention_tpu.chaos.faults import FaultPlan
+
+    assert FaultPlan.from_json(json.dumps(embedded)) == plan
+    # a plain trace has no annotation
+    save_trace(path, trace)
+    assert load_gray_plan(path) is None
+
+
+def test_serve_sim_cli_gray_plan_from_trace_alone(tmp_path, capsys):
+    """`serve-sim --gray-plan --trace-out` embeds the plan; a second
+    run from the trace file ALONE replays the storm byte-identically
+    (the acceptance property for trace-schema satellite 6)."""
+    from attention_tpu.chaos.faults import FaultEvent, FaultPlan
+    from attention_tpu.cli import main
+
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=2, kind="slow_step", arg=4,
+                   target="replica-1"),
+        FaultEvent(step=4, kind="replica_kill", target="replica-1"),
+    ))
+    plan_path = tmp_path / "gray.json"
+    plan_path.write_text(plan.to_json())
+    trace_path = tmp_path / "trace.json"
+    common = [
+        "serve-sim", "--num-requests", "6", "--max-tokens", "5",
+        "--prompt-len-min", "4", "--prompt-len-max", "8",
+        "--vocab", "32", "--dim", "32", "--depth", "1",
+        "--q-heads", "2", "--kv-heads", "1",
+        "--num-pages", "16", "--max-seq-len", "128",
+        "--max-decode-batch", "2", "--prefill-chunk", "16",
+        "--token-budget", "32", "--watermark-pages", "0",
+        "--replicas", "2", "--standbys", "1", "--suspect-after", "2",
+        "--outputs",
+    ]
+    assert main(common + ["--gray-plan", str(plan_path),
+                          "--trace-out", str(trace_path)]) == 0
+    out1 = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out1["summary"]["supervisor_dead"] >= 1
+    assert out1["summary"]["standby_promotions"] == 1
+    # second run: NO --gray-plan — the embedded annotation drives it
+    assert main(common + ["--trace", str(trace_path)]) == 0
+    out2 = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out2 == out1
+
+
+# ------------------------------------------------- storm acceptance
+
+
+def test_gray_storm_acceptance(tiny_model, tmp_path):
+    """ISSUE 10 acceptance: a seeded gray storm (slow-step window +
+    intermittent typed errors + one kill) against a supervised,
+    durable, standby-backed front end — every FINISHED stream
+    (migrated and standby-promoted included) token-identical to the
+    fault-free single-replica run, zero violations from all three new
+    checkers, and a byte-identical summary on re-run."""
+    from attention_tpu.chaos.faults import run_gray_campaign
+
+    model, params = tiny_model
+
+    def run(root):
+        return run_gray_campaign(
+            0, str(root), num_plans=2, num_requests=6,
+            num_replicas=2, standbys=1, model=model, params=params,
+            config=_cfg(),
+        )
+
+    rep = run(tmp_path / "a")
+    assert rep.ok, [v for r in rep.reports for v in r.violations]
+    assert rep.total_injected > 0
+    # the storms actually exercised the machinery
+    assert any(r.summary.get("supervisor_suspects", 0) > 0
+               or r.summary.get("supervisor_dead", 0) > 0
+               for r in rep.reports)
+    # byte-identical re-run (virtual clocks only, seeded everything)
+    rep2 = run(tmp_path / "b")
+    assert ([json.dumps(r.summary, sort_keys=True)
+             for r in rep.reports]
+            == [json.dumps(r.summary, sort_keys=True)
+                for r in rep2.reports])
+    assert [r.outputs for r in rep.reports] == \
+        [r.outputs for r in rep2.reports]
+
+
+@pytest.mark.slow
+def test_gray_storm_broad_sweep(tmp_path):
+    """Wider seeded sweep of gray storms (tier-2): more plans, more
+    seeds, same zero-violation bar."""
+    from attention_tpu.chaos.faults import run_gray_campaign
+
+    for seed in range(3):
+        rep = run_gray_campaign(seed, str(tmp_path / f"s{seed}"),
+                                num_plans=5)
+        assert rep.ok, [v for r in rep.reports for v in r.violations]
