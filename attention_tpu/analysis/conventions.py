@@ -110,6 +110,7 @@ FAMILY_FIELDS = {
     "flash_bwd_fused": {"block_q", "block_k"},
     "decode": {"block_k"},
     "paged": {"page_size"},
+    "ragged": {"block_q"},
 }
 
 META_FIELDS = {"ms", "source", "recorded"}

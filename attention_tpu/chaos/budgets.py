@@ -36,6 +36,7 @@ FAMILY_BUDGETS: dict[str, float] = {
     "flash": CONTRACT_TOL,   # fused Pallas forward (fp32/bf16)
     "decode": CONTRACT_TOL,  # dense-cache flash decode
     "paged": CONTRACT_TOL,   # page-table decode
+    "ragged": CONTRACT_TOL,  # packed mixed decode/prefill launch
     "int8": CONTRACT_TOL,    # int8 KV cache: measured ~2e-3, held to
                              # the contract (it is contract-grade)
     "int4": 0.25,            # full-band worst case (~0.20 measured)

@@ -23,8 +23,11 @@ from typing import Any, Sequence
 
 import numpy as np
 
-#: kernel families the fuzzer knows how to drive
-FAMILIES = ("flash", "decode", "paged", "int8", "int4")
+#: kernel families the fuzzer knows how to drive.  "ragged" is the
+#: packed mixed decode/prefill single-launch kernel (ops.ragged_paged):
+#: ``m`` requests share one token axis — request 0 decodes one token,
+#: the rest prefill short chunks — against per-request page tables
+FAMILIES = ("flash", "decode", "paged", "ragged", "int8", "int4")
 
 #: the paged kernels' page granule (ops.paged)
 PAGE_SIZE = 128

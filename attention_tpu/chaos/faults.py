@@ -638,17 +638,21 @@ class FrontendFaultInjector:
         eng = handle.engine
         state = {"left": count}
         if kind == "nan":
-            orig_apply = eng._apply
+            # wrap the logits device sync — the ONE seam both step
+            # modes (ragged single-launch and legacy two-call) fetch
+            # through, so the injector composes with either loop and
+            # with async staging unchanged
+            orig_fetch = eng._fetch_logits
 
             def poisoned(*args, **kwargs):
-                out = orig_apply(*args, **kwargs)
+                out = orig_fetch(*args, **kwargs)
                 if state["left"] > 0:
                     state["left"] -= 1
                     self._mark("nan")
                     out = np.full_like(np.asarray(out), np.nan)
                 return out
 
-            eng._apply = poisoned
+            eng._fetch_logits = poisoned
             return
         orig_step = eng.step
 

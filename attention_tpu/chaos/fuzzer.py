@@ -147,6 +147,23 @@ def _case_inputs(config: FuzzConfig):
         k = rng.standard_normal((hkv, config.n, d))
         v = rng.standard_normal((hkv, config.n, d))
         return q, k, v, None
+    if config.family == "ragged":
+        # one packed mixed step: request 0 decodes a single token, the
+        # rest prefill chunks.  ``lengths`` is (b, 2) int32 rows of
+        # (kv_pre, q_len); the appended K/V rows for request bi are the
+        # dense rows k[bi, :, kv_pre:kv_pre+q_len] — so the post-append
+        # ground truth is just the dense prefix of length kv_pre+q_len
+        b, n = config.m, config.n
+        lo = 1 + (config.sinks or 0)
+        q_lens = np.ones((b,), np.int64)
+        if b > 1:
+            q_lens[1:] = rng.integers(2, 17, size=b - 1)
+        kv_pre = rng.integers(lo, n - 16, size=b)
+        q = rng.standard_normal((hq, int(q_lens.sum()), d))
+        k = rng.standard_normal((b, hkv, n, d))
+        v = rng.standard_normal((b, hkv, n, d))
+        lengths = np.stack([kv_pre, q_lens], axis=1).astype(np.int32)
+        return q, k, v, lengths
     b, n = config.m, config.n
     q = rng.standard_normal((b, hq, d))
     k = rng.standard_normal((b, hkv, n, d))
@@ -175,6 +192,88 @@ def _decode_oracle(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
     return out
 
 
+def _ragged_oracle(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
+    """Per-request fp64 reference for the packed single-launch family:
+    request ``bi``'s span queries sit at absolute positions
+    ``kv_pre .. kv_pre+q_len-1`` of its own (history + chunk)
+    sequence."""
+    hq = config.heads
+    total = int(lengths[:, 1].sum())
+    out = np.empty((hq, total, v.shape[-1]))
+    off = 0
+    for bi in range(config.m):
+        kv_pre, q_len = int(lengths[bi, 0]), int(lengths[bi, 1])
+        ln = kv_pre + q_len
+        out[:, off:off + q_len] = oracle_masked(
+            q[:, off:off + q_len], k[bi, :, :ln], v[bi, :, :ln],
+            causal=True, window=config.window, sinks=config.sinks,
+            softcap=config.softcap,
+            q_positions=np.arange(kv_pre, ln),
+        )
+        off += q_len
+    return out
+
+
+def _run_ragged(config: FuzzConfig, q, k, v, lengths, dt) -> np.ndarray:
+    """Build the packed step (pools via `paged_from_dense`, appended
+    rows = the dense tail of each request) and run the single-launch
+    kernel; returns the real-token slice of the packed output."""
+    import jax.numpy as jnp
+
+    from attention_tpu.ops.paged import PagePool, paged_from_dense
+    from attention_tpu.ops.ragged_paged import (
+        RaggedPagedStep,
+        packed_bucket,
+        ragged_paged_append,
+        ragged_paged_attention,
+        tile_tokens,
+    )
+
+    b = config.m
+    kv_pre, q_lens = lengths[:, 0], lengths[:, 1]
+    num_pages = b * (config.n // PAGE_SIZE)
+    pool = PagePool(num_pages)
+    base = paged_from_dense(jnp.asarray(k, dt), jnp.asarray(v, dt),
+                            jnp.asarray(kv_pre, jnp.int32), pool,
+                            num_pages=num_pages, page_size=PAGE_SIZE,
+                            # full-capacity table rows: the appended
+                            # chunk may cross into the next page
+                            total_pages_per_seq=config.n // PAGE_SIZE)
+    group = config.heads // config.kv_heads
+    total = int(q_lens.sum())
+    q_tile = tile_tokens(packed_bucket(int(q_lens.max()), minimum=1),
+                         group)
+    width = packed_bucket(max(total, q_tile))
+    cu = np.zeros((b + 1,), np.int32)
+    cu[1:] = np.cumsum(q_lens)
+    tok_pos = np.zeros((width,), np.int32)
+    tok_slot = np.full((width,), -1, np.int32)
+    qp = np.zeros((1, config.heads, width, config.head_dim))
+    kn = np.zeros((1, config.kv_heads, width, config.head_dim))
+    vn = np.zeros((1, config.kv_heads, width, config.head_dim))
+    qp[0, :, :total] = q
+    for bi in range(b):
+        o, e = int(cu[bi]), int(cu[bi + 1])
+        tok_pos[o:e] = np.arange(kv_pre[bi], kv_pre[bi] + q_lens[bi])
+        tok_slot[o:e] = bi
+        kn[0, :, o:e] = k[bi, :, kv_pre[bi]:kv_pre[bi] + q_lens[bi]]
+        vn[0, :, o:e] = v[bi, :, kv_pre[bi]:kv_pre[bi] + q_lens[bi]]
+    cache = RaggedPagedStep(
+        base.k_pool, base.v_pool,
+        jnp.asarray(base.page_table, jnp.int32),
+        jnp.asarray(kv_pre, jnp.int32), jnp.asarray(cu),
+        jnp.asarray([1, b], jnp.int32), jnp.asarray(tok_pos),
+        jnp.asarray(tok_slot), np.zeros((q_tile,), np.int32),
+    )
+    cache = ragged_paged_append(cache, jnp.asarray(kn, dt),
+                                jnp.asarray(vn, dt))
+    out = ragged_paged_attention(
+        jnp.asarray(qp, dt), cache, softcap=config.softcap,
+        window=config.window, sinks=config.sinks,
+    )
+    return np.asarray(out, np.float64)[0, :, :total]
+
+
 def _run_kernel(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
     """Lower one config onto the real kernel path it names."""
     import jax.numpy as jnp
@@ -190,6 +289,9 @@ def _run_kernel(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
             causal=config.causal, **kw,
         )
         return np.asarray(out, np.float64)
+
+    if config.family == "ragged":
+        return _run_ragged(config, q, k, v, lengths, dt)
 
     lens = jnp.asarray(lengths, jnp.int32)
     if config.family == "decode":
@@ -246,6 +348,9 @@ def run_case(config: FuzzConfig, *,
                              window=config.window, sinks=config.sinks,
                              softcap=config.softcap)
         min_band = None
+    elif config.family == "ragged":
+        want = _ragged_oracle(config, qr, kr, vr, lengths)
+        min_band = int(np.min(lengths[:, 0] + lengths[:, 1]))
     else:
         want = _decode_oracle(config, qr, kr, vr, lengths)
         min_band = int(np.min(lengths))

@@ -10,16 +10,28 @@ chunk-mode kernel for prefill slices — exactly the kernels
 `generate_paged` steps, which is what makes the engine's output
 token-for-token comparable to per-request sequential generation.
 
-Shape discipline (the TPU way): every step lowers onto at most TWO
-jitted calls with FIXED shapes — a ``(max_decode_batch, 1)`` decode
-call and a ``(max_prefill_rows, prefill_chunk)`` prefill call — so the
-whole serving life of an engine compiles exactly two executables.
-Unused rows are padded with an inactive sentinel (empty table, length
--1) that the paged kernels already define semantics for: appends drop,
-outputs are masked, nothing is read or written.  Partial final chunks
-pad with token 0; pad rows sit causally AFTER every real row and their
-garbage KV lands beyond the request's tracked length, where the next
-real append overwrites it and no masked read ever sees it.
+Shape discipline (the TPU way): in the default ``step_mode="ragged"``
+every step lowers onto exactly ONE jitted call over a PACKED token
+axis — decode tokens and prefill chunks ride the same axis, delimited
+by ``cu_q_lens`` + a decode/prefill ``distribution`` split
+(`ops.ragged_paged`).  The packed width and per-request query tile are
+power-of-two bucketed, so a serving life compiles O(log max_tokens)
+executables and pad waste per step is just the bucket remainder — not
+the ``(max_decode_batch - d) + (max_prefill_rows*chunk - real)``
+poison rows of the legacy path.  ``step_mode="two_call"`` keeps that
+legacy lowering — a ``(max_decode_batch, 1)`` decode call plus a
+``(max_prefill_rows, prefill_chunk)`` prefill call padded with the
+inactive sentinel (empty table, length -1) — as the parity oracle;
+both modes consume logits through the same post-processing helpers,
+so their token streams are identical by construction.
+
+``async_steps=True`` double-buffers the loop: after the launch is
+dispatched, next step's page-table rows are staged on host
+(``engine.step.overlap`` span) BEFORE `jax.block_until_ready` forces
+the logits sync — host staging hides behind device compute, the source
+paper's ping-pong trick.  Staging is pure pre-rendering (no
+allocation, no RNG), so the async loop is token-identical to the sync
+loop; snapshot cuts call `quiesce` to settle it.
 
 Tokens stream out through callbacks (``on_token``/``on_finish``) the
 moment they are sampled — iteration-level, not request-level, latency.
@@ -47,11 +59,21 @@ from attention_tpu.engine.metrics import (
 from attention_tpu.engine.request import Request, RequestState, SamplingParams
 from attention_tpu.engine.scheduler import ScheduledStep, Scheduler
 from attention_tpu.ops.paged import OutOfPagesError, PagedKV, PagePool
+from attention_tpu.ops.ragged_paged import (
+    RaggedPagedStep,
+    packed_bucket,
+    recommended_q_tile,
+)
 
 _CANCELLED = obs.counter("engine.requests.cancelled",
                          "requests cancelled mid-flight")
 _TIMED_OUT = obs.counter("engine.requests.timed_out",
                          "requests expired by the deadline sweep")
+# host-side dispatches of jitted attention work, labelled by step mode:
+# ticks once per LAUNCH (the ragged loop's single-launch property is
+# asserted against this; the ops.*.calls counters tick per jit trace)
+_LAUNCHES = obs.counter("engine.step.launches",
+                        "jitted model launches dispatched by the step loop")
 
 #: consecutive non-finite-logits steps a request is held back before
 #: the finite guard gives up and samples anyway — must exceed any
@@ -81,6 +103,16 @@ def _paged_apply(model, params, tokens, caches):
     return model.apply({"params": params}, tokens, caches)
 
 
+@functools.partial(jax.jit, static_argnames=("model",))
+def _ragged_apply(model, params, tokens, caches):
+    """One PACKED model step: the whole mixed decode/prefill batch as a
+    single ``(1, width)`` token axis over per-layer `RaggedPagedStep`
+    caches — exactly one attention launch per layer per engine step.
+    Width and the caches' q_tile marker are pow2-bucketed by the
+    caller, so distinct compiled signatures stay O(log max_tokens)."""
+    return model.apply({"params": params}, tokens, caches)
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Serving-engine knobs.  Defaults are sized for tiny CPU tests;
@@ -95,12 +127,24 @@ class EngineConfig:
     token_budget: int = 128        # real tokens scheduled per step
     watermark_pages: int = 1       # admission must leave this reserve
     cache_dtype: Any = None        # None -> model dtype
+    # "ragged": ONE packed jitted launch per step (ops/ragged_paged);
+    # "two_call": the legacy fixed-shape decode+prefill pair, kept as
+    # the parity oracle
+    step_mode: str = "ragged"
+    # double-buffer: stage next step's page-table rows on host while
+    # the current launch runs on device (ragged mode only)
+    async_steps: bool = False
 
     def validate(self) -> None:
         if self.page_size % 128:
             raise ValueError(
                 f"page_size {self.page_size} must be a 128-multiple "
                 "(paged kernel granule)"
+            )
+        if self.step_mode not in ("ragged", "two_call"):
+            raise ValueError(
+                f"step_mode {self.step_mode!r} not in "
+                "['ragged', 'two_call']"
             )
         if min(self.num_pages, self.max_seq_len, self.max_decode_batch,
                self.max_prefill_rows, self.prefill_chunk,
@@ -189,6 +233,14 @@ class ServingEngine:
         # documented garbage-but-terminating contract (the checkers
         # exclude corrupted targets from parity)
         self._nonfinite_skips: dict[str, int] = {}
+        # async double-buffer state: page-table rows pre-rendered for
+        # next step while the current launch runs on device, keyed by
+        # request id as (num_pages, row) — `pack` only consumes a row
+        # whose page count is still current
+        self._staged_rows: dict[str, tuple[int, np.ndarray]] = {}
+        # seconds this step spent blocked in the logits device sync
+        # (host overhead = step wall minus this)
+        self._last_fetch_s = 0.0
         # write-ahead log between snapshots; attached by SnapshotManager
         # (engine/snapshot.py), None when durability is off
         self.journal: Any = None
@@ -373,22 +425,39 @@ class ServingEngine:
 
     def step(self) -> StepMetrics:
         """Run one scheduler iteration: compose a batch, lower it onto
-        the paged kernels, stream out sampled tokens."""
+        ONE ragged launch (or the legacy two-call pair), stream out
+        sampled tokens."""
         t0 = time.perf_counter()
         self._finished_in_step = 0
         self.last_step_virtual_cost = 1.0
+        self._last_fetch_s = 0.0
+        pad_tokens = 0
+        occupancy = 0.0
         with obs.span("engine.step"):
             timed_out = self._expire_deadlines()
             sched = self.scheduler.schedule(self._step)
-            if sched.decode:
-                with obs.span("engine.step.decode"):
-                    self._run_decode(sched.decode)
-            if sched.prefill:
-                with obs.span("engine.step.prefill"):
-                    self._run_prefill(sched.prefill)
+            total = sched.num_decode_tokens + sched.num_prefill_tokens
+            baseline_pad = self._baseline_pad(sched)
+            if self.config.step_mode == "ragged":
+                if not sched.is_empty:
+                    with obs.span("engine.step.ragged"):
+                        width = self._run_ragged(sched)
+                    pad_tokens = width - total
+                    occupancy = total / width
+            else:
+                if sched.decode:
+                    with obs.span("engine.step.decode"):
+                        self._run_decode(sched.decode)
+                if sched.prefill:
+                    with obs.span("engine.step.prefill"):
+                        self._run_prefill(sched.prefill)
+                pad_tokens = baseline_pad
+                if total:
+                    occupancy = total / (total + baseline_pad)
+        wall_s = time.perf_counter() - t0
         m = StepMetrics(
             step=self._step,
-            wall_s=time.perf_counter() - t0,
+            wall_s=wall_s,
             num_decode_reqs=len(sched.decode),
             num_prefill_reqs=len(sched.prefill),
             decode_tokens=sched.num_decode_tokens,
@@ -404,6 +473,10 @@ class ServingEngine:
             page_utilization=self.pool.used_pages / self.pool.num_pages,
             prefix_hit_tokens_total=self.allocator.prefix_hit_tokens,
             preemptions_total=self.scheduler.num_preemptions,
+            pad_tokens=pad_tokens,
+            baseline_pad_tokens=baseline_pad,
+            ragged_occupancy=occupancy,
+            host_overhead_s=max(0.0, wall_s - self._last_fetch_s),
         )
         self.metrics.record_step(m)
         self._step += 1
@@ -466,11 +539,35 @@ class ServingEngine:
 
     # -- batch lowering ---------------------------------------------------
 
+    def _baseline_pad(self, sched: ScheduledStep) -> int:
+        """Pad tokens the legacy two-call lowering dispatches for this
+        step's composition — the yardstick ragged occupancy is measured
+        against."""
+        pad = 0
+        if sched.decode:
+            pad += self.config.max_decode_batch - len(sched.decode)
+        if sched.prefill:
+            pad += (self.config.max_prefill_rows
+                    * self.config.prefill_chunk
+                    - sched.num_prefill_tokens)
+        return pad
+
     def _table_rows(self, reqs: list[Request]) -> np.ndarray:
         rows = np.full((len(reqs), self.config.table_width), -1, np.int64)
         for i, req in enumerate(reqs):
             rows[i, : len(req.pages)] = req.pages
         return rows
+
+    def _fetch_logits(self, logits_dev) -> np.ndarray:
+        """The step loop's ONLY device sync: materialize the launch's
+        logits on host.  Isolated in one hook so (a) the async loop can
+        finish its overlapped staging before the block, (b) per-step
+        host overhead is measurable as wall minus time spent here, and
+        (c) fault injectors have a single seam to poison."""
+        t0 = time.perf_counter()
+        out = np.asarray(logits_dev, np.float32)
+        self._last_fetch_s += time.perf_counter() - t0
+        return out
 
     def _apply(self, tokens: np.ndarray, tables: np.ndarray,
                lens: np.ndarray) -> np.ndarray:
@@ -480,13 +577,102 @@ class ServingEngine:
                     jnp.asarray(lens, jnp.int32))
             for layer in range(self.model.depth)
         )
+        if obs.is_enabled():
+            _LAUNCHES.inc(mode="two_call")
         logits, new_caches = _paged_apply(
             self.model, self.params, jnp.asarray(tokens, jnp.int32), caches
         )
         for layer, c in enumerate(new_caches):
             self._k_pools[layer] = c.k_pool
             self._v_pools[layer] = c.v_pool
-        return np.asarray(logits, np.float32)
+        return self._fetch_logits(logits)
+
+    def _run_ragged(self, sched: ScheduledStep) -> int:
+        """Lower the WHOLE step onto one jitted packed launch; returns
+        the packed width dispatched.
+
+        The per-request query tile covers the longest prefill chunk and
+        the packed width covers every real token, both pow2-bucketed —
+        occupancy stays high while compiled signatures stay
+        O(log max_tokens).  With ``async_steps`` the host stages next
+        step's page-table rows between dispatch and the logits sync."""
+        cfg = self.config
+        slots = cfg.max_decode_batch + cfg.max_prefill_rows
+        group = self.model.num_q_heads // self.model.num_kv_heads
+        head_dim = self.model.dim // self.model.num_q_heads
+        max_q = max((n for _, n in sched.prefill), default=1)
+        q_tile = recommended_q_tile(
+            max_q, group, heads=self.model.num_q_heads,
+            kv_heads=self.model.num_kv_heads, seq=cfg.max_seq_len,
+            dim=head_dim, batch=slots,
+            dtype=cfg.cache_dtype or self.model.dtype,
+        )
+        total = sched.num_decode_tokens + sched.num_prefill_tokens
+        width = packed_bucket(max(total, q_tile))
+        batch = sched.pack(width=width, slots=slots,
+                           table_width=cfg.table_width,
+                           staged_rows=self._staged_rows)
+        self._staged_rows = {}
+        tables = jnp.asarray(batch.tables, jnp.int32)
+        kv_lens = jnp.asarray(batch.kv_lens, jnp.int32)
+        cu = jnp.asarray(batch.cu_q_lens, jnp.int32)
+        dist = jnp.asarray(batch.distribution, jnp.int32)
+        pos = jnp.asarray(batch.token_pos, jnp.int32)
+        slot = jnp.asarray(batch.token_slot, jnp.int32)
+        q_span = np.zeros((q_tile,), np.int32)  # shape carries q_tile
+        caches = tuple(
+            RaggedPagedStep(self._k_pools[layer], self._v_pools[layer],
+                            tables, kv_lens, cu, dist, pos, slot, q_span)
+            for layer in range(self.model.depth)
+        )
+        if obs.is_enabled():
+            _LAUNCHES.inc(mode="ragged")
+        logits_dev, new_caches = _ragged_apply(
+            self.model, self.params,
+            jnp.asarray(batch.tokens, jnp.int32), caches,
+        )
+        for layer, c in enumerate(new_caches):
+            self._k_pools[layer] = c.k_pool
+            self._v_pools[layer] = c.v_pool
+        if cfg.async_steps:
+            # the double-buffer window: the launch is in flight, the
+            # sync has not happened — overlap next step's host staging
+            with obs.span("engine.step.overlap"):
+                self._stage_next_step()
+        logits = self._fetch_logits(logits_dev)
+        cu_h = batch.cu_q_lens
+        num_decode = len(sched.decode)
+        for i, req in enumerate(sched.decode):
+            self._post_decode(req, logits[0, cu_h[i]])
+        for s, (req, real) in enumerate(sched.prefill):
+            self._post_prefill(
+                req, real, logits[0, cu_h[num_decode + s] + real - 1]
+            )
+        return width
+
+    def _stage_next_step(self) -> None:
+        """Host half of the double buffer: pre-render page-table rows
+        for every request that will decode next step, while the device
+        is still busy.  Pure staging — no page allocation, no pool
+        mutation, no RNG consumption — so the async loop's tokens are
+        identical to the sync loop's by construction; `pack` discards
+        any staged row whose page count went stale."""
+        staged: dict[str, tuple[int, np.ndarray]] = {}
+        tw = self.config.table_width
+        for req in self.scheduler.running:
+            if req.state is RequestState.DECODING and req.pages:
+                row = np.full((tw,), -1, np.int32)
+                row[: len(req.pages)] = req.pages
+                staged[req.request_id] = (len(req.pages), row)
+        self._staged_rows = staged
+
+    def quiesce(self) -> None:
+        """Settle the staged/in-flight step: drop staged rows and block
+        until the device pools are final.  Snapshot cuts run this first
+        so a serialized image never captures a half-staged async step."""
+        self._staged_rows = {}
+        for a in (*self._k_pools, *self._v_pools):
+            jax.block_until_ready(a)
 
     def _run_decode(self, reqs: list[Request]) -> None:
         d = self.config.max_decode_batch
@@ -499,24 +685,30 @@ class ServingEngine:
             tables[i, : len(req.pages)] = req.pages
         logits = self._apply(tokens, tables, lens)
         for i, req in enumerate(reqs):
-            if not np.isfinite(logits[i, 0]).all():
-                # poisoned logits must never reach sampling: a garbage
-                # token would break parity with the fault-free run.
-                # Un-feed the pending token (its KV slot is simply
-                # overwritten on retry) so the request makes no
-                # progress this step, and count the event — the
-                # replica supervisor's NaN signal.  Bounded: see
-                # _NONFINITE_SKIP_LIMIT.
-                self.nonfinite_events += 1
-                skips = self._nonfinite_skips.get(req.request_id, 0) + 1
-                self._nonfinite_skips[req.request_id] = skips
-                if skips <= _NONFINITE_SKIP_LIMIT:
-                    req.pending_token = req.tokens.pop()
-                    continue
-            else:
-                self._nonfinite_skips.pop(req.request_id, None)
-            req.computed_tokens = len(req.tokens)
-            self._emit(req, self._sample(req, logits[i, 0]))
+            self._post_decode(req, logits[i, 0])
+
+    def _post_decode(self, req: Request, logits_row: np.ndarray) -> None:
+        """Consume one decode request's logits row — the mode-agnostic
+        half of a decode step (both lowerings call this, which is what
+        makes their token streams identical by construction)."""
+        if not np.isfinite(logits_row).all():
+            # poisoned logits must never reach sampling: a garbage
+            # token would break parity with the fault-free run.
+            # Un-feed the pending token (its KV slot is simply
+            # overwritten on retry) so the request makes no
+            # progress this step, and count the event — the
+            # replica supervisor's NaN signal.  Bounded: see
+            # _NONFINITE_SKIP_LIMIT.
+            self.nonfinite_events += 1
+            skips = self._nonfinite_skips.get(req.request_id, 0) + 1
+            self._nonfinite_skips[req.request_id] = skips
+            if skips <= _NONFINITE_SKIP_LIMIT:
+                req.pending_token = req.tokens.pop()
+                return
+        else:
+            self._nonfinite_skips.pop(req.request_id, None)
+        req.computed_tokens = len(req.tokens)
+        self._emit(req, self._sample(req, logits_row))
 
     def _run_prefill(self, items: list[tuple[Request, int]]) -> None:
         p = self.config.max_prefill_rows
@@ -531,29 +723,36 @@ class ServingEngine:
             lens[i] = c
         logits = self._apply(tokens, tables, lens)
         for i, (req, real) in enumerate(items):
-            if (req.computed_tokens + real >= len(req.tokens)
-                    and not req.output_tokens
-                    and not np.isfinite(logits[i, real - 1]).all()):
-                # the final chunk samples the first token; with
-                # non-finite logits, skip the whole chunk (the KV it
-                # wrote is recomputed in place next step) rather than
-                # emit garbage.  Bounded: see _NONFINITE_SKIP_LIMIT.
-                self.nonfinite_events += 1
-                skips = self._nonfinite_skips.get(req.request_id, 0) + 1
-                self._nonfinite_skips[req.request_id] = skips
-                if skips <= _NONFINITE_SKIP_LIMIT:
-                    continue
-            req.computed_tokens += real
-            if req.computed_tokens < len(req.tokens):
-                continue  # more chunks to go
-            self._commit_prefix(req)
-            req.transition(RequestState.DECODING)
-            if req.output_tokens:
-                # resumed after preemption: the recomputed KV now covers
-                # every fed token; the pending token was already sampled
-                # and streamed — never resample it
-                continue
-            self._emit(req, self._sample(req, logits[i, real - 1]))
+            self._post_prefill(req, real, logits[i, real - 1])
+
+    def _post_prefill(self, req: Request, real: int,
+                      last_row: np.ndarray) -> None:
+        """Consume one prefill chunk's last logits row — the
+        mode-agnostic half of a prefill step (both lowerings call
+        this)."""
+        if (req.computed_tokens + real >= len(req.tokens)
+                and not req.output_tokens
+                and not np.isfinite(last_row).all()):
+            # the final chunk samples the first token; with
+            # non-finite logits, skip the whole chunk (the KV it
+            # wrote is recomputed in place next step) rather than
+            # emit garbage.  Bounded: see _NONFINITE_SKIP_LIMIT.
+            self.nonfinite_events += 1
+            skips = self._nonfinite_skips.get(req.request_id, 0) + 1
+            self._nonfinite_skips[req.request_id] = skips
+            if skips <= _NONFINITE_SKIP_LIMIT:
+                return
+        req.computed_tokens += real
+        if req.computed_tokens < len(req.tokens):
+            return  # more chunks to go
+        self._commit_prefix(req)
+        req.transition(RequestState.DECODING)
+        if req.output_tokens:
+            # resumed after preemption: the recomputed KV now covers
+            # every fed token; the pending token was already sampled
+            # and streamed — never resample it
+            return
+        self._emit(req, self._sample(req, last_row))
 
     def _commit_prefix(self, req: Request) -> None:
         full = req.num_prompt_tokens // self.config.page_size

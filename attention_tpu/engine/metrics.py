@@ -48,6 +48,12 @@ _TTFT = obs.histogram("engine.request.ttft_steps",
 _TPOT = obs.histogram("engine.request.tpot_steps",
                       "mean steps per output token after the first",
                       buckets=(1, 1.5, 2, 3, 4, 8, 16, 32))
+_PAD_TOKENS = obs.counter(
+    "engine.step.pad_tokens",
+    "pad tokens dispatched (packed/padded width minus real tokens)")
+_RAGGED_OCC = obs.gauge(
+    "engine.step.ragged_occupancy",
+    "real-token fraction of the last non-empty step's launch width")
 
 
 @dataclasses.dataclass
@@ -71,6 +77,10 @@ class StepMetrics:
     page_utilization: float = 0.0
     prefix_hit_tokens_total: int = 0  # cumulative
     preemptions_total: int = 0        # cumulative
+    pad_tokens: int = 0              # pads dispatched this step
+    baseline_pad_tokens: int = 0     # what the two-call lowering pads
+    ragged_occupancy: float = 0.0    # real / dispatched width
+    host_overhead_s: float = 0.0     # wall minus the logits device sync
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -137,6 +147,10 @@ class EngineMetrics:
             _PAGES_USED.set(m.used_pages)
             _PAGES_FREE.set(m.free_pages)
             _STEP_WALL.observe(m.wall_s * 1e3)
+            if m.pad_tokens:
+                _PAD_TOKENS.inc(m.pad_tokens)
+            if m.decode_tokens or m.prefill_tokens:
+                _RAGGED_OCC.set(m.ragged_occupancy)
 
     def record_request(self, m: RequestMetrics) -> None:
         self.requests.append(m)
@@ -179,6 +193,15 @@ class EngineMetrics:
                 4),
             "preemptions": self.steps[-1].preemptions_total
             if self.steps else 0,
+            "pad_tokens_total": sum(s.pad_tokens for s in self.steps),
+            "baseline_pad_tokens_total": sum(
+                s.baseline_pad_tokens for s in self.steps),
+            "mean_ragged_occupancy": round(
+                sum(s.ragged_occupancy for s in busy) / len(busy), 4)
+            if busy else 0.0,
+            "mean_host_overhead_ms": round(
+                sum(s.host_overhead_s for s in busy) * 1e3 / len(busy),
+                3) if busy else 0.0,
         }
 
     def to_run_record(self, *, config: str = "engine-serve",

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 
+import numpy as np
+
 from attention_tpu import obs
 from attention_tpu.engine.allocator import BlockAllocator, pages_for_tokens
 from attention_tpu.engine.request import Request, RequestState
@@ -40,14 +42,40 @@ _ADMIT_WAITS = obs.counter(
 
 
 @dataclasses.dataclass
+class PackedBatch:
+    """One step's work flattened onto a single padded token axis — the
+    host-side image of `ops.ragged_paged.RaggedPagedStep`.
+
+    ``tokens`` (1, width) int32 feeds the model in one launch;
+    ``token_slot``/``token_pos`` (width,) map each packed token to its
+    owning request slot (-1 = pad) and absolute cache position;
+    ``kv_lens`` (slots,) / ``cu_q_lens`` (slots+1,) / ``tables``
+    (slots, table_width) / ``distribution`` (2,) are the kernel's
+    scalar-prefetch operands.  Decode slots come first (the
+    ``distribution`` contract); ``num_real`` real tokens occupy the
+    packed prefix, the remaining ``width - num_real`` are pad."""
+
+    tokens: np.ndarray
+    token_slot: np.ndarray
+    token_pos: np.ndarray
+    kv_lens: np.ndarray
+    cu_q_lens: np.ndarray
+    tables: np.ndarray
+    distribution: np.ndarray
+    width: int
+    num_real: int
+
+
+@dataclasses.dataclass
 class ScheduledStep:
     """One step's batch composition (what the engine will lower onto
     kernel calls) plus the events the metrics layer records."""
 
     step: int
     decode: list[Request] = dataclasses.field(default_factory=list)
-    # (request, real tokens of this chunk) — the kernel call pads every
-    # chunk to the configured prefill_chunk for shape stability
+    # (request, real tokens of this chunk) — the two-call engine pads
+    # every chunk to the configured prefill_chunk for shape stability;
+    # the ragged engine packs the real tokens via `pack`
     prefill: list[tuple[Request, int]] = dataclasses.field(
         default_factory=list
     )
@@ -65,6 +93,61 @@ class ScheduledStep:
     @property
     def is_empty(self) -> bool:
         return not self.decode and not self.prefill
+
+    def pack(self, *, width: int, slots: int, table_width: int,
+             staged_rows: dict | None = None) -> PackedBatch:
+        """Flatten this step onto one padded token axis, decode slots
+        first then prefill chunks, each request's tokens contiguous.
+
+        CONSUMES pending decode tokens (`Request.feed_pending`) — call
+        at most once per step, from the engine's dispatch path.
+
+        ``staged_rows`` (optional ``{request_id: (num_pages, row)}``)
+        reuses page-table rows staged by the async loop while the
+        previous step ran on device; a row is taken only when the
+        request's page count is unchanged, so the packed operands are
+        bit-identical to a cold rebuild."""
+        items = [(r, 1) for r in self.decode] + list(self.prefill)
+        total = self.num_decode_tokens + self.num_prefill_tokens
+        if len(items) > slots:
+            raise ValueError(
+                f"step has {len(items)} requests but only {slots} slots"
+            )
+        if total > width:
+            raise ValueError(
+                f"step has {total} tokens but packed width is {width}"
+            )
+        tokens = np.zeros((1, width), np.int32)
+        token_slot = np.full((width,), -1, np.int32)
+        token_pos = np.zeros((width,), np.int32)
+        kv_lens = np.zeros((slots,), np.int32)
+        cu = np.zeros((slots + 1,), np.int32)
+        tables = np.full((slots, table_width), -1, np.int32)
+        num_decode = len(self.decode)
+        off = 0
+        for s, (req, n) in enumerate(items):
+            c = req.computed_tokens
+            if s < num_decode:
+                tokens[0, off] = req.feed_pending()
+            else:
+                tokens[0, off:off + n] = req.tokens[c:c + n]
+            token_slot[off:off + n] = s
+            token_pos[off:off + n] = np.arange(c, c + n)
+            kv_lens[s] = c
+            staged = (staged_rows or {}).get(req.request_id)
+            if staged is not None and staged[0] == len(req.pages):
+                tables[s] = staged[1]
+            else:
+                tables[s, :len(req.pages)] = req.pages
+            off += n
+            cu[s + 1] = off
+        cu[len(items) + 1:] = off
+        return PackedBatch(
+            tokens=tokens, token_slot=token_slot, token_pos=token_pos,
+            kv_lens=kv_lens, cu_q_lens=cu, tables=tables,
+            distribution=np.asarray([num_decode, len(items)], np.int32),
+            width=width, num_real=total,
+        )
 
 
 class Scheduler:

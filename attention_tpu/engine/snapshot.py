@@ -209,6 +209,10 @@ def _request_from_dict(d: dict) -> Request:
 
 
 def _serialize_sections(engine: ServingEngine) -> list[tuple[str, bytes]]:
+    # a snapshot cut must not capture a half-staged async step: settle
+    # the double buffer (drop staged page-table rows, block until the
+    # device pools are final) before reading any bytes out
+    engine.quiesce()
     cfg = dataclasses.asdict(engine.config)
     if cfg["cache_dtype"] is not None:
         cfg["cache_dtype"] = _dtype_name(cfg["cache_dtype"])

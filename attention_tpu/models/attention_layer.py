@@ -17,6 +17,11 @@ import jax.numpy as jnp
 
 from attention_tpu.ops.decode import flash_decode
 from attention_tpu.ops.paged import PagedKV, paged_append, paged_flash_decode
+from attention_tpu.ops.ragged_paged import (
+    RaggedPagedStep,
+    ragged_paged_append,
+    ragged_paged_attention,
+)
 from attention_tpu.ops.flash import flash_attention
 from attention_tpu.ops.flash_vjp import flash_attention_diff
 from attention_tpu.ops.quant import (
@@ -323,14 +328,19 @@ class GQASelfAttention(nn.Module):
             # rotate BEFORE caching: keys are stored already-rotated at
             # their absolute positions (scores depend only on relative
             # position, so cached history never needs re-rotation)
-            off = jnp.asarray(
-                0 if cache is None else cache.length, jnp.int32
-            )
-            base = jnp.arange(x.shape[1], dtype=jnp.int32)
-            if off.ndim:  # ragged: (B,) offsets -> (B, 1, S) positions
-                pos = (off[:, None] + base[None, :])[:, None, :]
+            if isinstance(cache, RaggedPagedStep):
+                # packed step: every token carries its own absolute
+                # position (mixed decode/prefill share one axis)
+                pos = cache.token_pos[None, None, :]
             else:
-                pos = off + base
+                off = jnp.asarray(
+                    0 if cache is None else cache.length, jnp.int32
+                )
+                base = jnp.arange(x.shape[1], dtype=jnp.int32)
+                if off.ndim:  # ragged: (B,) offsets -> (B, 1, S) positions
+                    pos = (off[:, None] + base[None, :])[:, None, :]
+                else:
+                    pos = off + base
             q = apply_rope(q, pos, self.rope_theta)
             k = apply_rope(k, pos, self.rope_theta)
         if self.window is not None:
@@ -393,6 +403,8 @@ class GQASelfAttention(nn.Module):
             out, cache = self._quantized_decode(q, k, v, cache)
         elif isinstance(cache, RaggedKVCache):
             out, cache = self._ragged_attention(q, k, v, cache)
+        elif isinstance(cache, RaggedPagedStep):
+            out, cache = self._ragged_paged_step(q, k, v, cache)
         elif isinstance(cache, PagedKV):
             out, cache = self._paged_attention(q, k, v, cache)
         elif isinstance(cache, RollingKVCache):
@@ -636,6 +648,37 @@ class GQASelfAttention(nn.Module):
         over = new_lengths > cache.k.shape[2]
         out = jnp.where(over[:, None, None, None], jnp.nan, out)
         return out.astype(q.dtype), RaggedKVCache(kc, vc, new_lengths)
+
+    def _ragged_paged_step(self, q, k, v, cache: RaggedPagedStep):
+        """One packed serving step: every request's tokens for this
+        step — one per decode, a chunk per prefill — ride a single
+        token axis and lower onto ONE ragged kernel launch (append
+        through the per-slot page tables, then
+        `ops.ragged_paged.ragged_paged_attention`)."""
+        if self.impl != "flash":
+            raise ValueError(
+                f"impl {self.impl!r} has no ragged paged-step path "
+                "(supported: ['flash'])"
+            )
+        if self.tp_axis is not None:
+            raise ValueError(
+                "the ragged packed step has no head-sharded form yet; "
+                "serve tensor-parallel engines with "
+                "step_mode='two_call'"
+            )
+        if self.rope and self.attn_sinks and self.window is not None:
+            raise ValueError(
+                "rope+sinks needs the per-sequence rotated sink read "
+                "copy (paged_sink_decode), which the packed step does "
+                "not carry; serve such models with "
+                "step_mode='two_call'"
+            )
+        cache = ragged_paged_append(cache, k, v)
+        out = ragged_paged_attention(
+            q, cache, softcap=self.softcap, window=self.window,
+            sinks=self.attn_sinks or None,
+        )
+        return out.astype(q.dtype), cache
 
     def _paged_attention(self, q, k, v, cache: PagedKV):
         """S == 1: one decode step per sequence through the page table.

@@ -1,0 +1,459 @@
+"""Ragged paged attention: ONE kernel launch for a mixed decode/prefill step.
+
+The serving engine used to lower every step onto two fixed-shape calls —
+decode ``(D, 1)`` + prefill-chunk ``(P, S)`` — padded with inactive
+poison rows.  This kernel serves the whole step in a single launch over
+a PACKED token axis (the tpu_commons ``ragged_paged_attention`` shape):
+
+  * every real token of the step — one per decode request, ``real`` per
+    prefill chunk — sits consecutively on one axis of width ``T``;
+  * ``cu_q_lens`` (S+1,) delimits each request's token span,
+    ``kv_lens`` (S,) holds each request's post-append KV length, and
+    ``distribution`` (2,) = (num_decode, num_active) carries the
+    decode/prefill split;
+  * each request reads KV through its own page-table row, causal within
+    the request: the token at span offset ``s`` attends cache positions
+    ``<= kv_len - q_len + s``.
+
+The grid is ``(Hkv * S, max_pages)`` — request-slot minor, kv-head
+major — so one head's packed output block stays VMEM-resident while
+every slot accumulates into its own row span (slots never overlap rows,
+so the read-modify-write at finalize composes).  Per-slot KV pages
+translate through the scalar-prefetched page table exactly like
+`ops.paged`; clamped indices make Pallas elide the DMAs of inactive
+slots and past-the-prefix pages, so pad SLOTS cost nothing — the pad
+waste of a step is just ``T - total_real`` bucketed tokens, not
+``(D - d) + (P*S - real)`` poison rows.
+
+Static tile discipline: the per-request query tile is ``q_tile`` tokens
+(>= the longest span; the engine buckets it to a power of two), and
+``T`` is pow2-bucketed, so the whole serving life compiles O(log)
+executables instead of one per (D, P) composition — the no-recompile-
+cliff property the two fixed shapes bought, kept.
+
+``q_tile`` rides in the SHAPE of the cache's ``q_span`` marker field
+(shapes are static under jit, values are not) so the engine can pick
+the tile per step without threading a static argument through
+``model.apply``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attention_tpu import obs
+from attention_tpu.ops.decode import (
+    banded_block_clamp,
+    banded_live,
+    check_band,
+)
+from attention_tpu.ops.flash import (
+    _LOG2E,
+    _STAT_LANES,
+    NEG_INF,
+    _compiler_params,
+    _online_softmax_update,
+    _should_interpret,
+    check_softcap,
+)
+
+# Op-dispatch telemetry (attention_tpu.obs, off by default): one tick
+# per host-side dispatch; calls inside an enclosing jit tick per trace.
+_RAGGED_CALLS = obs.counter(
+    "ops.ragged.calls",
+    "ragged paged-attention dispatches by (tokens, capacity, dim) bucket")
+
+
+class RaggedPagedStep(NamedTuple):
+    """One packed engine step over the shared page pool.
+
+    ``k_pool``/``v_pool``: (P, Hkv, page_size, d) — the same pools the
+    two-call engine steps.  ``page_table``: (S, max_pages) int32, one
+    row per request SLOT (inactive slots all -1).  ``kv_lens``: (S,)
+    int32 valid cache tokens per slot — PRE-append when handed to
+    `ragged_paged_append`, post-append after it (-1 = poisoned).
+    ``cu_q_lens``: (S+1,) int32 cumulative token spans; slot ``s`` owns
+    packed tokens ``[cu[s], cu[s+1])``.  ``distribution``: (2,) int32
+    (num_decode_slots, num_active_slots); decode slots come first.
+    ``token_pos``: (T,) int32 absolute cache position of each packed
+    token (drives RoPE and the append scatter).  ``token_slot``: (T,)
+    int32 owning slot per token, -1 for pad tokens.  ``q_span``: a
+    (q_tile,) int32 zeros marker whose SHAPE carries the static
+    per-request query-tile width (values unused).
+    """
+
+    k_pool: jax.Array
+    v_pool: jax.Array
+    page_table: jax.Array
+    kv_lens: jax.Array
+    cu_q_lens: jax.Array
+    distribution: jax.Array
+    token_pos: jax.Array
+    token_slot: jax.Array
+    q_span: jax.Array
+
+    @property
+    def length(self):
+        """Per-slot lengths (uniform name across cache types)."""
+        return self.kv_lens
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pool.shape[2]
+
+    @property
+    def q_tile(self) -> int:
+        return self.q_span.shape[0]
+
+    @property
+    def max_tokens(self) -> int:
+        return self.page_table.shape[1] * self.page_size
+
+
+def packed_bucket(n_tokens: int, *, minimum: int = 8) -> int:
+    """Packed-axis width for ``n_tokens`` real tokens: the next power
+    of two (>= ``minimum``), so the number of distinct jit signatures
+    over a serving life is O(log max_tokens) instead of one per batch
+    composition."""
+    if n_tokens < 0:
+        raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
+    w = max(minimum, 1)
+    while w < n_tokens:
+        w *= 2
+    return w
+
+
+def tile_tokens(max_q_len: int, group: int) -> int:
+    """Smallest query tile (in tokens) covering ``max_q_len`` whose row
+    count ``tile * group`` hits the fp32 sublane granule (8)."""
+    if group < 1:
+        raise ValueError(f"group must be >= 1, got {group}")
+    t = max(int(max_q_len), 1)
+    while (t * group) % 8:
+        t += 1
+    return t
+
+
+def recommended_q_tile(max_q_len: int, group: int, *, heads: int = 1,
+                       kv_heads: int | None = None, seq: int = 0,
+                       dim: int = 0, batch: int = 1,
+                       dtype=None) -> int:
+    """Static query-tile width (tokens) for a step whose longest span
+    is ``max_q_len``: pow2-bucketed for jit-signature reuse, sublane-
+    aligned, optionally widened toward the tuned ``ragged`` family
+    ``block_q`` row count when the measured-dispatch tables ship one."""
+    t = packed_bucket(max_q_len, minimum=1)
+    try:
+        from attention_tpu.tuning.lookup import key_fields, lookup
+
+        entry = lookup(
+            "ragged", dtype=dtype,
+            **key_fields("ragged", heads=heads, kv_heads=kv_heads,
+                         seq=seq, dim=dim, batch=batch),
+        )
+        if entry is not None:
+            cap = int(entry["block_q"]) // max(group, 1)
+            if cap >= max_q_len:
+                t = min(t, cap)
+    except Exception:  # noqa: BLE001 - tuning must never break dispatch
+        pass
+    return tile_tokens(t, group)
+
+
+def _ragged_kernel(
+    lens_ref, cu_ref, dist_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+    acc_scr, m_scr, l_scr,
+    *, s_slots: int, group: int, page: int, q_tile: int, t_pad: int,
+    softcap2, window: int | None, sinks: int | None,
+):
+    """One (kv-head * slot, logical-page) grid step.
+
+    The output block is the head's FULL packed row axis, index-mapped
+    constant over (slot, page), so it stays VMEM-resident while every
+    slot finalizes its own row span into it — the single-launch analog
+    of one out-block per decode row.  Slot spans never overlap, and the
+    grid is sequential over slots ("arbitrary" semantics), so the
+    masked read-modify-write at finalize is race-free."""
+    rh = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    r = jax.lax.rem(rh, s_slots)
+    q_rows = q_tile * group
+    raw_len = lens_ref[r]
+    kv_len = jnp.maximum(raw_len, 0)  # poisoned slots read nothing
+    q_start = cu_ref[r]
+    q_len = cu_ref[r + 1] - q_start
+    active = jnp.logical_and(r < dist_ref[1], q_len > 0)
+    # tile start: the span head, clamped so the tile stays in-bounds
+    # (q_len <= q_tile by the caller contract, so the span always fits)
+    clamp = jnp.minimum(q_start, t_pad - q_tile)
+    # the band must admit the EARLIEST query row's window; per-row
+    # exactness comes from the mask below (the decode kernels' chunk rule)
+    w_eff = (window + q_tile - 1) if window is not None else None
+
+    @pl.when(jnp.logical_and(r == 0, j == 0))
+    def _zero_out():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = jnp.logical_and(active,
+                           banded_live(j, kv_len, page, w_eff, sinks))
+
+    @pl.when(live)
+    def _tile():
+        qb = q_ref[0, pl.ds(clamp * group, q_rows), :]
+        s = jax.lax.dot_general(
+            qb, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (q_rows, page), log2-domain (q pre-scaled by scale*log2e)
+        if softcap2 is not None:
+            s = softcap2 * jnp.tanh(s / softcap2)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        seg = clamp + row // group - q_start   # span offset per row
+        pos = kv_len - q_len + seg             # absolute cache position
+        col = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = jnp.logical_and(
+            jnp.logical_and(seg >= 0, seg < q_len), col <= pos
+        )
+        if window is not None:
+            win = col >= pos - (window - 1)
+            if sinks is not None:
+                win = jnp.logical_or(win, col < sinks)
+            mask = jnp.logical_and(mask, win)
+        s = jnp.where(mask, s, NEG_INF)
+        p, corr = _online_softmax_update(s, m_scr, l_scr, masked=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr + pv
+
+    @pl.when(jnp.logical_and(j == num_j - 1, active))
+    def _finalize():
+        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        res = acc_scr[...] / l_safe
+        # poisoned slots (bad append, length -1) emit NaN, loudly
+        res = jnp.where(raw_len < 0, jnp.nan, res)
+        row = jax.lax.broadcasted_iota(jnp.int32, res.shape, 0)
+        seg = clamp + row // group - q_start
+        mine = jnp.logical_and(seg >= 0, seg < q_len)
+        cur = o_ref[0, pl.ds(clamp * group, q_rows), :]
+        o_ref[0, pl.ds(clamp * group, q_rows), :] = jnp.where(
+            mine, res, cur.astype(jnp.float32)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "interpret", "softcap", "window", "sinks"),
+)
+def _ragged_paged_attention_jit(
+    q: jax.Array,            # (1, Hq, T, d) packed token axis
+    cache: RaggedPagedStep,
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+) -> jax.Array:
+    """softmax(q K^T * scale) V for every packed token through its
+    slot's page table, causal within each request — (1, Hq, T, dv).
+
+    ``kv_lens`` must be POST-append (run `ragged_paged_append` first);
+    pad tokens return zeros, poisoned slots NaN.  ``window``/``sinks``
+    are the decode kernels' per-request logical band, applied before
+    page translation so out-of-window pages never DMA."""
+    check_softcap(softcap)
+    check_band(window, sinks)
+    if q.ndim != 4 or q.shape[0] != 1:
+        raise ValueError(
+            f"packed q must be (1, Hq, T, d), got {q.shape}"
+        )
+    _, h, t_pad, d = q.shape
+    p_, hkv, page, dk = cache.k_pool.shape
+    dv = cache.v_pool.shape[-1]
+    s_slots, max_pages = cache.page_table.shape
+    if dk != d or cache.v_pool.shape[:3] != (p_, hkv, page):
+        raise ValueError(
+            f"ragged cache shapes inconsistent: Q{q.shape} "
+            f"K{cache.k_pool.shape} V{cache.v_pool.shape}"
+        )
+    if cache.cu_q_lens.shape != (s_slots + 1,):
+        raise ValueError(
+            f"cu_q_lens {cache.cu_q_lens.shape} must be "
+            f"({s_slots + 1},) for a {s_slots}-slot table"
+        )
+    if page % 128:
+        raise ValueError(f"page_size {page} must be a multiple of 128")
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    group = h // hkv
+    q_tile = cache.q_tile
+    if (t_pad * group) % 8 or (q_tile * group) % 8:
+        raise ValueError(
+            f"packed width {t_pad} and q_tile {q_tile} must keep "
+            f"token*group row counts 8-aligned (group {group}); use "
+            "packed_bucket/tile_tokens"
+        )
+    if q_tile > t_pad:
+        raise ValueError(f"q_tile {q_tile} > packed width {t_pad}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _should_interpret()
+
+    lens = jnp.asarray(cache.kv_lens, jnp.int32)
+    cu = jnp.asarray(cache.cu_q_lens, jnp.int32)
+    dist = jnp.asarray(cache.distribution, jnp.int32)
+    # token-major packed rows: row = token * group + group_head, so a
+    # span's rows are contiguous and the per-slot tile is one dynamic
+    # sublane slice
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    qs = qs[0].reshape(hkv, group, t_pad, d).transpose(0, 2, 1, 3)
+    qs = qs.reshape(hkv, t_pad * group, d)
+    w_eff = (window + q_tile - 1) if window is not None else None
+
+    def kv_index(rh, j, lens_ref, cu_ref, dist_ref, tbl_ref):
+        # LOGICAL-page clamp (past-the-prefix, and below-the-band with
+        # a window), THEN page translation, all on prefetched scalars:
+        # repeated physical indices make Pallas elide the DMA — pad
+        # slots (length 0) pin to one page and never re-fetch.
+        r = jax.lax.rem(rh, s_slots)
+        valid = jnp.maximum(lens_ref[r], 0)
+        jj = banded_block_clamp(j, valid, page, w_eff, sinks)
+        return (jnp.maximum(tbl_ref[r, jj], 0), rh // s_slots, 0, 0)
+
+    q_rows = q_tile * group
+    kernel = functools.partial(
+        _ragged_kernel, s_slots=s_slots, group=group, page=page,
+        q_tile=q_tile, t_pad=t_pad,
+        softcap2=None if softcap is None else softcap * _LOG2E,
+        window=window, sinks=sinks,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(hkv * s_slots, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, t_pad * group, d),
+                         lambda rh, j, lr, cr, dr, tr: (rh // s_slots,
+                                                        0, 0)),
+            pl.BlockSpec((1, 1, page, d), kv_index),
+            pl.BlockSpec((1, 1, page, dv), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, t_pad * group, dv),
+                         lambda rh, j, lr, cr, dr, tr: (rh // s_slots,
+                                                        0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((q_rows, dv), jnp.float32),
+            pltpu.VMEM((q_rows, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((q_rows, _STAT_LANES), jnp.float32),
+        ],
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hkv, t_pad * group, dv),
+                                 cache.v_pool.dtype),
+        ],
+        # NOT parallel: every slot of one head accumulates into the
+        # same resident output block
+        compiler_params=_compiler_params(("arbitrary", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * hkv * s_slots * q_rows * max_pages * page
+            * (d + dv),
+            bytes_accessed=hkv * s_slots * max_pages * page * (d + dv)
+            * cache.k_pool.dtype.itemsize + qs.size * qs.dtype.itemsize,
+            transcendentals=hkv * s_slots * q_rows * max_pages * page,
+        ),
+        interpret=interpret,
+    )(lens, cu, dist, cache.page_table, qs, cache.k_pool, cache.v_pool)
+    out = outs[0] if isinstance(outs, (list, tuple)) else outs
+    out = out.reshape(hkv, t_pad, group, dv).transpose(0, 2, 1, 3)
+    return out.reshape(1, h, t_pad, dv)
+
+
+def ragged_paged_attention(q: jax.Array, cache: RaggedPagedStep,
+                           **kwargs) -> jax.Array:
+    """Ragged paged attention (telemetry shim; full docs on
+    :func:`_ragged_paged_attention_jit`)."""
+    if obs.is_enabled():
+        _RAGGED_CALLS.inc(
+            bucket=obs.shape_bucket(q.shape[2], cache.max_tokens,
+                                    q.shape[-1]))
+    return _ragged_paged_attention_jit(q, cache, **kwargs)
+
+
+def ragged_paged_append(cache: RaggedPagedStep, k_new: jax.Array,
+                        v_new: jax.Array) -> RaggedPagedStep:
+    """Write every packed token's K/V row (k/v (1, Hkv, T, d)) at its
+    slot's next positions; returns the cache with post-append lengths.
+
+    One vectorized drop-mode scatter over the token axis — the packed
+    analog of `ops.paged.paged_append`, with the same poison contract:
+    a token targeting an unclaimed (-1) table entry or past the table's
+    capacity writes NOTHING and marks its whole SLOT's length -1
+    (sticky; the attention kernel then emits NaN for that slot's
+    tokens).  Pad tokens (slot -1) always drop, silently."""
+    page = cache.page_size
+    t = k_new.shape[2]
+    if (k_new.ndim != 4 or v_new.ndim != 4
+            or k_new.shape[:3] != v_new.shape[:3]
+            or k_new.shape[0] != 1
+            or t != cache.token_slot.shape[0]):
+        raise ValueError(
+            f"expected (1, Hkv, {cache.token_slot.shape[0]}, d) packed "
+            f"rows: K{k_new.shape} V{v_new.shape}"
+        )
+    s_slots, max_pages = cache.page_table.shape
+    slot = jnp.asarray(cache.token_slot, jnp.int32)
+    pos = jnp.asarray(cache.token_pos, jnp.int32)
+    safe_slot = jnp.maximum(slot, 0)
+    logical = pos // page
+    phys = cache.page_table[safe_slot,
+                            jnp.minimum(logical, max_pages - 1)]
+    bad = ((phys < 0)
+           | (logical >= max_pages)
+           | (cache.kv_lens[safe_slot] < 0))
+    drop = jnp.logical_or(bad, slot < 0)
+    # drop-mode scatter: dropped tokens target one-past-the-end (a
+    # positive sentinel — negative indices would WRAP before the check)
+    tgt = jnp.where(drop, cache.k_pool.shape[0], phys)
+    k_rows = k_new[0].transpose(1, 0, 2).astype(cache.k_pool.dtype)
+    v_rows = v_new[0].transpose(1, 0, 2).astype(cache.v_pool.dtype)
+    k_pool = cache.k_pool.at[tgt, :, pos % page].set(k_rows, mode="drop")
+    v_pool = cache.v_pool.at[tgt, :, pos % page].set(v_rows, mode="drop")
+    # per-slot sticky poison: any bad REAL token condemns its slot
+    bad_slot = jnp.zeros((s_slots + 1,), jnp.bool_).at[
+        jnp.where(slot < 0, s_slots, slot)
+    ].max(bad, mode="drop")[:s_slots]
+    q_lens = cache.cu_q_lens[1:] - cache.cu_q_lens[:-1]
+    new_lens = jnp.where(bad_slot | (cache.kv_lens < 0), -1,
+                         cache.kv_lens + q_lens)
+    return cache._replace(k_pool=k_pool, v_pool=v_pool,
+                          kv_lens=new_lens)
+
+
+__all__ = [
+    "RaggedPagedStep",
+    "ragged_paged_attention",
+    "ragged_paged_append",
+    "packed_bucket",
+    "tile_tokens",
+    "recommended_q_tile",
+]

@@ -140,3 +140,86 @@ def attention_xla_partials(
             preferred_element_type=jnp.float32,
         )
     return out_unnorm.astype(jnp.float32), row_max, row_sum
+
+
+def ragged_paged_reference(
+    q,
+    k_pool,
+    v_pool,
+    page_table,
+    kv_lens,
+    cu_q_lens,
+    distribution,
+    *,
+    scale: float | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+):
+    """fp64 NumPy oracle for `ops.ragged_paged.ragged_paged_attention`.
+
+    Same packed contract: ``q`` (1, Hq, T, d) with per-request spans
+    delimited by ``cu_q_lens`` (S+1,), per-slot POST-append ``kv_lens``
+    (S,) read through ``page_table`` (S, max_pages) rows of the
+    (P, Hkv, page, d) pools, ``distribution`` (2,) = (num_decode,
+    num_active).  A span's token at offset ``s`` attends cache
+    positions ``<= kv_len - q_len + s`` (optionally banded to the last
+    ``window`` positions plus ``sinks`` leading ones).  Pad tokens
+    return zeros; a poisoned slot (kv_len < 0) returns NaN rows.
+    Everything runs in float64 off-device — the ground truth the chaos
+    fuzzer and the tier-1 kernel tests scan against.
+    """
+    import numpy as np
+
+    check_softcap(softcap)
+    q = np.asarray(q, np.float64)
+    k_pool = np.asarray(k_pool, np.float64)
+    v_pool = np.asarray(v_pool, np.float64)
+    page_table = np.asarray(page_table)
+    kv_lens = np.asarray(kv_lens)
+    cu_q_lens = np.asarray(cu_q_lens)
+    num_active = int(np.asarray(distribution)[1])
+    _, hq, t_pad, d = q.shape
+    hkv, page = k_pool.shape[1], k_pool.shape[2]
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    out = np.zeros((1, hq, t_pad, v_pool.shape[-1]), np.float64)
+    for r in range(num_active):
+        q_start, q_end = int(cu_q_lens[r]), int(cu_q_lens[r + 1])
+        q_len = q_end - q_start
+        if q_len <= 0:
+            continue
+        kv_len = int(kv_lens[r])
+        if kv_len < 0:
+            out[0, :, q_start:q_end] = np.nan
+            continue
+        num_pages = -(-kv_len // page)
+        rows = np.concatenate(
+            [k_pool[page_table[r, p]] for p in range(num_pages)], axis=1
+        ) if num_pages else np.zeros((hkv, 0, d))
+        vrows = np.concatenate(
+            [v_pool[page_table[r, p]] for p in range(num_pages)], axis=1
+        ) if num_pages else np.zeros((hkv, 0, v_pool.shape[-1]))
+        rows, vrows = rows[:, :kv_len], vrows[:, :kv_len]
+        pos = kv_len - q_len + np.arange(q_len)          # (q_len,)
+        col = np.arange(kv_len)                          # (kv_len,)
+        mask = col[None, :] <= pos[:, None]
+        if window is not None:
+            band = col[None, :] >= pos[:, None] - (window - 1)
+            if sinks is not None:
+                band |= col[None, :] < sinks
+            mask &= band
+        for h in range(hq):
+            s = rows[h // group] @ q[0, h, q_start:q_end].T * scale
+            s = s.T                                      # (q_len, kv_len)
+            if softcap is not None:
+                s = softcap * np.tanh(s / softcap)
+            s = np.where(mask, s, -np.inf)
+            m = np.max(s, axis=-1, keepdims=True)
+            m = np.where(np.isfinite(m), m, 0.0)
+            p = np.exp(s - m)
+            z = np.sum(p, axis=-1, keepdims=True)
+            z = np.where(z == 0.0, 1.0, z)
+            out[0, h, q_start:q_end] = (p / z) @ vrows[h // group]
+    return out

@@ -47,7 +47,8 @@ import tempfile
 
 SCHEMA_VERSION = 1
 
-KERNELS = ("flash_fwd", "flash_bwd", "flash_bwd_fused", "decode", "paged")
+KERNELS = ("flash_fwd", "flash_bwd", "flash_bwd_fused", "decode", "paged",
+           "ragged")
 
 _TILE_FIELDS = ("block_q", "block_k", "page_size")
 

@@ -54,8 +54,8 @@ def key_fields(kernel: str, *, heads=1, kv_heads=None, seq=0, dim=0,
     m=n=seq, d, causal/stats/window-bucket); the backward families are
     head- and causal-generic (measured: the defaults hold across h and
     the causal band, RESULTS.md r2/r4) and key on (m=n=seq, d,
-    window-bucket); decode/paged key on (GQA group, m=batch,
-    n=cache capacity, d, sinks/window-bucket).
+    window-bucket); decode/paged/ragged key on (GQA group, m=batch
+    (ragged: active slots), n=cache capacity, d, sinks/window-bucket).
     """
     wb = window_bucket(window)
     if kernel == "flash_fwd":
@@ -64,7 +64,7 @@ def key_fields(kernel: str, *, heads=1, kv_heads=None, seq=0, dim=0,
                            "stats": int(bool(stats)), "window": wb})
     if kernel in ("flash_bwd", "flash_bwd_fused"):
         return dict(g=1, m=seq, n=seq, d=dim, flags={"window": wb})
-    if kernel in ("decode", "paged"):
+    if kernel in ("decode", "paged", "ragged"):
         group = heads // (kv_heads or heads)
         return dict(g=group, m=batch, n=seq, d=dim,
                     flags={"sinks": int(bool(sinks)), "window": wb})

@@ -42,6 +42,10 @@ DECODE_BLOCK_K = (256, 512, 1024, 2048, 4096, 8192)
 # Physical page sizes for the paged decode kernel.
 PAGED_PAGE_SIZES = (128, 256, 512, 1024, 2048, 4096)
 
+# Query-tile ROW counts (q_tile tokens x GQA group) for the ragged
+# packed-step kernel; the engine divides by the group to get tokens.
+RAGGED_BLOCK_Q = (128, 256, 512)
+
 
 def _ceil_to(x: int, mult: int) -> int:
     return -(-x // mult) * mult
@@ -68,6 +72,9 @@ def candidates(kernel: str, *, m: int, n: int, d: int,
             if n % bk == 0]
     elif kernel == "paged":
         return [p for p in PAGED_PAGE_SIZES if n % p == 0]
+    elif kernel == "ragged":
+        return [bq for bq in dict.fromkeys(
+            min(bq, _ceil_to(m, 128)) for bq in RAGGED_BLOCK_Q)]
     else:
         raise ValueError(f"unknown kernel family {kernel!r}")
     m_pad = _ceil_to(m, 128)

@@ -621,6 +621,16 @@ def _bench_engine(args) -> dict:
             "engine_wall_s": round(engine_s, 3),
             "sequential_wall_s": round(sequential_s, 3),
             "output_tokens": out_tokens,
+            # ragged single-launch packing economics: pads actually
+            # dispatched vs what the two-call lowering would have padded
+            # on the identical schedule, plus the host-side staging cost
+            "pad_tokens_total": summary.get("pad_tokens_total", 0),
+            "baseline_pad_tokens_total": summary.get(
+                "baseline_pad_tokens_total", 0),
+            "mean_ragged_occupancy": summary.get(
+                "mean_ragged_occupancy", 0.0),
+            "mean_host_overhead_ms": summary.get(
+                "mean_host_overhead_ms", 0.0),
             "summary": summary,
             "per_step": [m.to_dict() for m in engine.metrics.steps],
         },

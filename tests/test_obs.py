@@ -372,7 +372,7 @@ def test_engine_outputs_byte_identical_with_obs_on(tiny_model):
         assert counters["engine.steps.total"]["value"] > 0
         assert counters["engine.scheduler.admissions"]["value"] == 4
         assert counters["engine.requests.finished"]["value"] == 4
-        assert any(s["name"] == "ops.paged.calls"
+        assert any(s["name"] == "ops.ragged.calls"
                    for s in snap["counters"])
         span_names = {e["name"] for e in obs.events()}
         assert {"engine.step", "scheduler.admit",
