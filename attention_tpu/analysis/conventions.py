@@ -35,6 +35,10 @@ ATP501 = register_code(
     "ATP501", "obs-naming", Severity.ERROR,
     "literal telemetry name violates layer.component.verb "
     "(absorbed scripts/check_obs_names.py)")
+ATP504 = register_code(
+    "ATP504", "obs-trace-event", Severity.ERROR,
+    "literal trace event name outside the closed enum in "
+    "obs/naming.py (TRACE_EVENTS)")
 ATP502 = register_code(
     "ATP502", "shipped-table-schema", Severity.ERROR,
     "committed tuning table fails schema/key/tile validation "
@@ -49,11 +53,14 @@ ATP601 = register_code(
     "dropping (.pyc/.so/__pycache__)")
 
 
-# -- ATP501: telemetry naming ---------------------------------------------
+# -- ATP501/ATP504: telemetry + trace-event naming ------------------------
 
 #: call names whose first literal argument must be a telemetry name
-INSTRUMENT_CALLS = {"counter", "gauge", "histogram", "span",
+INSTRUMENT_CALLS = {"counter", "gauge", "histogram", "digest", "span",
                     "record_event"}
+
+#: call names whose second literal argument must be a trace event type
+TRACE_RECORD_CALLS = {"record"}
 
 _OBS_MSG = ("telemetry name {name!r} violates layer.component.verb "
             "(2-4 lowercase dot-separated [a-z][a-z0-9_]* segments)")
@@ -82,11 +89,49 @@ def obs_name_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
     return out
 
 
-@file_pass("obs-naming", [ATP501])
+_TRACE_MSG = ("trace event {event!r} is not in the closed enum "
+              "obs/naming.py:TRACE_EVENTS")
+
+
+def trace_event_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
+    """(line, col, event) for every unknown literal trace event name.
+
+    Matches calls named ``record`` (``trace.record(rid, "event", ...)``)
+    whose SECOND positional argument is a string literal — the event
+    type slot.  Dynamic event names are runtime-validated by
+    ``require_event`` in the recorder itself."""
+    from attention_tpu.obs.naming import check_event
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None)
+        if name not in TRACE_RECORD_CALLS or len(node.args) < 2:
+            continue
+        second = node.args[1]
+        if not (isinstance(second, ast.Constant)
+                and isinstance(second.value, str)):
+            continue
+        if not check_event(second.value):
+            out.append((node.lineno, node.col_offset, second.value))
+    return out
+
+
+@file_pass("obs-naming", [ATP501, ATP504])
 def check_obs_names(path: str, tree: ast.Module, src: str):
-    """Literal counter/gauge/histogram/span names follow the scheme."""
-    return [Finding(ATP501, _OBS_MSG.format(name=name), path, line, col)
-            for line, col, name in obs_name_violations(tree)]
+    """Literal instrument names and trace event types follow the scheme."""
+    findings = [
+        Finding(ATP501, _OBS_MSG.format(name=name), path, line, col)
+        for line, col, name in obs_name_violations(tree)]
+    findings += [
+        Finding(ATP504, _TRACE_MSG.format(event=event), path, line, col)
+        for line, col, event in trace_event_violations(tree)]
+    findings.sort(key=lambda f: (f.line, f.col))
+    return findings
 
 
 def legacy_obs_check_file(path: str) -> list[str]:
@@ -97,8 +142,11 @@ def legacy_obs_check_file(path: str) -> list[str]:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return [f"{path}: unparsable ({e})"]
-    return [f"{path}:{line}: " + _OBS_MSG.format(name=name)
-            for line, _col, name in obs_name_violations(tree)]
+    lines = [(line, col, _OBS_MSG.format(name=name))
+             for line, col, name in obs_name_violations(tree)]
+    lines += [(line, col, _TRACE_MSG.format(event=event))
+              for line, col, event in trace_event_violations(tree)]
+    return [f"{path}:{line}: {msg}" for line, _col, msg in sorted(lines)]
 
 
 # -- ATP502: shipped tuning table -----------------------------------------

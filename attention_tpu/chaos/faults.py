@@ -764,21 +764,28 @@ def run_frontend_plan(model, params, config: EngineConfig,
     enables token parity over finished requests.
     ``snapshot_roundtrip`` additionally pins invariant 7 on every
     surviving replica of a drained run (``restore(save(engine))``
-    state-identical)."""
-    from attention_tpu.frontend import ServingFrontend, replay_frontend
+    state-identical).
 
-    frontend = ServingFrontend(model, params, config, frontend_config)
-    injector = FrontendFaultInjector(frontend, plan)
-    error: BaseException | None = None
-    outputs: dict[str, list[int]] = {}
-    summary: dict[str, Any] = {}
-    try:
-        summary, outputs = replay_frontend(frontend, trace,
-                                           max_ticks=max_ticks)
-    except Exception as e:  # noqa: BLE001 - the typed-error invariant
-        error = e           # decides what may land here
-        outputs = frontend.outputs()
-    drained = error is None and not frontend.has_work()
+    The whole plan runs inside ``obs.trace.capture()`` so invariant 12
+    (trace completeness) has chains to judge even with telemetry off —
+    capture clears the store on entry, isolating each plan's chains."""
+    from attention_tpu.frontend import ServingFrontend, replay_frontend
+    from attention_tpu.obs import trace as obs_trace
+
+    with obs_trace.capture():
+        frontend = ServingFrontend(model, params, config,
+                                   frontend_config)
+        injector = FrontendFaultInjector(frontend, plan)
+        error: BaseException | None = None
+        outputs: dict[str, list[int]] = {}
+        summary: dict[str, Any] = {}
+        try:
+            summary, outputs = replay_frontend(frontend, trace,
+                                               max_ticks=max_ticks)
+        except Exception as e:  # noqa: BLE001 - the typed-error
+            error = e           # invariant decides what may land here
+            outputs = frontend.outputs()
+        drained = error is None and not frontend.has_work()
 
     from attention_tpu.frontend.frontend import FrontendRequestState
 
@@ -808,6 +815,10 @@ def run_frontend_plan(model, params, config: EngineConfig,
     violations += inv.termination_violations(drained, error,
                                              max_steps=max_ticks)
     violations += inv.typed_error_violations(error)
+    # invariant 12: the capture scope above recorded a chain for every
+    # submitted request; judge them (incl. gray + crash campaigns,
+    # which all funnel through this runner)
+    violations += inv.trace_completeness_violations(frontend)
     if snapshot_roundtrip and drained:
         for handle in frontend.replicas:
             if handle.alive:

@@ -61,6 +61,16 @@ The gray-failure layer (ISSUE 10) adds three more:
     recovery or restart verdict.  Checked by replaying the front
     end's unified event log (append order = global order, so
     within-tick phase ordering is handled by construction).
+
+The observability layer (ISSUE 12) adds one more:
+
+12. **Trace completeness** — every submitted request owns exactly one
+    well-formed `obs.trace` chain: it starts with ``submitted``, ends
+    with exactly one terminal matching the front end's terminal state,
+    retry attempts strictly increase, each migration hop lands on its
+    recorded destination, and no chain exists for an unknown request.
+    Fault campaigns run inside ``obs.trace.capture()`` so the chains
+    exist even with telemetry disabled.
 """
 
 from __future__ import annotations
@@ -393,6 +403,81 @@ def supervisor_consistency_violations(frontend) -> list[str]:
                     f"{tick} while its verdict was {state[rid]}"
                 )
     return _report("supervisor_consistency", problems)
+
+
+def trace_completeness_violations(frontend) -> list[str]:
+    """Invariant 12: one well-formed trace chain per submitted request.
+
+    Reads the live `obs.trace` store (the campaign runner wraps the
+    whole plan in ``trace.capture()``); an empty store means tracing
+    was off for the run and there is nothing to judge."""
+    from attention_tpu.obs import trace as _trace
+    from attention_tpu.obs.naming import TRACE_TERMINAL_EVENTS
+
+    chains = _trace.all_traces()
+    if not chains:
+        return []
+    problems = []
+    known = set(frontend.requests)
+    for rid in sorted(set(chains) - known):
+        problems.append(f"orphan chain for unknown request {rid}")
+    for rid in sorted(known):
+        fr = frontend.requests[rid]
+        evs = chains.get(rid, [])
+        if not evs:
+            problems.append(f"request {rid}: no trace chain recorded")
+            continue
+        names = [e["event"] for e in evs]
+        if names[0] != "submitted":
+            problems.append(
+                f"request {rid}: chain starts with {names[0]!r}, "
+                "not 'submitted'")
+        terms = [n for n in names if n in TRACE_TERMINAL_EVENTS]
+        if fr.is_terminal:
+            if len(terms) != 1:
+                problems.append(
+                    f"request {rid}: {len(terms)} terminal events "
+                    f"{terms} (want exactly one)")
+            elif names[-1] != terms[0]:
+                problems.append(
+                    f"request {rid}: terminal {terms[0]!r} is not the "
+                    "last event")
+            elif terms[0] != fr.state.value:
+                problems.append(
+                    f"request {rid}: trace terminal {terms[0]!r} != "
+                    f"front-end state {fr.state.value!r}")
+        elif terms:
+            problems.append(
+                f"request {rid}: live request carries terminal "
+                f"{terms[0]!r}")
+        attempts = [e.get("attempt") for e in evs
+                    if e["event"] == "retried"]
+        if (any(a is None for a in attempts)
+                or any(b <= a for a, b in zip(attempts, attempts[1:]))):
+            problems.append(
+                f"request {rid}: retry attempts {attempts} not "
+                "strictly increasing")
+        # hop pairing: a retried hop leaves the replica, so the next
+        # placement-class event must be a re-placement (or another
+        # backoff round / a terminal) — never an engine-side event on
+        # a replica the chain never re-entered; a migrated hop must
+        # land exactly on its recorded destination
+        placement = {"routed", "warm_adopted", "retried", "migrated"}
+        for i, ev in enumerate(evs):
+            if ev["event"] == "retried":
+                nxt = names[i + 1:i + 2]
+                if nxt and nxt[0] not in placement \
+                        and nxt[0] not in TRACE_TERMINAL_EVENTS:
+                    problems.append(
+                        f"request {rid}: {nxt[0]!r} follows a retried "
+                        "hop without a re-placement")
+            elif ev["event"] == "migrated":
+                if ev.get("replica") != ev.get("dest"):
+                    problems.append(
+                        f"request {rid}: migrated hop stamped on "
+                        f"{ev.get('replica')!r}, dest was "
+                        f"{ev.get('dest')!r}")
+    return _report("trace_completeness", problems)
 
 
 def snapshot_roundtrip_violations(engine) -> list[str]:

@@ -396,14 +396,28 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
                "deadline_ms": args.deadline_ms,
                "tick_ms": args.tick_ms},
     )
+    # SLO observatory (obs.slo): deterministic error-budget accounting
+    # over the run's latency rows, mirrored onto the frozen registry
+    # series and persisted next to the telemetry dump for `cli obs slo`
+    from attention_tpu.obs import slo as slo_mod
+
+    slo_report = slo_mod.slo_report(frontend.latency_rows(),
+                                    horizon_tick=summary["ticks"])
+    slo_mod.publish(slo_report)
     out = {"summary": summary,
-           "run_record": json.loads(record.to_json())}
+           "run_record": json.loads(record.to_json()),
+           "slo": {"fleet": {ob["objective"]:
+                             {"burn_rate": ob["burn_rate"],
+                              "budget_remaining": ob["budget_remaining"],
+                              "violations": ob["violations"]}
+                             for ob in slo_report["fleet"]["slo"]}}}
     if args.outputs:
         out["outputs"] = outputs
     if args.obs_out:
         from attention_tpu import obs
 
         obs.dump(args.obs_out)
+        obs.write_slo(args.obs_out, slo_report)
         _logger.info("wrote telemetry dump: %s", args.obs_out)
     print(json.dumps(out))
     return 0
@@ -840,8 +854,10 @@ def _obs_load(args: argparse.Namespace):
 
 
 def _cmd_obs_report(args: argparse.Namespace) -> int:
-    """Human-oriented run picture: counters, gauges, histogram and span
-    aggregates, and per-module device seconds when a capture exists."""
+    """Human-oriented run picture: instrument families first (every
+    layer that recorded anything, frontend.* through engine.step.*),
+    then counters, gauges, histogram/digest and span aggregates, and
+    per-module device seconds when a capture exists."""
     snapshot, events, device = _obs_load(args)
 
     def _lbl(labels):
@@ -849,6 +865,20 @@ def _cmd_obs_report(args: argparse.Namespace) -> int:
                                sorted(labels.items())) + "}"
                 if labels else "")
 
+    # grouped family view: series counts per layer.component, so the
+    # PR 6-11 families (frontend.*, engine.snapshot.*, engine.step.*)
+    # and the new digest/SLO series are visible at a glance
+    fams: dict[str, dict[str, int]] = {}
+    for kind in ("counters", "gauges", "histograms", "digests"):
+        for s in snapshot.get(kind, []):
+            fam = ".".join(s["name"].split(".")[:2])
+            fams.setdefault(fam, {}).setdefault(kind, 0)
+            fams[fam][kind] += 1
+    print("== families ==")
+    for fam in sorted(fams):
+        parts = ", ".join(f"{n} {k}" for k, n in
+                          sorted(fams[fam].items()))
+        print(f"  {fam}: {parts}")
     print("== counters ==")
     for s in snapshot.get("counters", []):
         print(f"  {s['name']}{_lbl(s['labels'])} = {s['value']:g}")
@@ -860,6 +890,12 @@ def _cmd_obs_report(args: argparse.Namespace) -> int:
         mean = s["sum"] / s["count"] if s["count"] else 0.0
         print(f"  {s['name']}{_lbl(s['labels'])}: count={s['count']} "
               f"mean={mean:.3f} sum={s['sum']:.3f}")
+    print("== digests ==")
+    for s in snapshot.get("digests", []):
+        p = s["percentiles"]
+        print(f"  {s['name']}{_lbl(s['labels'])}: count={s['count']} "
+              f"p50={p['p50']:.3f} p90={p['p90']:.3f} "
+              f"p99={p['p99']:.3f} p999={p['p999']:.3f}")
     print("== spans ==")
     agg: dict[str, list[float]] = {}
     for e in events:
@@ -900,6 +936,53 @@ def _cmd_obs_export(args: argparse.Namespace) -> int:
         _logger.info("wrote %s export: %s", args.format, args.out)
     else:
         sys.stdout.write(text)
+    return 0
+
+
+def _cmd_obs_trace(args: argparse.Namespace) -> int:
+    """Per-request journey report (obs.trace): ``--request ID`` prints
+    one chain event by event; without it, one summary line per chain.
+    Reads ``<run>/traces.jsonl`` from a dump, else the live store."""
+    from attention_tpu import obs
+    from attention_tpu.obs import trace as trace_mod
+
+    chains = (obs.load_traces(args.run) if args.run
+              else trace_mod.all_traces())
+    if args.request is not None:
+        evs = chains.get(args.request)
+        if not evs:
+            print(f"no trace recorded for request {args.request!r}",
+                  file=sys.stderr)
+            return 1
+        for line in trace_mod.journey_lines(args.request, evs):
+            print(line)
+        return 0
+    for rid in sorted(chains):
+        evs = chains[rid]
+        term = trace_mod.terminal_of(evs)
+        print(f"{rid}: {len(evs)} events, "
+              f"terminal={term or 'none (in flight)'}")
+    return 0
+
+
+def _cmd_obs_slo(args: argparse.Namespace) -> int:
+    """Print a run's SLO report (obs.slo) in its canonical JSON form —
+    byte-identical across same-seed runs, which is the property the
+    acceptance test pins."""
+    import json
+
+    from attention_tpu import obs
+
+    if not args.run:
+        print("obs slo requires --run "
+              "(a `serve-sim --obs-out` directory)", file=sys.stderr)
+        return 1
+    report = obs.load_slo(args.run)
+    if report is None:
+        print(f"no slo.json under {args.run} (was serve-sim run "
+              "with --replicas and --obs-out?)", file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=1, sort_keys=True))
     return 0
 
 
@@ -1091,7 +1174,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     obsub = ob.add_subparsers(dest="obs_cmd", required=True)
     for name, fn in (("report", _cmd_obs_report),
-                     ("export", _cmd_obs_export)):
+                     ("export", _cmd_obs_export),
+                     ("trace", _cmd_obs_trace),
+                     ("slo", _cmd_obs_slo)):
         sp = obsub.add_parser(name)
         sp.add_argument("--run", default=None,
                         help="telemetry dump directory written by "
@@ -1106,6 +1191,11 @@ def main(argv: list[str] | None = None) -> int:
                             default="chrome")
             sp.add_argument("--out", default=None,
                             help="write here instead of stdout")
+        if name == "trace":
+            sp.add_argument("--request", default=None,
+                            help="print the full journey of one "
+                                 "request id (default: list every "
+                                 "chain, one line each)")
         sp.set_defaults(fn=fn)
 
     _setup_logging()

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from attention_tpu import obs
+from attention_tpu.obs import trace as _trace
 from attention_tpu.engine.allocator import BlockAllocator
 from attention_tpu.engine.errors import DeadlineExceededError
 from attention_tpu.engine.metrics import (
@@ -244,6 +245,28 @@ class ServingEngine:
         # write-ahead log between snapshots; attached by SnapshotManager
         # (engine/snapshot.py), None when durability is off
         self.journal: Any = None
+        # request-trace coordinates (obs/trace.py).  A fronting
+        # ReplicaHandle stamps these so engine-side events carry
+        # (tick, replica, incarnation); standalone engines default to
+        # tick == step.  trace_owner says who records submit/terminal
+        # events — the frontend's _finalize funnel takes that role for
+        # replicas it owns, so a chain never gets two terminals.
+        self.trace_replica: str | None = None
+        self.trace_incarnation: int = 0
+        self.trace_start_tick: int = 0
+        self.trace_owner: str = "engine"
+
+    # -- request tracing --------------------------------------------------
+
+    def _trace_event(self, req: Request, event: str, **extra: Any) -> None:
+        """Stamp one trace event with this engine's coordinates."""
+        _trace.record(
+            req.request_id, event,
+            tick=self.trace_start_tick + self._step,
+            replica=self.trace_replica,
+            incarnation=self.trace_incarnation,
+            step=self._step, **extra,
+        )
 
     # -- request intake ---------------------------------------------------
 
@@ -303,6 +326,9 @@ class ServingEngine:
         )
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
+        if _trace.active() and self.trace_owner == "engine":
+            self._trace_event(req, "submitted")
+            self._trace_event(req, "admitted")
         if self.journal is not None:
             self.journal.record_admit(req)
         return req
@@ -375,6 +401,8 @@ class ServingEngine:
                     continue
                 queue.remove(req)
                 _CANCELLED.inc()
+                if _trace.active() and self.trace_owner == "engine":
+                    self._trace_event(req, "cancelled")
                 if req.pages:
                     self.allocator.free(req.pages)
                 req.pages = []
@@ -395,6 +423,8 @@ class ServingEngine:
             if req in queue:
                 queue.remove(req)
         _TIMED_OUT.inc()
+        if _trace.active() and self.trace_owner == "engine":
+            self._trace_event(req, "timed_out")
         if req.pages:
             self.allocator.free(req.pages)
         req.pages = []
@@ -436,6 +466,16 @@ class ServingEngine:
         with obs.span("engine.step"):
             timed_out = self._expire_deadlines()
             sched = self.scheduler.schedule(self._step)
+            if _trace.active():
+                # preemptions free the pages the admissions claim, so
+                # they precede admissions in the chain too
+                for req in sched.preempted:
+                    self._trace_event(req, "preempted")
+                for req in sched.admitted:
+                    ev = ("resumed"
+                          if (req.preemptions or req.output_tokens)
+                          else "prefill_start")
+                    self._trace_event(req, ev)
             total = sched.num_decode_tokens + sched.num_prefill_tokens
             baseline_pad = self._baseline_pad(sched)
             if self.config.step_mode == "ragged":
@@ -788,6 +828,8 @@ class ServingEngine:
         if req.first_token_step < 0:
             req.first_token_step = self._step
             self._wall[req.request_id]["first_token"] = time.perf_counter()
+            if _trace.active():
+                self._trace_event(req, "first_token")
         if self.on_token is not None:
             self.on_token(req, token)
         if done:
@@ -796,6 +838,8 @@ class ServingEngine:
     def _finish(self, req: Request) -> None:
         req.transition(RequestState.FINISHED)
         req.finish_step = self._step
+        if _trace.active() and self.trace_owner == "engine":
+            self._trace_event(req, "finished")
         self._nonfinite_skips.pop(req.request_id, None)
         if self.journal is not None:
             self.journal.record_finish(req.request_id)

@@ -27,6 +27,11 @@ import time
 from typing import Any
 
 from attention_tpu import obs
+from attention_tpu.obs.naming import (
+    SERIES_ENGINE_TPOT_DIGEST,
+    SERIES_ENGINE_TTFT_DIGEST,
+)
+from attention_tpu.obs.quantile import QuantileDigest
 from attention_tpu.utils.profiling import RunRecord
 
 _STEPS = obs.counter("engine.steps.total", "engine steps recorded")
@@ -54,6 +59,10 @@ _PAD_TOKENS = obs.counter(
 _RAGGED_OCC = obs.gauge(
     "engine.step.ragged_occupancy",
     "real-token fraction of the last non-empty step's launch width")
+_TTFT_DIG = obs.digest(SERIES_ENGINE_TTFT_DIGEST,
+                       "TTFT quantile digest (engine steps)")
+_TPOT_DIG = obs.digest(SERIES_ENGINE_TPOT_DIGEST,
+                       "TPOT quantile digest (steps/token)")
 
 
 @dataclasses.dataclass
@@ -157,8 +166,21 @@ class EngineMetrics:
         if obs.enabled():
             _FINISHED.inc()
             _TTFT.observe(m.ttft_steps)
+            _TTFT_DIG.observe(m.ttft_steps)
             if m.output_tokens > 1:
                 _TPOT.observe(m.tpot_steps)
+                _TPOT_DIG.observe(m.tpot_steps)
+
+    def latency_digests(self) -> tuple[QuantileDigest, QuantileDigest]:
+        """(ttft, tpot) digests rebuilt from the deterministic request
+        rows — works with telemetry disabled, so summaries never depend
+        on the obs flag."""
+        ttft, tpot = QuantileDigest(), QuantileDigest()
+        for r in self.requests:
+            ttft.add(max(r.ttft_steps, 0))
+            if r.output_tokens > 1:
+                tpot.add(r.tpot_steps)
+        return ttft, tpot
 
     def summary(self) -> dict[str, Any]:
         wall = time.perf_counter() - self._t0
@@ -169,6 +191,7 @@ class EngineMetrics:
         tpots = [r.tpot_steps for r in self.requests if r.output_tokens > 1]
         busy = [s for s in self.steps if s.decode_tokens or s.prefill_tokens]
         mixed = [s for s in busy if s.decode_tokens and s.prefill_tokens]
+        ttft_dig, tpot_dig = self.latency_digests()
         return {
             "num_requests": len(self.requests),
             "num_steps": len(self.steps),
@@ -182,8 +205,14 @@ class EngineMetrics:
             "mean_ttft_steps": round(
                 sum(ttfts) / len(ttfts), 2) if ttfts else 0.0,
             "max_ttft_steps": max(ttfts) if ttfts else 0,
+            # digest-backed quantiles (bounded relative error, not the
+            # fixed Prometheus buckets) — the SLO accounting surface
+            "ttft_p50_steps": round(ttft_dig.quantile(0.5), 3),
+            "ttft_p99_steps": round(ttft_dig.quantile(0.99), 3),
             "mean_tpot_steps": round(
                 sum(tpots) / len(tpots), 3) if tpots else 0.0,
+            "tpot_p50_steps": round(tpot_dig.quantile(0.5), 3),
+            "tpot_p99_steps": round(tpot_dig.quantile(0.99), 3),
             "mixed_batch_steps": len(mixed),
             "mean_batched_tokens_per_step": round(
                 sum(s.decode_tokens + s.prefill_tokens for s in busy)

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from attention_tpu import obs
+from attention_tpu.obs import trace as _trace
 from attention_tpu.engine.allocator import _PrefixEntry
 from attention_tpu.engine.engine import EngineConfig, ServingEngine
 from attention_tpu.engine.errors import SnapshotCorruptError, SnapshotError
@@ -180,6 +181,13 @@ def _request_to_dict(req: Request, queue: str) -> dict:
         "first_scheduled_step": req.first_scheduled_step,
         "first_token_step": req.first_token_step,
         "finish_step": req.finish_step,
+        # the request's trace tail rides the snapshot (obs/trace.py):
+        # a warm restart or migration in a FRESH process reconstructs
+        # the journey chain from this section alone.  Deterministic —
+        # trace events carry only tick/step coordinates, never wall
+        # time — so serialize() stays fingerprint-stable.
+        "trace": _trace.events_of(req.request_id)
+        if _trace.active() else [],
     }
 
 
@@ -507,6 +515,9 @@ def restore(path: str, model, params, *,
                 engine.scheduler.waiting.append(req)
             else:
                 engine.scheduler.running.append(req)
+            # splice the snapshotted trace tail back into the live
+            # store (idempotent: in-process restores already hold it)
+            _trace.adopt(req.request_id, d.get("trace", []))
             # wall-clock bookkeeping restarts at restore (TTFT history
             # is observability, not contract)
             engine._wall[req.request_id] = {"added": time.perf_counter()}

@@ -18,6 +18,13 @@ import zlib
 
 import numpy as np
 
+from attention_tpu import obs
+
+_DELAY_H = obs.histogram(
+    "frontend.retry.delay_ticks",
+    "granted backoff delays (exponential + seeded jitter)",
+    buckets=(1, 2, 4, 8, 16, 32))
+
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
@@ -68,4 +75,6 @@ class RetryPolicy:
                  attempt)
             )
             raw *= 1.0 + self.jitter * float(rng.uniform(-1.0, 1.0))
-        return max(1, int(round(raw)))
+        delay = max(1, int(round(raw)))
+        _DELAY_H.observe(delay)
+        return delay

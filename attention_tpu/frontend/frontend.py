@@ -40,6 +40,11 @@ import os
 from typing import Any, Callable, Sequence
 
 from attention_tpu import obs
+from attention_tpu.obs import trace as _trace
+from attention_tpu.obs.naming import (
+    SERIES_TPOT_DIGEST,
+    SERIES_TTFT_DIGEST,
+)
 from attention_tpu.engine.engine import (
     EngineConfig,
     StepLimitExceededError,
@@ -100,6 +105,13 @@ _R_UTIL_G = obs.gauge("frontend.replica.page_util",
                       "per-replica page-pool utilization")
 _PROMOTED = obs.counter("frontend.replica.promoted",
                         "warm standbys promoted on a DEAD verdict")
+# client-observed latency digests (obs.quantile): per-replica series
+# merge bucket-wise into the fleet view, so `cli obs slo` / the SLO
+# observatory aggregate replicas without resampling
+_TTFT_DIG = obs.digest(SERIES_TTFT_DIGEST,
+                       "client TTFT quantile digest (front-end ticks)")
+_TPOT_DIG = obs.digest(SERIES_TPOT_DIGEST,
+                       "client TPOT quantile digest (ticks per token)")
 
 
 class FrontendRequestState(enum.Enum):
@@ -117,6 +129,15 @@ FRONTEND_TERMINAL = frozenset({
     FrontendRequestState.FINISHED, FrontendRequestState.CANCELLED,
     FrontendRequestState.TIMED_OUT, FrontendRequestState.SHED,
 })
+
+#: terminal state -> its trace event name (obs.naming TRACE_EVENTS);
+#: the `_finalize` funnel records exactly one of these per request
+_TERMINAL_EVENT = {
+    FrontendRequestState.FINISHED: "finished",
+    FrontendRequestState.CANCELLED: "cancelled",
+    FrontendRequestState.TIMED_OUT: "timed_out",
+    FrontendRequestState.SHED: "shed",
+}
 
 # RETRY_WAIT -> RETRY_WAIT is a real edge: a retry that finds no alive
 # replica goes straight back on the backoff queue.  ASSIGNED/RETRY_WAIT
@@ -174,6 +195,7 @@ class FrontendRequest:
     waiting_since: int | None = None  # stall-detection bookkeeping
     downclassed: bool = False
     prefix_cached_tokens: int = 0
+    first_token_tick: int | None = None
     finish_tick: int = -1
     error: BaseException | None = None
 
@@ -375,6 +397,8 @@ class ServingFrontend:
         self.requests[fr.request_id] = fr
         self._pending.append(fr)
         self._pending.sort(key=lambda f: (f.arrival, f.seq))
+        self._trace_event(fr, "submitted", tick=fr.arrival,
+                          tenant=fr.session, priority=fr.priority)
         return fr
 
     def cancel(self, request_id: str) -> bool:
@@ -395,6 +419,8 @@ class ServingFrontend:
     def _on_engine_token(self, replica_id: str, req: Request,
                          token: int) -> None:
         fr = self.requests[req.request_id]
+        if not fr.tokens:
+            fr.first_token_tick = self._tick
         fr.tokens.append(int(token))
         fr.emitters.append(replica_id)
         fr.waiting_since = None
@@ -547,6 +573,8 @@ class ServingFrontend:
             # deadline in the restarted replica's own step space
             req.deadline_step = handle.local_deadline(fr.deadline)
             self.counts["warm_adoptions"] += 1
+            self._trace_event(fr, "warm_adopted",
+                              tokens_restored=len(fr.tokens))
             self.events_log.append(
                 ("admit", t, fr.request_id, handle.replica_id))
 
@@ -555,6 +583,24 @@ class ServingFrontend:
     def _handle(self, replica_id: str | None) -> ReplicaHandle | None:
         return next((h for h in self.replicas
                      if h.replica_id == replica_id), None)
+
+    def _trace_event(self, fr: FrontendRequest, event: str, *,
+                     tick: int | None = None, **extra: Any) -> None:
+        """Stamp one front-end trace event with the request's current
+        replica coordinates (None/-1 while it sits in a front-end
+        queue)."""
+        if not _trace.active():
+            return
+        handle = self._handle(fr.replica_id)
+        _trace.record(
+            fr.request_id, event,
+            tick=self._tick if tick is None else tick,
+            replica=fr.replica_id,
+            incarnation=handle.deaths if handle is not None else 0,
+            step=(handle.engine.current_step
+                  if handle is not None and handle.alive else -1),
+            **extra,
+        )
 
     def _finalize(self, fr: FrontendRequest,
                   state: FrontendRequestState, *,
@@ -569,6 +615,16 @@ class ServingFrontend:
             self._pending.remove(fr)
         if fr in self._retry:
             self._retry.remove(fr)
+        self._trace_event(fr, _TERMINAL_EVENT[state])
+        if obs.enabled() and state is FrontendRequestState.FINISHED:
+            labels = {"replica": fr.replica_id or "none"}
+            if fr.first_token_tick is not None:
+                _TTFT_DIG.observe(
+                    max(fr.first_token_tick - fr.arrival, 0), **labels)
+                if len(fr.tokens) > 1:
+                    _TPOT_DIG.observe(
+                        (fr.finish_tick - fr.first_token_tick)
+                        / (len(fr.tokens) - 1), **labels)
 
     def _expire_queued(self, t: int) -> None:
         """Deadline sweep over the FRONT-END queues (pending arrivals
@@ -673,6 +729,8 @@ class ServingFrontend:
         fr.routed_by = decision.reason
         fr.assigned_tick = t
         fr.waiting_since = None
+        self._trace_event(fr, "routed", reason=decision.reason)
+        self._trace_event(fr, "admitted")
         self.events_log.append(
             ("admit", t, fr.request_id, handle.replica_id))
 
@@ -700,6 +758,9 @@ class ServingFrontend:
             self.config.seed, fr.request_id, fr.attempts)
         fr.next_retry = t + delay
         fr.transition(FrontendRequestState.RETRY_WAIT)
+        self._trace_event(fr, "retried", attempt=fr.attempts,
+                          delay=delay, from_replica=fr.last_replica,
+                          cause=type(cause).__name__)
         if fr not in self._retry:
             self._retry.append(fr)
         self.counts["retries_scheduled"] += 1
@@ -815,6 +876,9 @@ class ServingFrontend:
         fr.assigned_tick = t
         fr.waiting_since = None
         self.counts["live_migrations"] += 1
+        self._trace_event(fr, "migrated", source=fr.last_replica,
+                          dest=dest.replica_id,
+                          tokens_at_cut=len(fr.tokens))
         self.events_log.append(
             ("admit", t, fr.request_id, dest.replica_id))
 
@@ -901,6 +965,25 @@ class ServingFrontend:
         return {fr.request_id: list(fr.tokens)
                 for fr in sorted(self.requests.values(),
                                  key=lambda f: f.seq)}
+
+    def latency_rows(self) -> list[dict[str, Any]]:
+        """Per-request latency rows in the `obs.slo` schema, submission
+        order.  Pure bookkeeping (works with telemetry disabled): the
+        SLO observatory is a deterministic function of these rows."""
+        rows: list[dict[str, Any]] = []
+        for fr in sorted(self.requests.values(), key=lambda f: f.seq):
+            rows.append({
+                "request_id": fr.request_id,
+                "tenant": fr.session or "default",
+                "priority": fr.priority,
+                "submit_tick": fr.arrival,
+                "first_token_tick": fr.first_token_tick,
+                "finish_tick": (fr.finish_tick if fr.finish_tick >= 0
+                                else self._tick),
+                "output_tokens": len(fr.tokens),
+                "state": fr.state.value,
+            })
+        return rows
 
     def summary(self) -> dict[str, Any]:
         """Deterministic run aggregate: every field is a pure function

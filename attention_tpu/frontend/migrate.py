@@ -30,6 +30,7 @@ import dataclasses
 from typing import TYPE_CHECKING, Any
 
 from attention_tpu import obs
+from attention_tpu.obs import trace as _trace
 from attention_tpu.engine.errors import DeadlineExceededError
 from attention_tpu.engine.request import SamplingParams
 from attention_tpu.engine.snapshot import _request_to_dict
@@ -128,6 +129,11 @@ def drain_replica(frontend: "ServingFrontend", handle: ReplicaHandle,
                 source=handle.replica_id, dest=None,
                 tokens_at_cut=len(fr.tokens), record=rec))
             continue
+        # the drained record carries the request's trace tail (the PR 9
+        # snapshot section embeds it); adopting on the destination is
+        # what makes a chain survive a cut across processes — in-process
+        # it deduplicates to a no-op
+        _trace.adopt(fr.request_id, rec.get("trace", []))
         frontend.note_migrated(fr, dest, tick)
         _MIGRATED.inc()
         if outs:

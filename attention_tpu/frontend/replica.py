@@ -92,7 +92,20 @@ class ReplicaHandle:
                                on_token=on_token, on_finish=on_finish,
                                on_timeout=on_timeout)
         self._attach_snapshots(engine)
+        self._stamp_trace(engine)
         return engine
+
+    def _stamp_trace(self, engine: ServingEngine) -> None:
+        """Give the engine its trace coordinates (obs.trace): events it
+        records carry THIS replica's id and incarnation, with its step
+        counter translated into front-end ticks via ``start_tick``.
+        Owner "frontend" hands the request-lifecycle events (submitted/
+        admitted/terminals) to the front end — the engine keeps only
+        the scheduling events it alone can see."""
+        engine.trace_replica = self.replica_id
+        engine.trace_incarnation = self.deaths
+        engine.trace_start_tick = self.start_tick
+        engine.trace_owner = "frontend"
 
     def _attach_snapshots(self, engine: ServingEngine) -> None:
         if self.snapshot_dir and self.snapshot_every:
@@ -176,6 +189,7 @@ class ReplicaHandle:
                 self.start_tick = tick - engine.current_step
                 self._engine = engine
                 self._attach_snapshots(engine)
+                self._stamp_trace(engine)
                 self.last_restart_mode = "warm"
                 self.last_warm_fallback = None
                 return "warm"

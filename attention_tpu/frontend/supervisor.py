@@ -57,6 +57,8 @@ _SIGNALS = obs.counter("frontend.supervisor.signals",
                        "bad-tick signals observed per kind")
 _STATE_G = obs.gauge("frontend.supervisor.state",
                      "per-replica supervisor state (0=healthy..3=dead)")
+_STREAK_G = obs.gauge("frontend.supervisor.bad_streak",
+                      "consecutive bad ticks toward the next step-down")
 
 
 class SupervisorState(enum.Enum):
@@ -288,6 +290,9 @@ class ReplicaSupervisor:
             for handle in replicas:
                 _STATE_G.set(
                     _SEVERITY[self.state(handle.replica_id)],
+                    replica=handle.replica_id)
+                _STREAK_G.set(
+                    self._track(handle.replica_id).bad_streak,
                     replica=handle.replica_id)
         return verdicts
 

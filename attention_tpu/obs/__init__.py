@@ -42,18 +42,35 @@ from attention_tpu.obs.export import (  # noqa: F401
     dump,
     jsonl_lines,
     load_dump,
+    load_slo,
+    load_traces,
     prom_text,
     write_jsonl,
+    write_slo,
 )
-from attention_tpu.obs.naming import check_name, require_name  # noqa: F401
+from attention_tpu.obs.naming import (  # noqa: F401
+    FROZEN_SERIES,
+    TRACE_EVENTS,
+    TRACE_TERMINAL_EVENTS,
+    check_event,
+    check_name,
+    require_event,
+    require_name,
+)
+from attention_tpu.obs.quantile import (  # noqa: F401
+    QuantileDigest,
+    merge_digests,
+)
 from attention_tpu.obs.registry import (  # noqa: F401
     DEFAULT_BUCKETS,
     REGISTRY,
     Counter,
+    Digest,
     Gauge,
     Histogram,
     Registry,
     counter,
+    digest,
     disable,
     enable,
     gauge,
@@ -66,7 +83,9 @@ from attention_tpu.obs.spans import (  # noqa: F401
     record_event,
     span,
 )
+from attention_tpu.obs import slo  # noqa: F401
 from attention_tpu.obs import spans as _spans
+from attention_tpu.obs import trace  # noqa: F401
 
 
 def enabled() -> bool:
@@ -75,10 +94,11 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Zero every metric series and drop every span event (instrument
-    registrations survive)."""
+    """Zero every metric series and drop every span event and request
+    trace (instrument registrations survive)."""
     REGISTRY.reset()
     _spans.clear()
+    trace.clear()
 
 
 def shape_bucket(*dims: int) -> str:

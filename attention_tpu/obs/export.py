@@ -28,13 +28,20 @@ import os
 from typing import Any, Iterator
 
 from attention_tpu.obs import spans
+from attention_tpu.obs import trace as _trace
 from attention_tpu.obs.naming import prom_name
 from attention_tpu.obs.registry import REGISTRY
 
 #: file names inside a dump directory
 DUMP_METRICS = "metrics.json"
 DUMP_EVENTS = "events.jsonl"
+DUMP_TRACES = "traces.jsonl"
+DUMP_SLO = "slo.json"
 DUMP_DEVICE = "device"
+
+#: percentile-key -> Prometheus quantile-label spelling
+_PROM_QUANTILES = {"p50": "0.5", "p90": "0.9", "p99": "0.99",
+                   "p999": "0.999"}
 
 
 def _fmt_labels(labels: dict[str, str]) -> str:
@@ -86,6 +93,20 @@ def prom_text(snapshot: dict[str, Any] | None = None) -> str:
             f"{flat}_sum{_fmt_labels(s['labels'])} {_fmt_value(s['sum'])}")
         lines.append(
             f"{flat}_count{_fmt_labels(s['labels'])} {s['count']}")
+    for s in snap.get("digests", []):
+        # digests export as Prometheus summaries: pre-computed quantile
+        # values, not bucket series (Histogram keeps that role)
+        flat = prom_name(s["name"])
+        _type_line(flat, "summary")
+        for pk, q in _PROM_QUANTILES.items():
+            lab = dict(s["labels"], quantile=q)
+            lines.append(
+                f"{flat}{_fmt_labels(lab)} "
+                f"{_fmt_value(s['percentiles'][pk])}")
+        lines.append(
+            f"{flat}_sum{_fmt_labels(s['labels'])} {_fmt_value(s['sum'])}")
+        lines.append(
+            f"{flat}_count{_fmt_labels(s['labels'])} {s['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -96,7 +117,7 @@ def jsonl_lines(span_events: list[dict] | None = None,
     snap = REGISTRY.snapshot() if snapshot is None else snapshot
     for e in evs:
         yield json.dumps({"type": "span", **e})
-    for kind in ("counters", "gauges", "histograms"):
+    for kind in ("counters", "gauges", "histograms", "digests"):
         for s in snap.get(kind, []):
             yield json.dumps({"type": kind[:-1], **s})
 
@@ -109,13 +130,23 @@ def write_jsonl(path: str, span_events: list[dict] | None = None,
             f.write(line + "\n")
 
 
+#: nominal tick width when laying request journeys on the timeline —
+#: ticks are virtual time, so the scale is presentational only
+TICK_US = 1000.0
+
+
 def chrome_trace(span_events: list[dict] | None = None,
-                 device_dir: str | None = None) -> dict[str, Any]:
+                 device_dir: str | None = None,
+                 request_traces: dict[str, list[dict]] | None = None,
+                 ) -> dict[str, Any]:
     """The merged host/device timeline as a Chrome-trace dict.
 
     ``device_dir`` is a ``profiling.trace`` log dir; absent/unparsable
     captures degrade to a host-only timeline (never an error — the CPU
-    CI path has no device lane)."""
+    CI path has no device lane).  ``request_traces`` (request id ->
+    event chain, default the live trace store) adds one lane per
+    request under a third process: each journey is a span from submit
+    to terminal with an instant mark per trace event."""
     evs = spans.events() if span_events is None else span_events
     trace_events: list[dict[str, Any]] = [
         {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
@@ -153,6 +184,37 @@ def chrome_trace(span_events: list[dict] | None = None,
                     "ph": "X", "pid": 2, "tid": 1, "name": name,
                     "ts": round(ts - dev_t0, 3), "dur": round(dur, 3),
                 })
+
+    chains = (_trace.all_traces() if request_traces is None
+              else request_traces)
+    if chains:
+        trace_events.append(
+            {"ph": "M", "pid": 3, "tid": 0, "name": "process_name",
+             "args": {"name": "requests"}})
+        for lane, rid in enumerate(sorted(chains), start=1):
+            chain = chains[rid]
+            if not chain:
+                continue
+            trace_events.append(
+                {"ph": "M", "pid": 3, "tid": lane, "name": "thread_name",
+                 "args": {"name": rid}})
+            t_first = min(ev["tick"] for ev in chain)
+            t_last = max(ev["tick"] for ev in chain)
+            trace_events.append({
+                "ph": "X", "pid": 3, "tid": lane, "name": rid,
+                "ts": t_first * TICK_US,
+                "dur": max((t_last - t_first) * TICK_US, 1.0),
+                "args": {"events": len(chain),
+                         "terminal": _trace.terminal_of(chain)},
+            })
+            for ev in chain:
+                args = {k: v for k, v in ev.items()
+                        if k != "event" and v is not None}
+                trace_events.append({
+                    "ph": "i", "pid": 3, "tid": lane, "s": "t",
+                    "name": ev["event"], "ts": ev["tick"] * TICK_US,
+                    "args": args,
+                })
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 
@@ -163,6 +225,12 @@ def dump(out_dir: str) -> None:
         json.dump(REGISTRY.snapshot(), f, indent=1)
         f.write("\n")
     write_jsonl(os.path.join(out_dir, DUMP_EVENTS))
+    chains = _trace.all_traces()
+    if chains:
+        with open(os.path.join(out_dir, DUMP_TRACES), "w") as f:
+            for rid in sorted(chains):
+                f.write(json.dumps(
+                    {"request_id": rid, "events": chains[rid]}) + "\n")
 
 
 def load_dump(run_dir: str) -> tuple[dict[str, Any], list[dict]]:
@@ -182,6 +250,40 @@ def load_dump(run_dir: str) -> tuple[dict[str, Any], list[dict]]:
                     row.pop("type")
                     evs.append(row)
     return snapshot, evs
+
+
+def load_traces(run_dir: str) -> dict[str, list[dict]]:
+    """Request-trace chains from a :func:`dump` directory (request id
+    -> event chain; {} when the run recorded none)."""
+    path = os.path.join(run_dir, DUMP_TRACES)
+    chains: dict[str, list[dict]] = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                chains[row["request_id"]] = row["events"]
+    return chains
+
+
+def write_slo(out_dir: str, report: dict[str, Any]) -> None:
+    """Persist an `obs.slo.slo_report` next to the metrics dump, in
+    canonical form (sorted keys) so same-seed runs are byte-identical."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, DUMP_SLO), "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_slo(run_dir: str) -> dict[str, Any] | None:
+    """The dump's SLO report, or None if the run wrote none."""
+    path = os.path.join(run_dir, DUMP_SLO)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def device_dir_of(run_dir: str) -> str | None:

@@ -44,3 +44,83 @@ def prom_name(name: str, *, kind: str = "") -> str:
     if kind == "counter" and not flat.endswith("_total"):
         flat += "_total"
     return flat
+
+
+# -- request-trace event names (closed enum) ------------------------------
+#
+# A RequestTrace chain is built ONLY from these event types; the trace
+# recorder rejects anything else at record time and the obs-naming lint
+# (ATP504) rejects unknown literals at review time.  The set is a
+# contract: the chaos `trace_completeness` invariant and the journey
+# report both reason structurally about these names.
+
+#: events that end a chain — every well-formed chain has exactly one,
+#: as its last event
+TRACE_TERMINAL_EVENTS = frozenset({
+    "finished", "timed_out", "shed", "cancelled",
+})
+
+#: the full closed enum of trace event types
+TRACE_EVENTS = frozenset({
+    "submitted",      # frontend accepted the request (chain start)
+    "routed",         # router chose a replica
+    "admitted",       # replica engine accepted the request
+    "prefill_start",  # scheduler first put the request on a step
+    "first_token",    # first output token emitted (TTFT mark)
+    "preempted",      # scheduler evicted the request mid-flight
+    "resumed",        # request re-entered a step after preempt/handoff
+    "migrated",       # drained source -> dest (cancel-before-admit)
+    "retried",        # requeued with backoff after replica death
+    "warm_adopted",   # in-flight stream adopted across a warm restart
+}) | TRACE_TERMINAL_EVENTS
+
+
+def check_event(event: str) -> bool:
+    """True iff ``event`` is a known trace event type."""
+    return event in TRACE_EVENTS
+
+
+def require_event(event: str) -> str:
+    """``event``, or ValueError naming the closed enum."""
+    if event not in TRACE_EVENTS:
+        raise ValueError(
+            f"unknown trace event {event!r}; trace chains are built from "
+            f"the closed enum in obs/naming.py: "
+            f"{', '.join(sorted(TRACE_EVENTS))}"
+        )
+    return event
+
+
+# -- frozen fleet series names --------------------------------------------
+#
+# The digest/SLO surface below is the INPUT CONTRACT for the planned
+# load forecaster and SLO-aware admission (ROADMAP): renaming any of
+# these is a breaking change to downstream consumers.  All latency
+# digests are tick/step-denominated (never wall time) so fleet rollups
+# stay deterministic.
+
+#: per-replica TTFT digest, ticks, labels: replica, tenant, priority
+SERIES_TTFT_DIGEST = "frontend.digest.ttft_ticks"
+#: per-replica TPOT digest, ticks/token, labels: replica, tenant, priority
+SERIES_TPOT_DIGEST = "frontend.digest.tpot_ticks"
+#: engine-local TTFT digest, steps, single-engine serve path
+SERIES_ENGINE_TTFT_DIGEST = "engine.digest.ttft_steps"
+#: engine-local TPOT digest, steps/token
+SERIES_ENGINE_TPOT_DIGEST = "engine.digest.tpot_steps"
+#: SLO burn rate gauge, labels: objective, tenant, priority
+SERIES_SLO_BURN_RATE = "frontend.slo.burn_rate"
+#: SLO error-budget remaining gauge (1.0 = untouched), same labels
+SERIES_SLO_BUDGET = "frontend.slo.budget_remaining"
+#: SLO violation counter, same labels
+SERIES_SLO_VIOLATIONS = "frontend.slo.violations"
+
+#: every frozen fleet series, name -> instrument kind
+FROZEN_SERIES: dict[str, str] = {
+    SERIES_TTFT_DIGEST: "digest",
+    SERIES_TPOT_DIGEST: "digest",
+    SERIES_ENGINE_TTFT_DIGEST: "digest",
+    SERIES_ENGINE_TPOT_DIGEST: "digest",
+    SERIES_SLO_BURN_RATE: "gauge",
+    SERIES_SLO_BUDGET: "gauge",
+    SERIES_SLO_VIOLATIONS: "counter",
+}

@@ -1,0 +1,199 @@
+"""Deterministic mergeable quantile digest over log-spaced buckets.
+
+The registry's fixed-bucket :class:`~attention_tpu.obs.registry.Histogram`
+is the Prometheus-facing view; it approximates tail quantiles only as
+well as its hand-picked bucket edges.  This module is the fleet-level
+latency instrument: a DDSketch-style digest whose bucket boundaries are
+FIXED powers of ``gamma = (1+eps)/(1-eps)`` — the same boundaries in
+every process — so
+
+* **merge is bucket-wise addition** (replica digests sum into a fleet
+  digest with zero coordination, no resampling, no approximation on
+  top of approximation; pinned exact by test), and
+* **relative error is bounded**: any value in bucket ``i`` lies in
+  ``(gamma^(i-1), gamma^i]`` and is reported as the geometric midpoint,
+  so ``|est - true| / true <= eps`` for every quantile, point mass to
+  heavy tail alike.
+
+Everything is plain Python floats/ints and insertion-order-free
+(buckets keyed by integer index, emitted sorted), so a digest snapshot
+is byte-deterministic for a deterministic stream of observations —
+the property `slo_report()` builds on.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable
+
+#: default relative-error bound (1%): p99 of a 1000-tick TTFT tail is
+#: reported within 10 ticks of truth
+DEFAULT_EPS = 0.01
+
+#: the quantiles every report surfaces
+REPORT_QUANTILES = (0.5, 0.9, 0.99, 0.999)
+
+
+def _q_label(q: float) -> str:
+    """``0.5 -> "p50"``, ``0.999 -> "p999"`` — the frozen report
+    spelling."""
+    return "p" + f"{q}".split(".")[1].ljust(2, "0")
+
+
+class QuantileDigest:
+    """Mergeable quantile digest with bounded relative error.
+
+    ``min_value`` floors the resolvable magnitude: observations in
+    ``[0, min_value]`` share the exact "zero" bucket (latencies of 0
+    ticks are common and must not hit ``log``).  Negative observations
+    are a caller bug and raise.
+    """
+
+    __slots__ = ("eps", "min_value", "_gamma", "_log_gamma",
+                 "zero", "buckets", "count", "sum", "min", "max")
+
+    def __init__(self, eps: float = DEFAULT_EPS,
+                 min_value: float = 1e-9):
+        if not 0.0 < eps < 1.0:
+            raise ValueError(f"eps must be in (0, 1), got {eps}")
+        if min_value <= 0.0:
+            raise ValueError(f"min_value must be > 0, got {min_value}")
+        self.eps = float(eps)
+        self.min_value = float(min_value)
+        self._gamma = (1.0 + self.eps) / (1.0 - self.eps)
+        self._log_gamma = math.log(self._gamma)
+        self.zero = 0
+        self.buckets: dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- recording --------------------------------------------------------
+
+    def _index(self, v: float) -> int:
+        return math.ceil(math.log(v) / self._log_gamma)
+
+    def add(self, v: float, n: int = 1) -> None:
+        v = float(v)
+        if v < 0.0:
+            raise ValueError(f"digest values must be >= 0, got {v}")
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if v <= self.min_value:
+            self.zero += n
+        else:
+            i = self._index(v)
+            self.buckets[i] = self.buckets.get(i, 0) + n
+        self.count += n
+        self.sum += v * n
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def extend(self, values: Iterable[float]) -> None:
+        for v in values:
+            self.add(v)
+
+    # -- querying ---------------------------------------------------------
+
+    def _value_of(self, index: int) -> float:
+        # geometric midpoint of (gamma^(i-1), gamma^i]: the estimate
+        # whose worst-case relative error is exactly eps
+        return 2.0 * self._gamma ** index / (self._gamma + 1.0)
+
+    def quantile(self, q: float) -> float:
+        """The ``q``-quantile estimate (0 for an empty digest)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        if self.count == 0:
+            return 0.0
+        # nearest-rank on the bucketed CDF; min/max are exact so the
+        # extreme quantiles never overshoot the observed range
+        rank = q * (self.count - 1)
+        seen = self.zero
+        if rank < seen:
+            return self.min if self.min < math.inf else 0.0
+        for i in sorted(self.buckets):
+            seen += self.buckets[i]
+            if rank < seen:
+                est = self._value_of(i)
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    def percentiles(self) -> dict[str, float]:
+        """The frozen report quantiles: ``{"p50": ..., ..., "p999"}``."""
+        return {_q_label(q): self.quantile(q) for q in REPORT_QUANTILES}
+
+    # -- merge ------------------------------------------------------------
+
+    def _check_compatible(self, other: "QuantileDigest") -> None:
+        if (self.eps, self.min_value) != (other.eps, other.min_value):
+            raise ValueError(
+                f"cannot merge digests with different boundaries: "
+                f"(eps={self.eps}, min={self.min_value}) vs "
+                f"(eps={other.eps}, min={other.min_value})"
+            )
+
+    def merge(self, other: "QuantileDigest") -> "QuantileDigest":
+        """Fold ``other`` into self (bucket-wise addition; exact)."""
+        self._check_compatible(other)
+        self.zero += other.zero
+        for i, c in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + c
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
+    # -- plain-data round trip --------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able view (bucket keys stringified, sorted)."""
+        return {
+            "eps": self.eps,
+            "min_value": self.min_value,
+            "zero": self.zero,
+            "buckets": {str(i): self.buckets[i]
+                        for i in sorted(self.buckets)},
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else 0.0,
+            "max": self.max if self.count else 0.0,
+        }
+
+    @classmethod
+    def from_snapshot(cls, d: dict[str, Any]) -> "QuantileDigest":
+        dig = cls(eps=float(d["eps"]), min_value=float(d["min_value"]))
+        dig.zero = int(d["zero"])
+        dig.buckets = {int(k): int(v) for k, v in d["buckets"].items()}
+        dig.count = int(d["count"])
+        dig.sum = float(d["sum"])
+        if dig.count:
+            dig.min = float(d["min"])
+            dig.max = float(d["max"])
+        return dig
+
+    def reset(self) -> None:
+        self.zero = 0
+        self.buckets.clear()
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+
+def merge_digests(digests: Iterable[QuantileDigest],
+                  eps: float = DEFAULT_EPS) -> QuantileDigest:
+    """A fresh digest holding the bucket-wise sum of ``digests`` (the
+    replica -> fleet rollup; an empty iterable merges to empty)."""
+    out: QuantileDigest | None = None
+    for d in digests:
+        if out is None:
+            out = QuantileDigest(eps=d.eps, min_value=d.min_value)
+        out.merge(d)
+    return out if out is not None else QuantileDigest(eps=eps)

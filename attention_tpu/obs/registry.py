@@ -23,6 +23,7 @@ import threading
 from typing import Any, Iterable
 
 from attention_tpu.obs.naming import require_name
+from attention_tpu.obs.quantile import DEFAULT_EPS, QuantileDigest, merge_digests
 
 _enabled: bool = os.environ.get("ATTN_TPU_OBS", "") not in ("", "0")
 
@@ -150,6 +151,55 @@ class Histogram(_Instrument):
         ]
 
 
+class Digest(_Instrument):
+    """Mergeable quantile digest family (one
+    :class:`~attention_tpu.obs.quantile.QuantileDigest` per label set).
+
+    The fleet-latency instrument: fixed log-spaced boundaries mean a
+    per-replica series merges into a fleet series by bucket-wise
+    addition (:meth:`merged`), with relative error bounded by ``eps``.
+    Histogram remains the Prometheus-export shape; Digest is the
+    quantile source of truth for SLO accounting."""
+
+    kind = "digest"
+
+    def __init__(self, name: str, help: str = "",
+                 eps: float = DEFAULT_EPS):
+        super().__init__(name, help)
+        self.eps = float(eps)
+
+    def observe(self, v: float, **labels: str) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        d = self._series.get(key)
+        if d is None:
+            d = self._series[key] = QuantileDigest(eps=self.eps)
+        d.add(v)
+
+    def digest(self, **labels: str) -> QuantileDigest:
+        """The digest for one label set (empty digest if unseen)."""
+        d = self._series.get(_label_key(labels))
+        return d if d is not None else QuantileDigest(eps=self.eps)
+
+    def merged(self, **labels: str) -> QuantileDigest:
+        """Bucket-wise sum of every label set matching the given label
+        subset (no labels = the whole family: the fleet rollup)."""
+        want = set(_label_key(labels))
+        return merge_digests(
+            (d for k, d in sorted(self._series.items())
+             if want <= set(k)),
+            eps=self.eps,
+        )
+
+    def series(self) -> list[dict[str, Any]]:
+        return [
+            {"name": self.name, "labels": dict(k),
+             **d.snapshot(), "percentiles": d.percentiles()}
+            for k, d in sorted(self._series.items())
+        ]
+
+
 class Registry:
     """Get-or-create home of every instrument family."""
 
@@ -187,10 +237,14 @@ class Registry:
                   buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._get(Histogram, name, help, buckets=buckets)
 
+    def digest(self, name: str, help: str = "",
+               eps: float = DEFAULT_EPS) -> Digest:
+        return self._get(Digest, name, help, eps=eps)
+
     def snapshot(self) -> dict[str, Any]:
         """Plain-data view of every series, the exporters' input."""
         out: dict[str, Any] = {"counters": [], "gauges": [],
-                               "histograms": []}
+                               "histograms": [], "digests": []}
         for inst in sorted(self._instruments.values(),
                            key=lambda i: i.name):
             out[inst.kind + "s"].extend(inst.series())
@@ -219,3 +273,7 @@ def gauge(name: str, help: str = "") -> Gauge:
 def histogram(name: str, help: str = "",
               buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
     return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+def digest(name: str, help: str = "", eps: float = DEFAULT_EPS) -> Digest:
+    return REGISTRY.digest(name, help, eps=eps)

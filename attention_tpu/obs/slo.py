@@ -1,0 +1,222 @@
+"""SLO objectives + error-budget burn-rate accounting.
+
+Objectives are declared over the two serving latencies the scheduling
+literature (Orca, Sarathi-Serve) treats as primary — TTFT (ticks from
+submit to first token) and TPOT (ticks per output token after the
+first) — at a target quantile per tenant and priority class.  All
+arithmetic is over front-end ticks (never wall time) and every
+container is emitted in sorted order with a pinned ``generated_at``,
+so ``slo_report`` is byte-deterministic: same seed, same report — the
+property ``cli obs slo`` pins.
+
+Error-budget semantics: an objective "p99 <= N ticks" allows 1% of
+requests to miss N.  ``burn_rate`` is (observed miss fraction) /
+(allowed miss fraction) — 1.0 means spending budget exactly at the
+allowed rate, >1 means burning it — reported both over the whole run
+and as a rolling per-window series (the forecaster input surface; the
+series names live in :mod:`attention_tpu.obs.naming` and are frozen).
+
+This module is pure: it consumes plain latency *rows* (produced by
+``ServingFrontend.latency_rows`` / ``EngineMetrics``) so it imports
+nothing above the obs layer.
+
+Row schema (one dict per terminal request)::
+
+    {"request_id": str, "tenant": str, "priority": int,
+     "submit_tick": int, "first_token_tick": int | None,
+     "finish_tick": int, "output_tokens": int, "state": str}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from attention_tpu.obs import registry as _registry
+from attention_tpu.obs.naming import (
+    SERIES_SLO_BUDGET,
+    SERIES_SLO_BURN_RATE,
+    SERIES_SLO_VIOLATIONS,
+)
+from attention_tpu.obs.quantile import QuantileDigest
+
+#: report format version (bumped on breaking shape changes)
+SLO_REPORT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SLObjective:
+    """One latency objective: ``metric`` at ``quantile`` must stay
+    <= ``threshold_ticks``, accounted over rolling ``window_ticks``."""
+
+    name: str
+    metric: str  # "ttft" | "tpot"
+    quantile: float
+    threshold_ticks: float
+    window_ticks: int
+
+    def __post_init__(self):
+        if self.metric not in ("ttft", "tpot"):
+            raise ValueError(
+                f"objective {self.name}: metric must be 'ttft' or "
+                f"'tpot', got {self.metric!r}"
+            )
+        if not 0.0 < self.quantile < 1.0:
+            raise ValueError(
+                f"objective {self.name}: quantile must be in (0, 1)"
+            )
+        if self.window_ticks < 1:
+            raise ValueError(
+                f"objective {self.name}: window_ticks must be >= 1"
+            )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "metric": self.metric,
+            "quantile": self.quantile,
+            "threshold_ticks": self.threshold_ticks,
+            "window_ticks": self.window_ticks,
+        }
+
+
+#: default objectives for the simulated fleet (tick-denominated)
+DEFAULT_OBJECTIVES = (
+    SLObjective("ttft_p99", "ttft", 0.99, 48.0, 64),
+    SLObjective("tpot_p99", "tpot", 0.99, 4.0, 64),
+)
+
+
+def _r6(x: float) -> float:
+    return round(float(x), 6)
+
+
+def _metric_value(row: dict[str, Any], metric: str) -> float | None:
+    """The row's value for ``metric``, or None when undefined (never
+    reached first token / fewer than two output tokens)."""
+    ft = row.get("first_token_tick")
+    if metric == "ttft":
+        if ft is None:
+            return None
+        return float(ft - row["submit_tick"])
+    if ft is None or row.get("output_tokens", 0) < 2:
+        return None
+    return float(row["finish_tick"] - ft) / (row["output_tokens"] - 1)
+
+
+def _objective_block(rows: list[dict[str, Any]], obj: SLObjective,
+                     horizon_tick: int) -> dict[str, Any]:
+    """Accounting for one objective over one group's rows."""
+    allowed = 1.0 - obj.quantile
+    dig = QuantileDigest()
+    # (finish_tick, violated) per accountable request: a request that
+    # died before its metric was ever defined (shed, timed out before
+    # first token) burns TTFT budget — the user saw no token — but is
+    # not accountable for TPOT (there was nothing to time)
+    marks: list[tuple[int, bool]] = []
+    for row in rows:
+        v = _metric_value(row, obj.metric)
+        if v is None:
+            if obj.metric == "ttft":
+                marks.append((row["finish_tick"], True))
+            continue
+        dig.add(v)
+        marks.append((row["finish_tick"], v > obj.threshold_ticks))
+    count = len(marks)
+    violations = sum(1 for _, bad in marks if bad)
+    frac = violations / count if count else 0.0
+    burn = frac / allowed if count else 0.0
+    budget = 1.0 - burn
+    w = obj.window_ticks
+    series = []
+    end = w
+    while end < horizon_tick + w:
+        in_w = [bad for t, bad in marks if end - w < t <= end]
+        wf = (sum(in_w) / len(in_w)) if in_w else 0.0
+        series.append({
+            "window_end": end,
+            "requests": len(in_w),
+            "burn_rate": _r6(wf / allowed),
+        })
+        end += w
+    return {
+        "objective": obj.name,
+        "metric": obj.metric,
+        "threshold_ticks": obj.threshold_ticks,
+        "achieved": _r6(dig.quantile(obj.quantile)),
+        "requests": count,
+        "violations": violations,
+        "allowed_fraction": _r6(allowed),
+        "burn_rate": _r6(burn),
+        "budget_remaining": _r6(budget),
+        "burn_series": series,
+    }
+
+
+def _latency_block(rows: list[dict[str, Any]], metric: str) -> dict[str, Any]:
+    dig = QuantileDigest()
+    for row in rows:
+        v = _metric_value(row, metric)
+        if v is not None:
+            dig.add(v)
+    out = {k: _r6(v) for k, v in dig.percentiles().items()}
+    out["count"] = dig.count
+    return out
+
+
+def slo_report(rows: list[dict[str, Any]],
+               objectives: tuple[SLObjective, ...] = DEFAULT_OBJECTIVES,
+               *, horizon_tick: int) -> dict[str, Any]:
+    """Deterministic SLO report over terminal-request latency rows.
+
+    Groups by (tenant, priority); the ``fleet`` block re-runs the same
+    accounting over all rows at once (== merging the group digests:
+    bucket-wise addition is exact)."""
+    groups: dict[tuple[str, int], list[dict[str, Any]]] = {}
+    for row in rows:
+        key = (str(row.get("tenant") or "default"),
+               int(row.get("priority", 0)))
+        groups.setdefault(key, []).append(row)
+
+    def block(sub: list[dict[str, Any]]) -> dict[str, Any]:
+        return {
+            "requests": len(sub),
+            "ttft": _latency_block(sub, "ttft"),
+            "tpot": _latency_block(sub, "tpot"),
+            "slo": [_objective_block(sub, o, horizon_tick)
+                    for o in objectives],
+        }
+
+    return {
+        "version": SLO_REPORT_VERSION,
+        "generated_at": 0,  # pinned: reports are seed-deterministic
+        "horizon_tick": int(horizon_tick),
+        "objectives": [o.to_dict() for o in objectives],
+        "groups": [
+            {"tenant": t, "priority": p, **block(groups[(t, p)])}
+            for t, p in sorted(groups)
+        ],
+        "fleet": block(rows),
+    }
+
+
+def publish(report: dict[str, Any]) -> None:
+    """Mirror a report's headline numbers onto the frozen registry
+    series (no-op while telemetry is disabled)."""
+    if not _registry.is_enabled():
+        return
+    burn = _registry.gauge(SERIES_SLO_BURN_RATE,
+                           "SLO error-budget burn rate")
+    budget = _registry.gauge(SERIES_SLO_BUDGET,
+                             "SLO error budget remaining")
+    viols = _registry.counter(SERIES_SLO_VIOLATIONS,
+                              "SLO violations")
+    for grp in report["groups"]:
+        labels = {"tenant": grp["tenant"],
+                  "priority": str(grp["priority"])}
+        for ob in grp["slo"]:
+            lb = {"objective": ob["objective"], **labels}
+            burn.set(ob["burn_rate"], **lb)
+            budget.set(ob["budget_remaining"], **lb)
+            if ob["violations"]:
+                viols.inc(ob["violations"], **lb)

@@ -1,0 +1,177 @@
+"""Per-request distributed tracing: append-only event chains.
+
+A **RequestTrace** is the journey of one request through the fleet: an
+append-only chain of typed events (closed enum in
+:mod:`attention_tpu.obs.naming`) each stamped with the four
+deterministic coordinates of the serving stack —
+
+    ``(front-end tick, replica id, incarnation, engine step)``
+
+— never wall time, so the same seed produces byte-identical chains.
+The chain survives every fleet transition: migration carries the tail
+inside the drained request record, warm restart rides the per-request
+snapshot section (``snapshot._request_to_dict`` embeds the tail,
+``adopt`` splices it back, deduplicating against whatever the live
+store already saw), and retry-with-backoff appends ``retried`` hops to
+the same chain.  ``obs.dump`` persists every chain to ``traces.jsonl``
+so a journey through a kill+gray storm reconstructs from the dump
+alone (``cli obs trace --request ID``).
+
+Gating: recording is off unless telemetry is enabled (the PR 3
+zero-overhead contract — the disabled path is one global read and a
+return) or a :func:`capture` scope is active.  ``capture`` exists for
+the chaos harness: fault campaigns assert trace completeness without
+turning the whole registry on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterator
+
+from attention_tpu.obs import registry as _registry
+from attention_tpu.obs.naming import TRACE_TERMINAL_EVENTS, require_event
+
+#: most chains kept live; oldest request's chain drops first
+TRACE_CAPACITY = 65536
+
+_lock = threading.Lock()
+_traces: dict[str, list[dict[str, Any]]] = {}
+_forced = 0  # >0 inside a capture() scope: record regardless of obs flag
+
+
+def active() -> bool:
+    """True iff trace recording is currently on."""
+    return _registry._enabled or _forced > 0
+
+
+@contextlib.contextmanager
+def capture() -> Iterator[None]:
+    """Scope that records traces even while telemetry is disabled.
+
+    Clears the store on entry — each chaos plan gets an isolated set of
+    chains to assert completeness over (synthetic request ids repeat
+    across plans)."""
+    global _forced
+    with _lock:
+        _forced += 1
+        _traces.clear()
+    try:
+        yield
+    finally:
+        with _lock:
+            _forced -= 1
+
+
+def record(request_id: str, event: str, *, tick: int,
+           replica: str | None = None, incarnation: int = 0,
+           step: int = -1, **extra: Any) -> None:
+    """Append one event to ``request_id``'s chain.
+
+    ``extra`` carries hop details (``source``/``dest`` for migrations,
+    ``attempt``/``delay`` for retries) and must be plain scalars — the
+    chain is serialized verbatim into snapshots and dumps."""
+    if not (_registry._enabled or _forced):
+        return
+    require_event(event)
+    ev: dict[str, Any] = {
+        "event": event,
+        "tick": int(tick),
+        "replica": replica,
+        "incarnation": int(incarnation),
+        "step": int(step),
+    }
+    for k in sorted(extra):
+        v = extra[k]
+        if v is not None and not isinstance(v, (str, int, float, bool)):
+            raise TypeError(
+                f"trace extra {k}={v!r} must be a plain scalar"
+            )
+        ev[k] = v
+    with _lock:
+        chain = _traces.get(request_id)
+        if chain is None:
+            if len(_traces) >= TRACE_CAPACITY:
+                _traces.pop(next(iter(_traces)))
+            chain = _traces[request_id] = []
+        chain.append(ev)
+
+
+def _ev_key(ev: dict[str, Any]) -> tuple:
+    return tuple(sorted(ev.items()))
+
+
+def adopt(request_id: str, events: list[dict[str, Any]]) -> None:
+    """Splice a restored chain tail (from a snapshot or a migration
+    record) into the live store, skipping events already present —
+    idempotent, so in-process warm restarts (store survived) and
+    fresh-process restores (store empty) both end with one copy."""
+    if not (_registry._enabled or _forced):
+        return
+    if not events:
+        return
+    with _lock:
+        chain = _traces.get(request_id)
+        if chain is None:
+            if len(_traces) >= TRACE_CAPACITY:
+                _traces.pop(next(iter(_traces)))
+            _traces[request_id] = [dict(ev) for ev in events]
+            return
+        seen = {_ev_key(ev) for ev in chain}
+        for ev in events:
+            if _ev_key(ev) not in seen:
+                chain.append(dict(ev))
+
+
+def events_of(request_id: str) -> list[dict[str, Any]]:
+    """The chain for one request, oldest first (copy; [] if unknown)."""
+    with _lock:
+        return [dict(ev) for ev in _traces.get(request_id, ())]
+
+
+def all_traces() -> dict[str, list[dict[str, Any]]]:
+    """Every live chain, keyed by request id (copies)."""
+    with _lock:
+        return {rid: [dict(ev) for ev in chain]
+                for rid, chain in _traces.items()}
+
+
+def terminal_of(events: list[dict[str, Any]]) -> str | None:
+    """The terminal event name of a chain, or None if still open."""
+    for ev in reversed(events):
+        if ev["event"] in TRACE_TERMINAL_EVENTS:
+            return ev["event"]
+    return None
+
+
+def journey_lines(request_id: str,
+                  events: list[dict[str, Any]]) -> list[str]:
+    """Human-readable journey report for one chain (the ``cli obs
+    trace --request ID`` body)."""
+    term = terminal_of(events)
+    lines = [
+        f"request {request_id}: {len(events)} events, "
+        f"terminal={term or 'none (in flight)'}"
+    ]
+    for ev in events:
+        where = ""
+        if ev.get("replica") is not None:
+            where = f" replica={ev['replica']} inc={ev['incarnation']}"
+            if ev.get("step", -1) >= 0:
+                where += f" step={ev['step']}"
+        extras = [
+            f"{k}={ev[k]}" for k in sorted(ev)
+            if k not in ("event", "tick", "replica", "incarnation", "step")
+            and ev[k] is not None
+        ]
+        tail = (" [" + " ".join(extras) + "]") if extras else ""
+        lines.append(
+            f"  [tick {ev['tick']:>4}] {ev['event']}{where}{tail}"
+        )
+    return lines
+
+
+def clear() -> None:
+    with _lock:
+        _traces.clear()

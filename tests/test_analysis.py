@@ -388,6 +388,41 @@ def test_obs_naming_pass_literal_vs_dynamic():
     assert codes(fs) == ["ATP501", "ATP501"]
 
 
+def test_obs_trace_event_pass_literal_vs_dynamic():
+    """ATP504: literal trace event names outside the closed enum are
+    flagged; legal events and dynamic names are not — and the digest
+    instrument joined the ATP501 name check."""
+    fs = run_pass(
+        """
+        from attention_tpu import obs
+        from attention_tpu.obs import trace
+
+        def f(rid, dyn):
+            trace.record(rid, "teleported", tick=1)
+            trace.record(rid, "finished", tick=2)
+            trace.record(rid, dyn, tick=3)
+            trace.record(rid)
+            obs.digest("BadDigestName")
+            obs.digest("engine.digest.ttft_steps")
+        """,
+        "obs-naming")
+    assert codes(fs) == ["ATP504", "ATP501"]
+    assert "teleported" in fs[0].message
+    assert "TRACE_EVENTS" in fs[0].message
+
+
+def test_obs_trace_event_suppression():
+    fs = run_pass(
+        """
+        from attention_tpu.obs import trace
+
+        def f(rid):
+            trace.record(rid, "not_an_event", tick=0)  # atp: disable=ATP504
+        """,
+        "obs-naming")
+    assert fs == []
+
+
 def test_non_source_guard():
     fs = non_source_findings([
         "attention_tpu/ops/flash.py",
@@ -509,7 +544,7 @@ def test_every_registered_pass_has_codes_and_stable_ids():
     # stable public ids: retiring/renumbering any of these is a break
     assert {"ATP001", "ATP101", "ATP102", "ATP103", "ATP201", "ATP202",
             "ATP203", "ATP204", "ATP301", "ATP302", "ATP401", "ATP402",
-            "ATP501", "ATP502", "ATP503", "ATP601",
+            "ATP501", "ATP502", "ATP503", "ATP504", "ATP601",
             "ATP701"} <= set(core.CODES)
 
 

@@ -454,6 +454,107 @@ def test_chaos_storm_end_to_end_acceptance(tiny_model):
 
 
 @pytest.mark.chaos
+def test_storm_traces_digests_and_slo_byte_identical(tiny_model):
+    """ISSUE 12 acceptance: under a seeded kill+restart storm with
+    telemetry ON, every request carries a complete well-formed trace
+    chain (the chaos invariant), the fleet latency digest equals the
+    bucket-wise merge of the per-replica digests, and the same seed
+    reproduces every chain and the SLO report byte-identically."""
+    from attention_tpu import obs
+    from attention_tpu.chaos import invariants as inv
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        FrontendFaultInjector,
+    )
+    from attention_tpu.obs import slo as slo_mod
+    from attention_tpu.obs import trace as obs_trace
+    from attention_tpu.obs.naming import SERIES_TTFT_DIGEST
+    from attention_tpu.obs.quantile import merge_digests
+
+    model, params = tiny_model
+    trace = bursty_trace(6, vocab=43, seed=17, tenants=2, burst_every=3,
+                         burst_size=2, shared_prefix_len=129,
+                         prompt_len_min=4, prompt_len_max=10,
+                         max_tokens=5, temperature=0.7)
+    plan = FaultPlan(seed=41, events=(
+        FaultEvent(step=3, kind="replica_kill", target="replica-0"),
+        FaultEvent(step=5, kind="preempt", arg=1, target="replica-1"),
+        FaultEvent(step=8, kind="replica_restart", target="replica-0"),
+    ))
+
+    def storm():
+        was = obs.is_enabled()
+        obs.enable()
+        obs.reset()
+        try:
+            fe = ServingFrontend(
+                model, params, _cfg(num_pages=16),
+                FrontendConfig(num_replicas=3, seed=0,
+                               retry=RetryPolicy(max_retries=4)))
+            injector = FrontendFaultInjector(fe, plan)
+            summary, _ = replay_frontend(fe, trace, max_ticks=600)
+            assert injector.injected >= 2
+            assert all(fr.is_terminal for fr in fe.requests.values())
+            # 1) trace completeness holds over the live store
+            assert inv.trace_completeness_violations(fe) == []
+            chains = obs_trace.all_traces()
+            assert set(chains) == set(fe.requests)
+            # 2) fleet digest == bucket-wise merge of replica digests
+            dig = obs.digest(SERIES_TTFT_DIGEST)
+            shards = [dig.digest(**r["labels"]) for r in dig.series()]
+            fleet, want = dig.merged(), merge_digests(shards)
+            assert fleet.count == want.count > 0
+            assert fleet.snapshot()["buckets"] == \
+                want.snapshot()["buckets"]
+            assert fleet.percentiles() == want.percentiles()
+            report = slo_mod.slo_report(fe.latency_rows(),
+                                        horizon_tick=summary["ticks"])
+            return chains, json.dumps(report, sort_keys=True)
+        finally:
+            obs.reset()
+            (obs.enable if was else obs.disable)()
+
+    chains1, rep1 = storm()
+    chains2, rep2 = storm()
+    assert chains1 == chains2  # byte-identical journeys, same seed
+    assert rep1 == rep2        # byte-identical SLO report
+    # the kill actually produced cross-replica hops in some chain
+    hops = {e["event"] for c in chains1.values() for e in c}
+    assert hops & {"retried", "migrated", "warm_adopted"}, hops
+
+
+def test_engine_summary_digest_percentiles_deterministic(tiny_model):
+    """ISSUE 12 satellite: the engine summary's TTFT/TPOT p50/p99 are
+    digest-backed (rebuilt from the deterministic request rows, so
+    telemetry-off runs get them too), byte-identical across same-seed
+    runs, within the digest's 1% bound of the exact rank statistic,
+    and carried into the RunRecord extra."""
+    model, params = tiny_model
+    trace = synthetic_trace(5, vocab=43, seed=13, prompt_len_min=4,
+                            prompt_len_max=12, max_tokens=6)
+
+    def run():
+        engine = ServingEngine(model, params, _cfg())
+        summary, outputs = replay(engine, trace)
+        return engine, summary, outputs
+
+    eng, s1, o1 = run()
+    _, s2, o2 = run()
+    keys = ("ttft_p50_steps", "ttft_p99_steps",
+            "tpot_p50_steps", "tpot_p99_steps")
+    assert [s1[k] for k in keys] == [s2[k] for k in keys]
+    assert o1 == o2
+    rows = sorted(max(r.ttft_steps, 0) for r in eng.metrics.requests)
+    assert rows, "no finished requests"
+    exact_p50 = rows[(len(rows) - 1) // 2]
+    assert s1["ttft_p50_steps"] == pytest.approx(exact_p50, rel=0.011)
+    rec = eng.metrics.to_run_record()
+    assert rec.extra["ttft_p99_steps"] == s1["ttft_p99_steps"]
+    assert rec.extra["tpot_p50_steps"] == s1["tpot_p50_steps"]
+
+
+@pytest.mark.chaos
 def test_frontend_fault_smoke_campaign_green(tiny_model):
     """Tier-1 smoke storm: a couple of seeded plans through the
     campaign runner (the `cli chaos faults --replicas 3` core) hold
