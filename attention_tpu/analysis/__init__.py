@@ -11,7 +11,11 @@ passes that flag, before anything traces or compiles,
 - tree conventions — the absorbed ``scripts/check_*`` lints and the
   source-only guard (ATP5xx/ATP601, `conventions`),
 - torn-write-prone persistence in the durable modules (ATP701,
-  `durability`).
+  `durability`),
+- determinism hazards across call edges — wall-clock into artifacts,
+  unseeded randomness, unordered iteration/accumulation (ATP8xx,
+  `determinism`, on the `callgraph` + `dataflow` interprocedural
+  core).
 
 Entry points: ``cli analyze`` (text/JSON/SARIF, ``--changed``),
 ``scripts/check_all.py`` (the tier-1 gate), and `core.analyze` as a
@@ -34,6 +38,7 @@ from attention_tpu.analysis.core import (  # noqa: F401
 )
 from attention_tpu.analysis import (  # noqa: F401  (pass registration)
     conventions,
+    determinism,
     durability,
     errors,
     pallas,

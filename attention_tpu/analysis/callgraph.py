@@ -1,0 +1,489 @@
+"""Project-scope symbol table + call graph for interprocedural passes.
+
+PR 5's passes are file-scope: a hazard one helper call away from a jit
+body — or a wall-clock read three modules from the artifact it taints —
+is invisible.  This module builds the whole-tree index those passes
+need:
+
+- a per-module **symbol table**: module-level ``def``s and ``class``es,
+  every ``import``/``from-import`` binding (followed lazily through
+  re-export chains, so ``obs.counter`` resolves through
+  ``obs/__init__`` to ``obs/registry.py::counter``), plus module-level
+  aliases ``g = f`` and ``g = functools.partial(f, ...)``;
+- a **call graph**: one :class:`CallSite` per call expression in every
+  top-level function/method (nested defs ride their enclosing
+  function), with the callee resolved to a :class:`FunctionInfo` when
+  the chain is decidable — ``self.m()`` via the enclosing class (and
+  its in-index bases), ``mod.sub.f()`` via the import tables,
+  ``partial(f, ...)()`` via unwrap;
+- the **canonical name** of every call that does NOT resolve in-tree
+  (``np.random.normal`` -> ``numpy.random.normal``,
+  ``from time import time as now; now()`` -> ``time.time``), so
+  source/sink matchers in the determinism passes see through aliasing;
+- the ``--changed`` **reverse closure**: the set of files holding
+  callers (transitively) of anything defined in a changed file.
+
+Bounded, never guessing: any link that is not decidable — a call on a
+subscript, an attribute of an unknown object, a name rebound
+dynamically — yields an *opaque* call site (``callee=None``) rather
+than a wrong edge.  Resolution chains are depth-limited and
+cycle-guarded.  The whole index is plain ``ast`` — jax-free, one parse
+per file, built once per ``analyze()`` run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable
+
+from attention_tpu.analysis.core import dotted_name, iter_source_files
+
+#: maximum hops when chasing import/alias chains (cycle insurance)
+_RESOLVE_DEPTH = 8
+
+_PARTIAL = ("partial", "functools.partial")
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionInfo:
+    """One module-level function or class method."""
+
+    qual: str                 # "path::name" or "path::Class.name"
+    path: str
+    name: str
+    cls: str | None           # owning class qual, None for free functions
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassInfo:
+    qual: str                 # "path::Name"
+    path: str
+    name: str
+    bases: tuple[str, ...]    # base expressions as written (dotted)
+    methods: dict = dataclasses.field(default_factory=dict, hash=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    """One call expression inside ``caller``.
+
+    ``callee`` is the resolved in-tree function qual, or None when the
+    call is opaque; ``name`` is then the best canonical dotted name
+    (``numpy.random.normal``) or the raw text when even that is
+    unknown.
+    """
+
+    caller: str
+    callee: str | None
+    name: str | None
+    lineno: int
+    col: int
+    node: ast.Call = dataclasses.field(hash=False, compare=False)
+
+
+class _Module:
+    __slots__ = ("path", "dotted", "tree", "src", "symbols")
+
+    def __init__(self, path: str, dotted: str, tree: ast.Module, src: str):
+        self.path = path
+        self.dotted = dotted
+        self.tree = tree
+        self.src = src
+        #: name -> ("func", qual) | ("class", qual) | ("import", dotted)
+        #:         | ("ext", dotted)
+        self.symbols: dict[str, tuple[str, str]] = {}
+
+
+def _module_dotted(path: str) -> str:
+    """``attention_tpu/obs/naming.py`` -> ``attention_tpu.obs.naming``;
+    ``pkg/__init__.py`` -> ``pkg``."""
+    p = path[:-3] if path.endswith(".py") else path
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _is_partial(node: ast.expr) -> bool:
+    return dotted_name(node) in _PARTIAL
+
+
+class ProjectIndex:
+    """Symbol tables + call graph over one source tree."""
+
+    def __init__(self):
+        self.modules: dict[str, _Module] = {}
+        self._by_dotted: dict[str, str] = {}      # dotted -> path
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.calls: dict[str, list[CallSite]] = {}
+        self.callers: dict[str, set[str]] = {}    # callee qual -> callers
+        #: full-depth resolutions, keyed (module path, dotted) — the
+        #: same names recur at thousands of call sites
+        self._resolve_memo: dict[tuple[str, str],
+                                 tuple[str, str] | None] = {}
+        #: id(scope node) -> flattened source-order statement list;
+        #: shared by every dataflow query over this index (the index's
+        #: module trees keep the nodes alive, so ids stay valid)
+        self._stmt_cache: dict[int, list] = {}
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(cls, root: str,
+              rel_paths: Iterable[str] | None = None) -> "ProjectIndex":
+        """Index every scanned ``.py`` file under ``root``."""
+        sources: dict[str, str] = {}
+        for rel in (rel_paths if rel_paths is not None
+                    else iter_source_files(root)):
+            if not rel.endswith(".py"):
+                continue
+            full = os.path.join(root, rel)
+            if not os.path.isfile(full):
+                continue
+            with open(full, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        return cls.from_sources(sources)
+
+    @classmethod
+    def from_sources(cls, sources: dict[str, str]) -> "ProjectIndex":
+        """Index in-memory ``{rel_path: source}`` (the test seam)."""
+        idx = cls()
+        for path, src in sorted(sources.items()):
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError:
+                continue  # ATP001's problem, not the call graph's
+            mod = _Module(path, _module_dotted(path), tree, src)
+            idx.modules[path] = mod
+            idx._by_dotted[mod.dotted] = path
+        for mod in idx.modules.values():
+            idx._collect_defs(mod)
+        for mod in idx.modules.values():
+            idx._collect_imports_and_aliases(mod)
+        for mod in idx.modules.values():
+            idx._collect_calls(mod)
+        return idx
+
+    def _collect_defs(self, mod: _Module) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{mod.path}::{node.name}"
+                info = FunctionInfo(qual, mod.path, node.name, None, node)
+                self.functions[qual] = info
+                mod.symbols[node.name] = ("func", qual)
+            elif isinstance(node, ast.ClassDef):
+                cqual = f"{mod.path}::{node.name}"
+                bases = tuple(d for d in (dotted_name(b)
+                                          for b in node.bases) if d)
+                cinfo = ClassInfo(cqual, mod.path, node.name, bases)
+                self.classes[cqual] = cinfo
+                mod.symbols[node.name] = ("class", cqual)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fqual = f"{mod.path}::{node.name}.{sub.name}"
+                        finfo = FunctionInfo(fqual, mod.path, sub.name,
+                                             cqual, sub)
+                        self.functions[fqual] = finfo
+                        cinfo.methods[sub.name] = finfo
+
+    def _collect_imports_and_aliases(self, mod: _Module) -> None:
+        # imports anywhere in the file feed one module-wide table — a
+        # bounded over-approximation (function-local imports are the
+        # idiom here, and a name is never re-imported as two different
+        # things in this tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.symbols[alias.asname] = ("import", alias.name)
+                    else:
+                        head = alias.name.split(".")[0]
+                        mod.symbols.setdefault(head, ("import", head))
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: anchor at this file's package
+                    pkg = mod.dotted.split(".")
+                    if mod.path.endswith("__init__.py"):
+                        pkg = pkg  # package dotted already
+                    else:
+                        pkg = pkg[:-1]
+                    pkg = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 \
+                        else pkg
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue  # never guess star imports
+                    full = f"{base}.{alias.name}" if base else alias.name
+                    mod.symbols[alias.asname or alias.name] = (
+                        "import", full)
+        # module-level aliases: g = f  /  g = partial(f, ...)
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            tgt = node.targets[0].id
+            val = node.value
+            if isinstance(val, ast.Call) and _is_partial(val.func) \
+                    and val.args:
+                val = val.args[0]
+            d = dotted_name(val)
+            if d and tgt not in mod.symbols:
+                mod.symbols[tgt] = ("alias", d)
+
+    # -- symbol resolution ------------------------------------------------
+
+    def _module_attr(self, path: str, name: str,
+                     depth: int) -> tuple[str, str] | None:
+        mod = self.modules.get(path)
+        if mod is None:
+            return None
+        return self._resolve_symbol(mod, name, depth)
+
+    def _resolve_symbol(self, mod: _Module, name: str,
+                        depth: int) -> tuple[str, str] | None:
+        """A module-table entry chased to ("func"|"class"|"mod"|"ext",
+        ref) — None when the name is unbound (builtins stay opaque)."""
+        if depth <= 0:
+            return None
+        t = mod.symbols.get(name)
+        if t is None:
+            return None
+        kind, ref = t
+        if kind in ("func", "class"):
+            return t
+        if kind == "alias":
+            return self._resolve_dotted_in(mod, ref, depth - 1)
+        if kind == "import":
+            return self._resolve_import(ref, depth - 1)
+        return t
+
+    def _resolve_import(self, dotted: str,
+                        depth: int) -> tuple[str, str] | None:
+        if depth <= 0:
+            return None
+        if dotted in self._by_dotted:
+            return ("mod", self._by_dotted[dotted])
+        if "." in dotted:
+            parent, leaf = dotted.rsplit(".", 1)
+            if parent in self._by_dotted:
+                got = self._module_attr(self._by_dotted[parent], leaf,
+                                        depth - 1)
+                return got  # None: member we can't see — opaque
+            head = dotted.split(".")[0]
+            if head in self._by_dotted:
+                return None  # deep path into an indexed pkg we can't chase
+        return ("ext", dotted)
+
+    def _resolve_dotted_in(self, mod: _Module, dotted: str,
+                           depth: int) -> tuple[str, str] | None:
+        """Resolve ``a.b.c`` as written inside ``mod``."""
+        if depth <= 0:
+            return None
+        memo_key = (mod.path, dotted) if depth == _RESOLVE_DEPTH else None
+        if memo_key is not None and memo_key in self._resolve_memo:
+            return self._resolve_memo[memo_key]
+        got = self._resolve_dotted_uncached(mod, dotted, depth)
+        if memo_key is not None:
+            self._resolve_memo[memo_key] = got
+        return got
+
+    def _resolve_dotted_uncached(self, mod: _Module, dotted: str,
+                                 depth: int) -> tuple[str, str] | None:
+        parts = dotted.split(".")
+        t = self._resolve_symbol(mod, parts[0], depth)
+        if t is None:
+            return None
+        for i, part in enumerate(parts[1:], start=1):
+            kind, ref = t
+            if kind == "mod":
+                t = self._module_attr(ref, part, depth - 1)
+                if t is None:
+                    return None
+            elif kind == "ext":
+                return ("ext", ref + "." + ".".join(parts[i:]))
+            elif kind == "class":
+                m = self.classes[ref].methods.get(part) \
+                    or self._inherited_method(ref, part)
+                return ("func", m.qual) if m and i == len(parts) - 1 \
+                    else None
+            else:
+                return None  # attribute of a function: opaque
+        return t
+
+    def _inherited_method(self, cqual: str,
+                          name: str) -> FunctionInfo | None:
+        """Walk in-index base classes (bounded, cycle-guarded)."""
+        seen = set()
+        stack = [cqual]
+        while stack and len(seen) < _RESOLVE_DEPTH:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            cls = self.classes.get(q)
+            if cls is None:
+                continue
+            m = cls.methods.get(name)
+            if m is not None:
+                return m
+            mod = self.modules[cls.path]
+            for b in cls.bases:
+                t = self._resolve_dotted_in(mod, b, _RESOLVE_DEPTH)
+                if t and t[0] == "class":
+                    stack.append(t[1])
+        return None
+
+    def canonical_name(self, path: str, dotted: str) -> str:
+        """``np.random.normal`` written in ``path`` -> the canonical
+        external dotted name (``numpy.random.normal``); unresolvable
+        names come back as written."""
+        mod = self.modules.get(path)
+        if mod is None or not dotted:
+            return dotted
+        t = self._resolve_dotted_in(mod, dotted, _RESOLVE_DEPTH)
+        if t and t[0] == "ext":
+            return t[1]
+        return dotted
+
+    # -- call resolution --------------------------------------------------
+
+    def resolve_call(self, path: str, cls_qual: str | None,
+                     call: ast.Call,
+                     local_aliases: dict[str, str] | None = None,
+                     shadowed: set[str] | None = None,
+                     ) -> tuple[str | None, str | None]:
+        """(callee qual | None, canonical/raw dotted | None)."""
+        mod = self.modules.get(path)
+        func = call.func
+        if isinstance(func, ast.Call):  # partial(f, ...)(args)
+            if _is_partial(func.func) and func.args:
+                inner = ast.Call(func=func.args[0], args=[], keywords=[])
+                ast.copy_location(inner, call)
+                return self.resolve_call(path, cls_qual, inner,
+                                         local_aliases, shadowed)
+            return None, None
+        d = dotted_name(func)
+        if d is None or mod is None:
+            return None, d
+        parts = d.split(".")
+        head = parts[0]
+        if head in ("self", "cls") and cls_qual and len(parts) == 2:
+            # before the shadow check: self/cls are always parameters
+            m = (self.classes[cls_qual].methods.get(parts[1])
+                 or self._inherited_method(cls_qual, parts[1]))
+            return (m.qual, d) if m else (None, d)
+        if shadowed and head in shadowed:
+            return None, d
+        if local_aliases and head in local_aliases and len(parts) == 1:
+            d = local_aliases[head]
+            parts = d.split(".")
+            head = parts[0]
+        t = self._resolve_dotted_in(mod, d, _RESOLVE_DEPTH)
+        if t is None:
+            return None, d
+        kind, ref = t
+        if kind == "func":
+            return ref, d
+        if kind == "class":
+            # constructor call: resolve to __init__ when indexed
+            m = self.classes[ref].methods.get("__init__") \
+                or self._inherited_method(ref, "__init__")
+            return (m.qual if m else None), d
+        if kind == "ext":
+            return None, ref
+        return None, d
+
+    def _collect_calls(self, mod: _Module) -> None:
+        for qual, info in list(self.functions.items()):
+            if info.path != mod.path:
+                continue
+            aliases, shadowed = _local_env(info.node)
+            sites = []
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee, name = self.resolve_call(
+                    mod.path, info.cls, node, aliases, shadowed)
+                site = CallSite(qual, callee, name, node.lineno,
+                                node.col_offset, node)
+                sites.append(site)
+                if callee is not None:
+                    self.callers.setdefault(callee, set()).add(qual)
+            self.calls[qual] = sites
+
+    def sites_in(self, fn: ast.AST, path: str,
+                 cls_qual: str | None = None) -> list[CallSite]:
+        """Resolve every call under an arbitrary node (for passes that
+        walk scopes the function table doesn't cover)."""
+        aliases, shadowed = (_local_env(fn)
+                             if isinstance(fn, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))
+                             else ({}, set()))
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee, name = self.resolve_call(path, cls_qual, node,
+                                                 aliases, shadowed)
+                out.append(CallSite("<adhoc>", callee, name, node.lineno,
+                                    node.col_offset, node))
+        return out
+
+    # -- --changed reverse closure ---------------------------------------
+
+    def files_calling(self, changed: Iterable[str]) -> set[str]:
+        """Every file holding a (transitive) caller of any function
+        defined in ``changed`` — the extra files a ``--changed`` run
+        must lint once interprocedural passes are active."""
+        target_files = set(changed)
+        out: set[str] = set()
+        grew = True
+        while grew:
+            grew = False
+            for qual, sites in self.calls.items():
+                cpath = self.functions[qual].path
+                if cpath in target_files or cpath in out:
+                    continue
+                for s in sites:
+                    if s.callee is None:
+                        continue
+                    callee_path = self.functions[s.callee].path
+                    if callee_path in target_files or callee_path in out:
+                        out.add(cpath)
+                        grew = True
+                        break
+        return out
+
+
+def _local_env(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+               ) -> tuple[dict[str, str], set[str]]:
+    """(local aliases ``g -> f.dotted``, names shadowed by params or
+    non-alias assignment — those must NOT fall through to the module
+    table)."""
+    a = fn.args
+    shadowed = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+    for p in (a.vararg, a.kwarg):
+        if p:
+            shadowed.add(p.arg)
+    aliases: dict[str, str] = {}
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tgt = node.targets[0].id
+        val = node.value
+        if isinstance(val, ast.Call) and _is_partial(val.func) and val.args:
+            val = val.args[0]
+        d = dotted_name(val)
+        if d and tgt not in shadowed:
+            aliases.setdefault(tgt, d)
+        else:
+            shadowed.add(tgt)
+            aliases.pop(tgt, None)
+    return aliases, shadowed

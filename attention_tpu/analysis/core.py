@@ -113,13 +113,17 @@ class Pass:
     scope: str  # "file" | "project"
     fn: Callable
     doc: str
+    #: interprocedural passes get the tree-wide `callgraph.ProjectIndex`
+    #: as an extra ``index=`` argument (built once per analyze() run)
+    needs_index: bool = False
 
 
 #: pass name -> Pass, insertion-ordered
 PASSES: dict[str, Pass] = {}
 
 
-def _register(name: str, codes: Iterable[str], scope: str, fn: Callable):
+def _register(name: str, codes: Iterable[str], scope: str, fn: Callable,
+              needs_index: bool = False):
     if name in PASSES:
         raise ValueError(f"duplicate pass {name!r}")
     codes = tuple(codes)
@@ -128,25 +132,29 @@ def _register(name: str, codes: Iterable[str], scope: str, fn: Callable):
             raise ValueError(f"pass {name!r} emits unregistered code {c}")
     PASSES[name] = Pass(name, codes, scope, fn,
                         (fn.__doc__ or "").strip().splitlines()[0]
-                        if fn.__doc__ else "")
+                        if fn.__doc__ else "",
+                        needs_index)
     return fn
 
 
-def file_pass(name: str, codes: Iterable[str]):
+def file_pass(name: str, codes: Iterable[str], needs_index: bool = False):
     """Register ``fn(path, tree, src) -> Iterable[Finding]`` to run on
-    every scanned Python file (``path`` is repo-root-relative)."""
+    every scanned Python file (``path`` is repo-root-relative).  With
+    ``needs_index`` the signature grows an ``index=None`` 4th param."""
 
     def deco(fn):
-        return _register(name, codes, "file", fn)
+        return _register(name, codes, "file", fn, needs_index)
 
     return deco
 
 
-def project_pass(name: str, codes: Iterable[str]):
-    """Register ``fn(root) -> Iterable[Finding]`` to run once per tree."""
+def project_pass(name: str, codes: Iterable[str],
+                 needs_index: bool = False):
+    """Register ``fn(root) -> Iterable[Finding]`` to run once per tree
+    (with ``needs_index``: ``fn(root, index=None)``)."""
 
     def deco(fn):
-        return _register(name, codes, "project", fn)
+        return _register(name, codes, "project", fn, needs_index)
 
     return deco
 
@@ -236,49 +244,93 @@ def iter_source_files(root: str) -> Iterator[str]:
 
 
 def analyze_file(root: str, rel: str,
-                 passes: Iterable[Pass] | None = None) -> list[Finding]:
+                 passes: Iterable[Pass] | None = None,
+                 index=None,
+                 timings: dict[str, float] | None = None) -> list[Finding]:
     """Run the file passes on one file; suppressions already applied."""
-    passes = [p for p in (passes or PASSES.values()) if p.scope == "file"]
-    with open(os.path.join(root, rel), encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        return [Finding(ATP001, f"syntax error: {e.msg}", rel,
-                        e.lineno or 0, (e.offset or 1) - 1)]
+    passes = [p for p in (PASSES.values() if passes is None else passes)
+              if p.scope == "file"]
+    mod = index.modules.get(rel) if index is not None else None
+    if mod is not None:  # reuse the index's parse
+        src, tree = mod.src, mod.tree
+    else:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            return [Finding(ATP001, f"syntax error: {e.msg}", rel,
+                            e.lineno or 0, (e.offset or 1) - 1)]
     findings: list[Finding] = []
     for p in passes:
-        findings.extend(p.fn(rel, tree, src))
+        t0 = _clock()
+        if p.needs_index:
+            findings.extend(p.fn(rel, tree, src, index=index))
+        else:
+            findings.extend(p.fn(rel, tree, src))
+        if timings is not None:
+            timings[p.name] = timings.get(p.name, 0.0) + _clock() - t0
     lines = src.splitlines()
     return [f for f in findings if not is_suppressed(f, lines)]
+
+
+def _clock() -> float:
+    import time
+    return time.perf_counter()
+
+
+def build_index(root: str, rel_paths: Iterable[str] | None = None):
+    """The tree-wide ``callgraph.ProjectIndex`` (imported lazily so
+    plain file-pass runs never pay for it)."""
+    from attention_tpu.analysis import callgraph
+    return callgraph.ProjectIndex.build(root, rel_paths)
 
 
 def analyze(root: str | None = None,
             rel_paths: Iterable[str] | None = None,
             passes: Iterable[str] | None = None,
-            include_project: bool = True) -> list[Finding]:
+            include_project: bool = True,
+            timings: dict[str, float] | None = None,
+            index=None) -> list[Finding]:
     """Run registered passes over the tree (or just ``rel_paths``).
 
     Project passes always see the whole tree — they check committed
     artifacts (tables, ledgers, the git index), not individual files —
-    so a ``--changed`` run still enforces them.
+    so a ``--changed`` run still enforces them.  When any selected pass
+    is interprocedural the project index is built once (over the WHOLE
+    tree, even for a ``rel_paths`` run: call edges cross files) and
+    threaded through.  ``timings`` (when given) collects cumulative
+    per-pass wall seconds plus the index build under ``"<index>"``.
     """
     root = root or repo_root()
     selected = ([PASSES[name] for name in passes] if passes
                 else list(PASSES.values()))
     if rel_paths is None:
         rel_paths = list(iter_source_files(root))
+    if index is None and any(p.needs_index for p in selected):
+        t0 = _clock()
+        index = build_index(root)
+        if timings is not None:
+            timings["<index>"] = _clock() - t0
     findings: list[Finding] = []
     file_passes = [p for p in selected if p.scope == "file"]
-    for rel in rel_paths:
+    for rel in rel_paths if file_passes else ():
         if not rel.endswith(".py"):
             continue
         if not os.path.isfile(os.path.join(root, rel)):
             continue  # e.g. --changed listing a deleted file
-        findings.extend(analyze_file(root, rel, file_passes))
+        findings.extend(analyze_file(root, rel, file_passes, index=index,
+                                     timings=timings))
     if include_project:
         for p in selected:
             if p.scope == "project":
-                findings.extend(p.fn(root))
+                t0 = _clock()
+                if p.needs_index:
+                    findings.extend(p.fn(root, index=index))
+                else:
+                    findings.extend(p.fn(root))
+                if timings is not None:
+                    timings[p.name] = (timings.get(p.name, 0.0)
+                                       + _clock() - t0)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings

@@ -1,0 +1,477 @@
+"""A small taint lattice over the call graph (sources/sinks/sanitizers).
+
+The determinism passes (ATP8xx) all reduce to one question: *can a
+value produced here reach an artifact over there?*  This module answers
+it with a deliberately small forward dataflow:
+
+- the lattice is ``None < label`` per tracked name (labels are short
+  strings like ``"time.perf_counter"`` naming the originating source —
+  they ride into finding messages);
+- the environment is **name-level**: plain names, plus dotted
+  attribute roots (``self._wall``) so per-object state threads between
+  the methods of one class, plus module-level globals as seeds for
+  every function in that module;
+- propagation is **syntactic and conservative**: assignments,
+  augmented assignments, ``for``/``with`` targets, arithmetic,
+  containers, f-strings, and — unless a call is a registered
+  sanitizer — *through* opaque calls (a tainted argument taints the
+  result, so ``round(wall, 4)`` stays tainted and ``sorted(s)`` does
+  not);
+- calls resolved by the :mod:`callgraph` index propagate **along call
+  edges with a depth cap**: a call taints its result when the callee's
+  return value is (transitively, up to ``max_depth`` edges) tainted,
+  and a tainted argument reaches a sink when the callee (transitively,
+  same cap) forwards that parameter into one.
+
+Beyond the cap the analysis assumes *clean* — bounded, never guessing,
+matching the callgraph's contract.  Env construction runs the
+statement scan twice so loop-carried taint converges, then a third
+pass collects sink hits.  Everything is plain ``ast``; no imports of
+the analyzed code ever happen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator
+
+from attention_tpu.analysis.callgraph import (
+    CallSite,
+    FunctionInfo,
+    ProjectIndex,
+    _local_env,
+)
+from attention_tpu.analysis.core import dotted_name
+
+#: default interprocedural depth cap (call edges followed per query)
+MAX_DEPTH = 3
+
+
+def iter_stmts_ordered(node: ast.AST) -> Iterator[ast.AST]:
+    """Source-order traversal of a scope: yields every descendant but
+    does not enter nested function/class/lambda bodies (they are their
+    own scopes)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+            yield from iter_stmts_ordered(child)
+
+
+def ordered_stmts(index: ProjectIndex, node: ast.AST) -> list[ast.AST]:
+    """``iter_stmts_ordered(node)`` flattened once and cached on the
+    index — every summary query re-scans the same scopes, and the
+    recursive generator dominated the tree-wide budget before this."""
+    cache = index._stmt_cache
+    got = cache.get(id(node))
+    if got is None:
+        got = list(iter_stmts_ordered(node))
+        cache[id(node)] = got
+    return got
+
+
+def target_key(node: ast.expr) -> str | None:
+    """The env key a store binds: a plain name, or the dotted root of
+    an attribute/subscript chain (``self._wall[rid] = ...`` stores
+    under ``self._wall``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return dotted_name(node)
+
+
+def _join(*labels: str | None) -> str | None:
+    for lb in labels:
+        if lb:
+            return lb
+    return None
+
+
+class TaintAnalysis:
+    """One spec (source/sink/sanitizer hooks) evaluated over an index.
+
+    Hooks (all optional except ``source``):
+
+    - ``source(site) -> label|None`` — the call is a taint source;
+    - ``expr_source(node, taint_of) -> label|None`` — non-call
+      expression sources (set displays, comprehensions); ``taint_of``
+      lets the hook ask about sub-expressions;
+    - ``sink(site) -> kind|None`` — the call consumes its arguments
+      into a deterministic artifact;
+    - ``sanitizer(site) -> bool`` — the call's result is clean no
+      matter its arguments (``sorted``, explicit re-seeding, ...);
+    - ``taint_loop_var`` — whether ``for x in tainted:`` taints ``x``
+      (True for value taint like wall-clock; False for container
+      properties like unorderedness).
+    """
+
+    def __init__(self, index: ProjectIndex, *,
+                 source: Callable[[CallSite], str | None],
+                 sink: Callable[[CallSite], str | None] | None = None,
+                 sanitizer: Callable[[CallSite], bool] | None = None,
+                 expr_source: Callable | None = None,
+                 taint_loop_var: bool = True,
+                 decision_sinks: bool = False,
+                 max_depth: int = MAX_DEPTH):
+        self.index = index
+        self.source = source
+        self.sink = sink or (lambda site: None)
+        self.sanitizer = sanitizer or (lambda site: False)
+        self.expr_source = expr_source
+        self.taint_loop_var = taint_loop_var
+        self.decision_sinks = decision_sinks
+        self.max_depth = max_depth
+        self._site_by_node: dict[int, CallSite] = {}
+        for sites in index.calls.values():
+            for s in sites:
+                self._site_by_node[id(s.node)] = s
+        self._module_env_memo: dict[str, dict[str, str]] = {}
+        self._module_sites: dict[str, dict[int, CallSite]] = {}
+        self._class_attr_memo: dict[str, dict[str, str]] = {}
+        self._returns_memo: dict[tuple[str, int], str | None] = {}
+        self._param_sink_memo: dict[tuple[str, int, int], str | None] = {}
+        self._param_ret_memo: dict[tuple[str, int, int], bool] = {}
+        self._in_progress: set[tuple] = set()
+
+    # -- call-site lookup -------------------------------------------------
+
+    def _site(self, call: ast.Call, path: str,
+              cls_qual: str | None) -> CallSite:
+        site = self._site_by_node.get(id(call))
+        if site is None:
+            mod_sites = self._module_sites.get(path)
+            if mod_sites is not None:
+                site = mod_sites.get(id(call))
+        if site is None:
+            callee, name = self.index.resolve_call(path, cls_qual, call)
+            site = CallSite("<adhoc>", callee, name, call.lineno,
+                            call.col_offset, call)
+        return site
+
+    # -- environments -----------------------------------------------------
+
+    def module_env(self, path: str) -> dict[str, str]:
+        """Taint of module-level globals (seeds every scope in the
+        file: a ``_T0 = time.perf_counter()`` at import time taints
+        ``_T0`` everywhere)."""
+        if path in self._module_env_memo:
+            return self._module_env_memo[path]
+        self._module_env_memo[path] = {}  # cycle guard
+        mod = self.index.modules.get(path)
+        if mod is None:
+            return {}
+        sites: dict[int, CallSite] = {}
+        for node in ordered_stmts(self.index, mod.tree):
+            if isinstance(node, ast.Call):
+                callee, name = self.index.resolve_call(path, None, node)
+                sites[id(node)] = CallSite("<module>", callee, name,
+                                           node.lineno, node.col_offset,
+                                           node)
+        self._module_sites[path] = sites
+        env: dict[str, str] = {}
+        for _ in range(2):
+            self._env_pass(mod.tree, env, path, None, self.max_depth)
+        self._module_env_memo[path] = env
+        return env
+
+    def class_attrs(self, cls_qual: str) -> dict[str, str]:
+        """Tainted ``self.<attr>`` roots, unioned over the class's
+        methods (one seedless round) — how ``add_request`` stamping
+        ``self._wall`` reaches ``_finish_request`` reading it."""
+        if cls_qual in self._class_attr_memo:
+            return self._class_attr_memo[cls_qual]
+        self._class_attr_memo[cls_qual] = {}  # cycle guard
+        cls = self.index.classes.get(cls_qual)
+        if cls is None:
+            return {}
+        attrs: dict[str, str] = {}
+        for m in cls.methods.values():
+            env = dict(self.module_env(m.path))
+            for _ in range(2):
+                self._env_pass(m.node, env, m.path, cls_qual,
+                               self.max_depth)
+            for k, v in env.items():
+                if k.startswith("self."):
+                    attrs.setdefault(k, v)
+        self._class_attr_memo[cls_qual] = attrs
+        return attrs
+
+    def function_env(self, info: FunctionInfo,
+                     seed: dict[str, str] | None = None,
+                     depth: int | None = None) -> dict[str, str]:
+        depth = self.max_depth if depth is None else depth
+        env = dict(self.module_env(info.path))
+        if info.cls:
+            env.update(self.class_attrs(info.cls))
+        if seed:
+            env.update(seed)
+        for _ in range(2):
+            self._env_pass(info.node, env, info.path, info.cls, depth)
+        return env
+
+    def _env_pass(self, scope: ast.AST, env: dict[str, str], path: str,
+                  cls_qual: str | None, depth: int) -> None:
+        for node in ordered_stmts(self.index, scope):
+            if isinstance(node, ast.Assign):
+                lb = self.taint_of(node.value, env, path, cls_qual, depth)
+                for tgt in node.targets:
+                    for t in (tgt.elts if isinstance(
+                            tgt, (ast.Tuple, ast.List)) else [tgt]):
+                        key = target_key(t)
+                        if key:
+                            if lb:
+                                env[key] = lb
+                            elif isinstance(t, ast.Name):
+                                env.pop(key, None)  # clean rebind
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                key = target_key(node.target)
+                lb = self.taint_of(node.value, env, path, cls_qual, depth)
+                if key and lb:
+                    env[key] = lb
+            elif isinstance(node, ast.AugAssign):
+                key = target_key(node.target)
+                lb = self.taint_of(node.value, env, path, cls_qual, depth)
+                if key and lb:
+                    env.setdefault(key, lb)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                lb = self.taint_of(node.iter, env, path, cls_qual, depth)
+                if lb and self.taint_loop_var:
+                    key = target_key(node.target)
+                    if key:
+                        env[key] = lb
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        key = target_key(item.optional_vars)
+                        lb = self.taint_of(item.context_expr, env, path,
+                                           cls_qual, depth)
+                        if key and lb:
+                            env[key] = lb
+
+    # -- expression taint -------------------------------------------------
+
+    def taint_of(self, node: ast.expr, env: dict[str, str], path: str,
+                 cls_qual: str | None, depth: int) -> str | None:
+        if self.expr_source is not None:
+            lb = self.expr_source(
+                node, lambda e: self.taint_of(e, env, path, cls_qual,
+                                              depth))
+            if lb:
+                return lb
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            d = dotted_name(node)
+            if d and d in env:
+                return env[d]
+            return self.taint_of(node.value, env, path, cls_qual, depth)
+        if isinstance(node, ast.Subscript):
+            return self.taint_of(node.value, env, path, cls_qual, depth)
+        if isinstance(node, ast.Call):
+            return self.call_taint(node, env, path, cls_qual, depth)
+        if isinstance(node, ast.BinOp):
+            return _join(
+                self.taint_of(node.left, env, path, cls_qual, depth),
+                self.taint_of(node.right, env, path, cls_qual, depth))
+        if isinstance(node, ast.UnaryOp):
+            return self.taint_of(node.operand, env, path, cls_qual, depth)
+        if isinstance(node, ast.BoolOp):
+            return _join(*(self.taint_of(v, env, path, cls_qual, depth)
+                           for v in node.values))
+        if isinstance(node, ast.Compare):
+            return _join(
+                self.taint_of(node.left, env, path, cls_qual, depth),
+                *(self.taint_of(c, env, path, cls_qual, depth)
+                  for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return _join(
+                self.taint_of(node.body, env, path, cls_qual, depth),
+                self.taint_of(node.orelse, env, path, cls_qual, depth))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return _join(*(self.taint_of(e, env, path, cls_qual, depth)
+                           for e in node.elts))
+        if isinstance(node, ast.Dict):
+            return _join(*(self.taint_of(v, env, path, cls_qual, depth)
+                           for v in list(node.keys) + list(node.values)
+                           if v is not None))
+        if isinstance(node, ast.JoinedStr):
+            return _join(*(self.taint_of(v.value, env, path, cls_qual,
+                                         depth)
+                           for v in node.values
+                           if isinstance(v, ast.FormattedValue)))
+        if isinstance(node, ast.Starred):
+            return self.taint_of(node.value, env, path, cls_qual, depth)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            return self.taint_of(node.generators[0].iter, env, path,
+                                 cls_qual, depth)
+        if isinstance(node, ast.DictComp):
+            return self.taint_of(node.generators[0].iter, env, path,
+                                 cls_qual, depth)
+        if isinstance(node, ast.NamedExpr):
+            return self.taint_of(node.value, env, path, cls_qual, depth)
+        return None
+
+    def call_taint(self, call: ast.Call, env: dict[str, str], path: str,
+                   cls_qual: str | None, depth: int) -> str | None:
+        site = self._site(call, path, cls_qual)
+        if self.sanitizer(site):
+            return None
+        lb = self.source(site)
+        if lb:
+            return lb
+        if site.callee is not None and depth > 0:
+            lb = self.returns_taint(site.callee, depth - 1)
+            if lb:
+                return lb
+            # a tainted argument survives a callee that returns it
+            # (the `def _r6(x): return round(x, 6)` helper shape)
+            for i, a in enumerate(call.args):
+                alb = self.taint_of(a, env, path, cls_qual, depth)
+                if alb and self.param_returns(site.callee, i, depth - 1):
+                    return alb
+            return None  # resolved call: trust the summary
+        # opaque call: conservative — tainted args/receiver taint the
+        # result (round(wall), wall.get("added"), f(t), str(t), ...)
+        parts = [self.taint_of(a, env, path, cls_qual, depth)
+                 for a in call.args]
+        parts += [self.taint_of(kw.value, env, path, cls_qual, depth)
+                  for kw in call.keywords]
+        if isinstance(call.func, ast.Attribute):
+            parts.append(self.taint_of(call.func.value, env, path,
+                                       cls_qual, depth))
+        return _join(*parts)
+
+    # -- interprocedural summaries ---------------------------------------
+
+    def returns_taint(self, qual: str, depth: int) -> str | None:
+        """Does ``qual``'s return value carry taint (within depth)?"""
+        key = (qual, depth)
+        if key in self._returns_memo:
+            return self._returns_memo[key]
+        if ("r", qual) in self._in_progress or depth < 0:
+            return None
+        info = self.index.functions.get(qual)
+        if info is None:
+            return None
+        self._in_progress.add(("r", qual))
+        try:
+            env = self.function_env(info, depth=depth)
+            lb = None
+            for node in ordered_stmts(self.index, info.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    lb = _join(lb, self.taint_of(
+                        node.value, env, info.path, info.cls, depth))
+                elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                        and node.value is not None:
+                    lb = _join(lb, self.taint_of(
+                        node.value, env, info.path, info.cls, depth))
+        finally:
+            self._in_progress.discard(("r", qual))
+        self._returns_memo[key] = lb
+        return lb
+
+    def param_sink(self, qual: str, param: int,
+                   depth: int) -> str | None:
+        """Does ``qual`` forward positional param ``param`` into a sink
+        (within depth)?  Returns the sink kind."""
+        key = (qual, param, depth)
+        if key in self._param_sink_memo:
+            return self._param_sink_memo[key]
+        if ("p", qual, param) in self._in_progress or depth < 0:
+            return None
+        info = self.index.functions.get(qual)
+        if info is None:
+            return None
+        args = info.node.args
+        names = [p.arg for p in args.posonlyargs + args.args]
+        if info.cls and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        if param >= len(names):
+            self._param_sink_memo[key] = None
+            return None
+        self._in_progress.add(("p", qual, param))
+        try:
+            seed = {names[param]: f"param:{names[param]}"}
+            env = self.function_env(info, seed=seed, depth=depth)
+            kind = None
+            for node in ordered_stmts(self.index, info.node):
+                if isinstance(node, ast.Call):
+                    k = self.sink_hit(node, env, info.path, info.cls,
+                                      depth)
+                    kind = _join(kind, k)
+                elif self.decision_sinks and isinstance(
+                        node, (ast.If, ast.While)):
+                    if self.taint_of(node.test, env, info.path, info.cls,
+                                     depth):
+                        kind = _join(kind, "decision")
+                if kind:
+                    break
+        finally:
+            self._in_progress.discard(("p", qual, param))
+        self._param_sink_memo[key] = kind
+        return kind
+
+    def param_returns(self, qual: str, param: int, depth: int) -> bool:
+        """Does ``qual`` return a value derived from positional param
+        ``param`` (within depth)?  Evaluated with a sentinel-only env so
+        other taint in the callee cannot mask the answer."""
+        key = (qual, param, depth)
+        if key in self._param_ret_memo:
+            return self._param_ret_memo[key]
+        if ("pr", qual, param) in self._in_progress or depth < 0:
+            return False
+        info = self.index.functions.get(qual)
+        if info is None:
+            return False
+        args = info.node.args
+        names = [p.arg for p in args.posonlyargs + args.args]
+        if info.cls and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        if param >= len(names):
+            self._param_ret_memo[key] = False
+            return False
+        self._in_progress.add(("pr", qual, param))
+        try:
+            sentinel = f"param:{names[param]}"
+            env = {names[param]: sentinel}
+            for _ in range(2):
+                self._env_pass(info.node, env, info.path, info.cls, depth)
+            hit = False
+            for node in ordered_stmts(self.index, info.node):
+                if isinstance(node, (ast.Return, ast.Yield)) \
+                        and node.value is not None:
+                    if self.taint_of(node.value, env, info.path, info.cls,
+                                     depth) == sentinel:
+                        hit = True
+                        break
+        finally:
+            self._in_progress.discard(("pr", qual, param))
+        self._param_ret_memo[key] = hit
+        return hit
+
+    def sink_hit(self, call: ast.Call, env: dict[str, str], path: str,
+                 cls_qual: str | None, depth: int) -> str | None:
+        """The sink kind this call realizes with the given env: a
+        registered sink consuming a tainted argument, or a resolved
+        callee that forwards a tainted positional argument into one
+        (depth-capped)."""
+        site = self._site(call, path, cls_qual)
+        if self.sanitizer(site):
+            return None
+        arg_taints = [self.taint_of(a, env, path, cls_qual, depth)
+                      for a in call.args]
+        kw_taints = [self.taint_of(kw.value, env, path, cls_qual, depth)
+                     for kw in call.keywords]
+        any_tainted = _join(*arg_taints, *kw_taints)
+        kind = self.sink(site)
+        if kind and any_tainted:
+            return kind
+        if site.callee is not None and depth > 0:
+            for i, lb in enumerate(arg_taints):
+                if lb is None:
+                    continue
+                k = self.param_sink(site.callee, i, depth - 1)
+                if k:
+                    return k
+        return None

@@ -1,0 +1,450 @@
+"""Determinism-hazard lints (ATP801-804) over the interprocedural core.
+
+Every fleet guarantee — token parity under chaos, byte-identical
+``slo_report()``/traces/digests, warm-recovery parity — reduces to
+*same seed, byte-identical execution*.  The chaos invariants enforce
+that dynamically; this pass family flags the classic ways code breaks
+it, statically, across call edges (:mod:`callgraph` resolves the
+edges, :mod:`dataflow` carries the taint with a depth cap):
+
+- **ATP801** — a wall-clock read (``time.time``/``monotonic``/
+  ``perf_counter``, argless ``datetime.now``) reaches a deterministic
+  artifact sink (snapshot/journal serialize, trace/SLO/RunRecord
+  emission) or steers an engine/frontend scheduling decision.  The
+  sanctioned idioms do NOT fire: virtual-clock ticks are not sources,
+  and obs instrument writes (``.observe``/``.set``/``.inc`` — the
+  ``_SAVE_MS.observe(...)`` shape) are not sinks.
+- **ATP802** — unseeded randomness (``random.*`` stdlib global,
+  legacy ``np.random.*`` global, argless ``default_rng()``,
+  ``os.urandom``/``secrets``/``uuid4``, ``jax.random.PRNGKey`` from a
+  non-literal non-threaded seed) created in — or returned by a helper
+  into — engine/frontend/chaos code, where every decision must replay
+  from the seeded chain.
+- **ATP803** — iterating a ``set``/``frozenset`` of non-literal
+  origin into an order-sensitive consumer (list/tuple build, ``join``,
+  ``enumerate``, early-exit selection, append/yield loops) without an
+  enclosing ``sorted()``.  Literal set displays are exempt; ``dict``
+  iteration is insertion-ordered on every supported runtime and only
+  fires when the dict itself was built over an unordered iterable.
+- **ATP804** — float accumulation (``sum``, ``+=`` in a loop) over an
+  unordered container: the result depends on hash-iteration order
+  (warning — harmless for ints/counters, wrong for floats).
+
+Scope is ``attention_tpu/`` only (bench/tests/scripts time things on
+purpose); findings honour ``# atp: disable=...`` like any file pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis import core
+from attention_tpu.analysis.callgraph import CallSite, ProjectIndex
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    project_pass,
+    register_code,
+)
+from attention_tpu.analysis.dataflow import (
+    TaintAnalysis,
+    _join,
+    iter_stmts_ordered,
+    ordered_stmts,
+)
+
+ATP801 = register_code(
+    "ATP801", "wall-clock-into-artifact", Severity.ERROR,
+    "a wall-clock read reaches a deterministic artifact sink or "
+    "scheduling decision (breaks same-seed byte-identical replay)")
+ATP802 = register_code(
+    "ATP802", "unseeded-randomness", Severity.ERROR,
+    "unseeded randomness (stdlib/np-legacy global RNG, os.urandom, "
+    "non-threaded PRNGKey) enters engine/frontend/chaos decision paths")
+ATP803 = register_code(
+    "ATP803", "unordered-iteration", Severity.ERROR,
+    "iteration over a set/frozenset of non-literal origin feeds an "
+    "order-sensitive consumer without an enclosing sorted()")
+ATP804 = register_code(
+    "ATP804", "unordered-float-accumulation", Severity.WARNING,
+    "float accumulation (sum / += in a loop) over an unordered "
+    "container — result depends on hash-iteration order")
+
+#: the determinism surface: serving code, not the harnesses that
+#: legitimately time/randomize (bench.py, tests/, scripts/)
+_SCOPE = "attention_tpu/"
+#: dirs where a tainted branch condition is a scheduling decision
+_DECISION_DIRS = ("attention_tpu/engine/", "attention_tpu/frontend/")
+#: dirs whose decisions must replay from the seeded chain
+_RNG_DIRS = ("attention_tpu/engine/", "attention_tpu/frontend/",
+             "attention_tpu/chaos/")
+
+# -- ATP801: wall clock ---------------------------------------------------
+
+_WALL = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.process_time", "time.process_time_ns",
+}
+_NOW_LEAVES = {"now", "utcnow", "today"}
+
+#: final call-name segments that emit deterministic artifacts (the
+#: repo's serialize/record/trace surface); obs instrument methods
+#: (.observe/.set/.inc/.add) are deliberately absent — that channel is
+#: the sanctioned save_ms-style home for wall timings
+_ARTIFACT_LEAVES = {
+    "serialize", "save_trace", "write_jsonl", "write_slo",
+    "append_jsonl", "write_repro_json", "write_repro_bin",
+    "write_testcase", "record", "record_event", "record_run",
+    "record_step", "record_request", "record_admit", "record_token",
+    "record_cancel", "record_finish", "record_timeout",
+    "to_run_record",
+}
+_ARTIFACT_CANON = {"json.dumps", "json.dump"}
+
+
+def _wall_source(site: CallSite) -> str | None:
+    n = site.name
+    if not n:
+        return None
+    if n in _WALL:
+        return n
+    if n.startswith("datetime.") and n.rsplit(".", 1)[-1] in _NOW_LEAVES \
+            and not site.node.args and not site.node.keywords:
+        return n
+    return None
+
+
+def _artifact_sink(site: CallSite) -> str | None:
+    n = site.name or ""
+    if n in _ARTIFACT_CANON:
+        return n
+    leaf = n.rsplit(".", 1)[-1]
+    if leaf in _ARTIFACT_LEAVES:
+        return leaf
+    return None
+
+
+def _candidates(index: ProjectIndex, max_depth: int, source_fn,
+                *, setcomps: bool = False) -> set[str]:
+    """Function quals that could possibly observe this spec's taint:
+    they contain a source call (or set comprehension), live in a module
+    with a module-level source, share a class with such a method (taint
+    threads through ``self.*``), or transitively call one within the
+    depth cap.  Everything else is provably clean under the spec, so
+    the expensive env construction skips it."""
+    base: set[str] = set()
+    for qual, sites in index.calls.items():
+        for s in sites:
+            if source_fn(s):
+                base.add(qual)
+                break
+    if setcomps:
+        for info in index.functions.values():
+            if info.qual not in base and any(
+                    isinstance(n, ast.SetComp)
+                    for n in ast.walk(info.node)):
+                base.add(info.qual)
+    mod_paths: set[str] = set()
+    for path, mod in index.modules.items():
+        for node in ordered_stmts(index, mod.tree):
+            if isinstance(node, ast.Call):
+                callee, name = index.resolve_call(path, None, node)
+                site = CallSite("<module>", callee, name, node.lineno,
+                                node.col_offset, node)
+                if source_fn(site):
+                    mod_paths.add(path)
+                    break
+            elif setcomps and isinstance(node, ast.SetComp):
+                mod_paths.add(path)
+                break
+    for info in index.functions.values():
+        if info.path in mod_paths:
+            base.add(info.qual)
+    for _ in range(max_depth + 1):
+        new: set[str] = set()
+        for q in sorted(base):
+            new |= index.callers.get(q, set()) - base
+            info = index.functions.get(q)
+            if info is not None and info.cls:
+                for m in index.classes[info.cls].methods.values():
+                    if m.qual not in base:
+                        new.add(m.qual)
+        if not new:
+            break
+        base |= new
+    return base
+
+
+def _arg_label(ta: TaintAnalysis, call: ast.Call, env, path, cls) -> str:
+    parts = [ta.taint_of(a, env, path, cls, ta.max_depth)
+             for a in call.args]
+    parts += [ta.taint_of(kw.value, env, path, cls, ta.max_depth)
+              for kw in call.keywords]
+    return _join(*parts) or "wall-clock"
+
+
+def _run_atp801(index: ProjectIndex, findings: list[Finding]) -> None:
+    ta = TaintAnalysis(index, source=_wall_source, sink=_artifact_sink)
+    cands = _candidates(index, ta.max_depth, _wall_source)
+    for info in index.functions.values():
+        if not info.path.startswith(_SCOPE) or info.qual not in cands:
+            continue
+        env = ta.function_env(info)
+        decide = info.path.startswith(_DECISION_DIRS)
+        for node in ordered_stmts(index, info.node):
+            if isinstance(node, ast.Call):
+                kind = ta.sink_hit(node, env, info.path, info.cls,
+                                   ta.max_depth)
+                if kind:
+                    lb = _arg_label(ta, node, env, info.path, info.cls)
+                    findings.append(Finding(
+                        ATP801,
+                        f"wall-clock value ({lb}) reaches deterministic "
+                        f"artifact sink `{kind}`",
+                        info.path, node.lineno, node.col_offset))
+            elif decide and isinstance(node, (ast.If, ast.While)):
+                lb = ta.taint_of(node.test, env, info.path, info.cls,
+                                 ta.max_depth)
+                if lb:
+                    findings.append(Finding(
+                        ATP801,
+                        f"wall-clock value ({lb}) steers a scheduling "
+                        f"decision (non-replayable branch)",
+                        info.path, node.lineno, node.col_offset))
+
+
+# -- ATP802: unseeded randomness ------------------------------------------
+
+_NP_SEEDED = {"default_rng", "Generator", "SeedSequence", "PCG64",
+              "Philox", "MT19937", "RandomState", "bit_generator"}
+_SEED_TOKENS = ("seed", "key", "rng")
+
+
+def _threaded_seed(call: ast.Call) -> bool:
+    """PRNGKey(x): literal seed, or an expression over names that carry
+    the seed chain (``seed``/``key``/``rng`` in the name)."""
+    exprs = list(call.args) + [kw.value for kw in call.keywords]
+    if not exprs:
+        return False
+    for arg in exprs:
+        if isinstance(arg, ast.Constant):
+            continue
+        toks = [n.id.lower() for n in ast.walk(arg)
+                if isinstance(n, ast.Name)]
+        toks += [n.attr.lower() for n in ast.walk(arg)
+                 if isinstance(n, ast.Attribute)]
+        if not any(t for t in toks
+                   for s in _SEED_TOKENS if s in t):
+            return False
+    return True
+
+
+def _rng_source(site: CallSite) -> str | None:
+    n = site.name or ""
+    if not n:
+        return None
+    if n == "os.urandom" or n == "uuid.uuid4" or n.startswith("secrets."):
+        return n
+    if n in ("jax.random.PRNGKey", "jax.random.key"):
+        return None if _threaded_seed(site.node) else n
+    if n.startswith("random."):
+        leaf = n.split(".", 1)[1]
+        if leaf == "SystemRandom":
+            return n
+        if leaf == "Random":
+            return n if not site.node.args and not site.node.keywords \
+                else None
+        if "." not in leaf and leaf[:1].islower() and leaf != "seed":
+            return n  # the module-global functions: random.random(), ...
+        return None
+    if n.startswith("numpy.random."):
+        leaf = n.rsplit(".", 1)[-1]
+        if leaf == "default_rng":
+            return n if not site.node.args and not site.node.keywords \
+                else None
+        if leaf in _NP_SEEDED:
+            return None
+        return n  # legacy global: np.random.normal() etc.
+    return None
+
+
+def _run_atp802(index: ProjectIndex, findings: list[Finding]) -> None:
+    ta = TaintAnalysis(index, source=_rng_source)
+    for info in index.functions.values():
+        if not info.path.startswith(_RNG_DIRS):
+            continue
+        for node in ordered_stmts(index, info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            site = ta._site(node, info.path, info.cls)
+            lb = _rng_source(site)
+            if lb:
+                findings.append(Finding(
+                    ATP802,
+                    f"unseeded randomness `{lb}` in a replay-critical "
+                    f"path — thread the seeded chain instead",
+                    info.path, node.lineno, node.col_offset))
+            elif site.callee is not None:
+                lb = ta.returns_taint(site.callee, ta.max_depth - 1)
+                if lb:
+                    findings.append(Finding(
+                        ATP802,
+                        f"`{site.name}` returns a value derived from "
+                        f"unseeded randomness (`{lb}`)",
+                        info.path, node.lineno, node.col_offset))
+    for path, mod in index.modules.items():
+        if not path.startswith(_RNG_DIRS):
+            continue
+        for node in ordered_stmts(index, mod.tree):
+            if isinstance(node, ast.Call):
+                lb = _rng_source(ta._site(node, path, None))
+                if lb:
+                    findings.append(Finding(
+                        ATP802,
+                        f"unseeded randomness `{lb}` at module scope in "
+                        f"a replay-critical path",
+                        path, node.lineno, node.col_offset))
+
+
+# -- ATP803/804: unordered iteration & accumulation -----------------------
+
+_ORDER_SINK_LEAVES = {"list", "tuple", "enumerate", "join"}
+#: consumers whose result is independent of iteration order — their
+#: comprehension/genexp arguments are exempt from ATP803
+_ORDER_FREE = {"sorted", "min", "max", "sum", "len", "any", "all",
+               "set", "frozenset"}
+
+
+def _unordered_source(site: CallSite) -> str | None:
+    n = site.name or ""
+    if n in ("set", "frozenset"):
+        return n
+    return None
+
+
+def _unordered_expr(node: ast.expr, taint_of) -> str | None:
+    if isinstance(node, ast.SetComp):
+        return "set-comprehension"
+    return None
+
+
+def _is_sorted(site: CallSite) -> bool:
+    return (site.name or "") == "sorted"
+
+
+def _loop_order_sensitivity(loop: ast.For) -> str | None:
+    """How the loop body consumes iteration order: ``early-exit``
+    (break/return selects the first hit), ``ordered-build``
+    (append/yield preserves arrival order), ``accumulate`` (``+=``),
+    or None (order-free body, e.g. pure membership adds)."""
+    aug = False
+    for stmt in loop.body:
+        for n in [stmt, *iter_stmts_ordered(stmt)]:
+            if isinstance(n, (ast.Break, ast.Return)):
+                return "early-exit"
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return "ordered-build"
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("append", "extend", "write"):
+                return "ordered-build"
+            if isinstance(n, ast.AugAssign) and isinstance(n.op, ast.Add):
+                aug = True
+    return "accumulate" if aug else None
+
+
+def _run_atp803(index: ProjectIndex, findings: list[Finding]) -> None:
+    ta = TaintAnalysis(index, source=_unordered_source,
+                       sanitizer=_is_sorted, expr_source=_unordered_expr,
+                       taint_loop_var=False)
+    cands = _candidates(index, ta.max_depth, _unordered_source,
+                        setcomps=True)
+    for info in index.functions.values():
+        if not info.path.startswith(_SCOPE) or info.qual not in cands:
+            continue
+        env = ta.function_env(info)
+        exempt: set[int] = set()
+        for node in ordered_stmts(index, info.node):
+            if isinstance(node, ast.Call):
+                leaf = (ta._site(node, info.path, info.cls).name
+                        or "").rsplit(".", 1)[-1]
+                if leaf in _ORDER_FREE:
+                    for a in node.args:
+                        exempt.add(id(a))
+        for node in ordered_stmts(index, info.node):
+            if isinstance(node, ast.Call):
+                site = ta._site(node, info.path, info.cls)
+                leaf = (site.name or "").rsplit(".", 1)[-1]
+                if leaf in _ORDER_SINK_LEAVES:
+                    lb = _join(*(ta.taint_of(a, env, info.path, info.cls,
+                                             ta.max_depth)
+                                 for a in node.args))
+                    if lb:
+                        findings.append(Finding(
+                            ATP803,
+                            f"unordered {lb} feeds order-sensitive "
+                            f"`{leaf}` — wrap the iterable in sorted()",
+                            info.path, node.lineno, node.col_offset))
+                elif leaf == "sum" and node.args:
+                    lb = ta.taint_of(node.args[0], env, info.path,
+                                     info.cls, ta.max_depth)
+                    if lb:
+                        findings.append(Finding(
+                            ATP804,
+                            f"sum() over unordered {lb} — float result "
+                            f"depends on hash-iteration order",
+                            info.path, node.lineno, node.col_offset))
+            elif isinstance(node, ast.ListComp) and id(node) not in exempt:
+                lb = ta.taint_of(node.generators[0].iter, env, info.path,
+                                 info.cls, ta.max_depth)
+                if lb:
+                    findings.append(Finding(
+                        ATP803,
+                        f"list built by iterating unordered {lb} — wrap "
+                        f"the iterable in sorted()",
+                        info.path, node.lineno, node.col_offset))
+            elif isinstance(node, ast.For):
+                lb = ta.taint_of(node.iter, env, info.path, info.cls,
+                                 ta.max_depth)
+                if not lb:
+                    continue
+                how = _loop_order_sensitivity(node)
+                if how in ("early-exit", "ordered-build"):
+                    findings.append(Finding(
+                        ATP803,
+                        f"{how} loop over unordered {lb} — iterate "
+                        f"sorted({lb}) instead",
+                        info.path, node.lineno, node.col_offset))
+                elif how == "accumulate":
+                    findings.append(Finding(
+                        ATP804,
+                        f"accumulation (`+=`) while iterating unordered "
+                        f"{lb} — float result depends on hash order",
+                        info.path, node.lineno, node.col_offset))
+
+
+# -- the registered pass --------------------------------------------------
+
+@project_pass("determinism", (ATP801, ATP802, ATP803, ATP804),
+              needs_index=True)
+def determinism_pass(root: str, index: ProjectIndex | None = None):
+    """Wall-clock, RNG, and iteration-order hazards across call edges."""
+    if index is None:
+        index = core.build_index(root)
+    findings: list[Finding] = []
+    _run_atp801(index, findings)
+    _run_atp802(index, findings)
+    _run_atp803(index, findings)
+    lines_memo: dict[str, list[str]] = {}
+    out = []
+    for f in findings:
+        mod = index.modules.get(f.path)
+        if mod is not None:
+            if f.path not in lines_memo:
+                lines_memo[f.path] = mod.src.splitlines()
+            if core.is_suppressed(f, lines_memo[f.path]):
+                continue
+        out.append(f)
+    return out

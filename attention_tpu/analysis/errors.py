@@ -19,7 +19,10 @@ ISSUE 6 extended the scope over ``frontend/``: the resilient front
 end grew its own typed trio (``DeadlineExceededError`` /
 ``ReplicaDeadError`` / ``RequestShedError`` in
 ``attention_tpu.engine.errors``), so a bare RuntimeError there is just
-as much an erasure as in the engine.
+as much an erasure as in the engine.  ISSUE 13 extended it over
+``obs/``: the trace/SLO/digest modules are the fleet's forensic
+surface, and their validation-ValueErrors are pinned per file in the
+baseline like everyone else's.
 
 Raising a *name that ends in Error but is locally defined or imported
 from this package* is the blessed pattern and never flagged.
@@ -40,17 +43,17 @@ from attention_tpu.analysis.core import (
 ATP401 = register_code(
     "ATP401", "generic-runtime-raise-in-typed-path", Severity.ERROR,
     "raise RuntimeError/Exception/AssertionError under engine/, "
-    "chaos/, or frontend/ — use a typed error (OutOfPagesError "
+    "chaos/, frontend/, or obs/ — use a typed error (OutOfPagesError "
     "lineage)")
 ATP402 = register_code(
     "ATP402", "generic-value-raise-in-typed-path", Severity.WARNING,
-    "raise ValueError under engine/, chaos/, or frontend/ — argument "
-    "validation is baselined per file; new ones need a typed error "
-    "or a justified baseline entry")
+    "raise ValueError under engine/, chaos/, frontend/, or obs/ — "
+    "argument validation is baselined per file; new ones need a typed "
+    "error or a justified baseline entry")
 
 #: trees where the typed taxonomy is the contract
 _TYPED_PATHS = ("attention_tpu/engine/", "attention_tpu/chaos/",
-                "attention_tpu/frontend/")
+                "attention_tpu/frontend/", "attention_tpu/obs/")
 _GENERIC = {"RuntimeError", "Exception", "AssertionError"}
 
 

@@ -17,6 +17,14 @@ Lexical inference, two triggers, no guessing:
 
 An explicit ``.astype`` to fp32+ marks a name clean again, so the
 ``k32 = k.astype(jnp.float32)`` idiom never fires.
+
+With the project index (``needs_index``) the pass also follows ONE
+call-graph level out of traced (jit/pallas) bodies: a visibly
+low-precision value passed into an in-tree helper that dots it without
+``preferred_element_type`` (and without an ``.astype`` re-pin) is
+reported at the call site — the wrapper-function blind spot.  The
+helper's own ``.astype(float32)`` re-pins still mark the name clean,
+and helpers of helpers are out of scope by design.
 """
 
 from __future__ import annotations
@@ -167,9 +175,102 @@ def _module_scope(tree: ast.Module):
             stack.extend(ast.iter_child_nodes(node))
 
 
-@file_pass("precision", [ATP301, ATP302])
-def check_precision(path: str, tree: ast.Module, src: str):
+def _helper_dot_hit(index, qual: str, lp_pos: tuple[int, ...],
+                    lp_kw: tuple[str, ...],
+                    memo: dict) -> tuple[str, int] | None:
+    """Does seeding ``qual``'s named/positional params as low-precision
+    reach a dot without preferred_element_type (or a sub-fp32 exp)?
+    Returns (code, helper lineno) for the first hit."""
+    key = (qual, lp_pos, lp_kw)
+    if key in memo:
+        return memo[key]
+    memo[key] = None  # cycle guard (helper aliasing back)
+    helper = index.functions.get(qual)
+    if helper is None:
+        return None
+    a = helper.node.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if helper.cls and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    all_names = set(names) | {p.arg for p in a.kwonlyargs}
+    seed = {names[i]: True for i in lp_pos if i < len(names)}
+    seed.update({k: True for k in lp_kw if k in all_names})
+    if not seed:
+        return None
+    env = _scope_env(helper.node, seed)
+    hit = None
+    for node in iter_scope(helper.node):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            leaf = d.split(".")[-1]
+            if leaf in _DOT_LEAVES and not _has_kw(
+                    node, "preferred_element_type"):
+                operands = (node.args[1:] if leaf == "einsum"
+                            else node.args[:2])
+                if any(_is_lowprec(x, env) for x in operands):
+                    hit = (ATP301, node.lineno)
+                    break
+            elif d in _EXP_NAMES and node.args and _is_lowprec(
+                    node.args[0], env):
+                hit = (ATP302, node.lineno)
+                break
+        elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                        ast.MatMult):
+            if (_is_lowprec(node.left, env)
+                    or _is_lowprec(node.right, env)):
+                hit = (ATP301, node.lineno)
+                break
+    memo[key] = hit
+    return hit
+
+
+def _check_traced_helpers(fn, env: dict[str, bool], path: str, index,
+                          memo: dict, findings: list[Finding]) -> None:
+    """One call-graph level out of a traced body: low-precision args
+    flowing into an in-tree helper that dots them."""
+    env = _scope_env(fn, env)
+    for node in iter_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        if d.split(".")[-1] in _DOT_LEAVES or d in _EXP_NAMES:
+            continue  # the direct checks own these
+        lp_pos = tuple(i for i, x in enumerate(node.args)
+                       if _is_lowprec(x, env))
+        lp_kw = tuple(sorted(kw.arg for kw in node.keywords
+                             if kw.arg and _is_lowprec(kw.value, env)))
+        if not lp_pos and not lp_kw:
+            continue
+        callee, name = index.resolve_call(path, None, node)
+        if callee is None:
+            continue
+        hit = _helper_dot_hit(index, callee, lp_pos, lp_kw, memo)
+        if hit is None:
+            continue
+        code, hline = hit
+        helper = index.functions[callee]
+        what = ("dots it without preferred_element_type"
+                if code == ATP301 else "exponentiates it sub-fp32")
+        findings.append(Finding(
+            code,
+            f"low-precision operand flows into helper "
+            f"{helper.name!r} ({helper.path}:{hline}) which {what}",
+            path, node.lineno, node.col_offset))
+    for node in iter_scope(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_traced_helpers(node, env, path, index, memo, findings)
+
+
+@file_pass("precision", [ATP301, ATP302], needs_index=True)
+def check_precision(path: str, tree: ast.Module, src: str, index=None):
     """Low-precision dots without fp32 accumulation; sub-fp32 softmax."""
     findings: list[Finding] = []
     _check_scope(tree, {}, path, findings)
+    if index is not None:
+        from attention_tpu.analysis.purity import traced_functions
+
+        memo: dict = {}
+        menv = _scope_env(tree, {})
+        for fn in traced_functions(tree):
+            _check_traced_helpers(fn, menv, path, index, memo, findings)
     return findings

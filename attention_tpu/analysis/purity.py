@@ -21,6 +21,13 @@ idiom), and their *captured-ref* stores (``acc_scr[...] = ...`` where
 ``acc_scr`` is the enclosing kernel's parameter) are pure by design —
 the binding environment is threaded down the lexical chain so only
 stores whose root name is bound in no enclosing traced scope fire.
+
+With the project index (``needs_index``) the pass also traverses the
+call graph ONE level: a helper called from a traced body runs at trace
+time too, so an impure call (or global/nonlocal mutation) inside the
+helper is reported at the call site in the traced scope — closing the
+wrapper-function blind spot.  One level, bounded: helpers of helpers
+are out of scope by design.
 """
 
 from __future__ import annotations
@@ -229,11 +236,51 @@ def _check_scope(fn, inherited: set[str], where: str, path: str,
             _check_scope(node, bound, where, path, findings)
 
 
-@file_pass("purity", [ATP101, ATP102, ATP103])
-def check_purity(path: str, tree: ast.Module, src: str):
+def _helper_hazard(fn) -> tuple[str, str, int] | None:
+    """The first lexical purity hazard in a helper body:
+    (code, culprit, lineno) — or None when the helper is clean."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            c = _impure_call(n)
+            if c:
+                return (ATP101, c, n.lineno)
+        elif isinstance(n, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(n, ast.Global) else "nonlocal"
+            return (ATP103, f"{kw} statement", n.lineno)
+    return None
+
+
+def _check_helpers(fn, where: str, path: str, index,
+                   findings: list[Finding]) -> None:
+    """One call-graph level: helpers invoked from a traced body run at
+    trace time too; report their hazards at the call site."""
+    seen: set[str] = set()
+    for site in index.sites_in(fn, path):
+        if site.callee is None or site.callee in seen:
+            continue
+        seen.add(site.callee)
+        helper = index.functions.get(site.callee)
+        if helper is None:
+            continue
+        hz = _helper_hazard(helper.node)
+        if hz is None:
+            continue
+        code, culprit, hline = hz
+        findings.append(Finding(
+            code,
+            f"helper {helper.name!r} ({helper.path}:{hline}) has "
+            f"impure {culprit} and is called from {where} — it runs "
+            "at trace time too",
+            path, site.lineno, site.col))
+
+
+@file_pass("purity", [ATP101, ATP102, ATP103], needs_index=True)
+def check_purity(path: str, tree: ast.Module, src: str, index=None):
     """Impure host calls / coercions / mutation inside traced scopes."""
     findings: list[Finding] = []
     for fn in traced_functions(tree):
         where = f"traced scope {fn.name!r}"
         _check_scope(fn, set(), where, path, findings)
+        if index is not None:
+            _check_helpers(fn, where, path, index, findings)
     return findings

@@ -806,7 +806,28 @@ def _cmd_analyze(args: argparse.Namespace) -> int:
             os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
             for p in args.paths
         ]
-    findings = analysis.analyze(root, rel_paths=rel_paths)
+    index = None
+    if rel_paths is not None and any(
+            p.needs_index for p in analysis.PASSES.values()):
+        # interprocedural passes see hazards across call edges, so a
+        # helper edit must re-lint the files that CALL the helper — the
+        # call-graph reverse closure (--changed can't silently pass a
+        # hazard introduced one level away)
+        from attention_tpu.analysis import core as acore
+
+        index = acore.build_index(root)
+        closure = index.files_calling(
+            [p for p in rel_paths if p.endswith(".py")])
+        if closure:
+            rel_paths = sorted(set(rel_paths) | closure)
+    timings: dict[str, float] | None = {} if args.timings else None
+    findings = analysis.analyze(root, rel_paths=rel_paths,
+                                timings=timings, index=index)
+    if timings is not None:
+        total = sum(timings.values())
+        for name, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"{secs * 1e3:9.1f} ms  {name}", file=sys.stderr)
+        print(f"{total * 1e3:9.1f} ms  total", file=sys.stderr)
 
     problems: list[str] = []
     if not args.no_baseline:
@@ -1151,7 +1172,12 @@ def main(argv: list[str] | None = None) -> int:
     an.add_argument("--changed", action="store_true",
                     help="lint only files touched since "
                          "`git merge-base HEAD --base` (plus "
-                         "staged/unstaged/untracked changes)")
+                         "staged/unstaged/untracked changes, plus the "
+                         "call-graph reverse closure: files whose "
+                         "callers changed)")
+    an.add_argument("--timings", action="store_true",
+                    help="print per-pass wall time to stderr (the "
+                         "tree-wide budget is <= 5 s)")
     an.add_argument("--base", default="main",
                     help="merge-base ref for --changed (default: main)")
     an.add_argument("--format", choices=["text", "json", "sarif"],

@@ -2,13 +2,18 @@
 
 One entry point for CI and the tier-1 suite: runs the full
 ``attention_tpu.analysis`` registry (trace purity, Pallas contracts,
-precision, error taxonomy, the absorbed check_* lints, the
-source-only guard) over the whole scanned tree and applies the
-committed baseline — exactly ``cli analyze`` with no arguments, so
+precision, error taxonomy, the determinism lints, the absorbed
+check_* lints, the source-only guard) over the whole scanned tree —
+interprocedural passes get the project index built once — and applies
+the committed baseline: exactly ``cli analyze`` with no arguments, so
 the two can never disagree.
 
 Exit 0 iff the tree is clean modulo analysis/baseline.json.
 Run: python scripts/check_all.py [cli-analyze flags, e.g. --format json]
+     python scripts/check_all.py --timings   # per-pass wall time on
+                                             # stderr; the tree-wide
+                                             # budget (<= 5 s) is
+                                             # asserted by a tier-1 test
 """
 
 from __future__ import annotations

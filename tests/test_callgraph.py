@@ -1,0 +1,235 @@
+"""attention_tpu.analysis.callgraph + dataflow: the interprocedural core.
+
+Everything runs over ``ProjectIndex.from_sources`` (in-memory
+``{path: source}`` fixtures — the test seam), covering the resolution
+shapes the determinism passes lean on: module-level defs through
+import/re-export chains, assignment aliases, ``functools.partial``,
+``self.``-methods, constructors, the unresolvable-stays-opaque
+contract, the ``files_calling`` reverse closure behind
+``cli analyze --changed``, and the taint lattice's depth cap.
+"""
+
+import textwrap
+
+import pytest
+
+from attention_tpu.analysis.callgraph import ProjectIndex
+from attention_tpu.analysis.dataflow import MAX_DEPTH, TaintAnalysis
+from attention_tpu.analysis.determinism import _wall_source
+
+pytestmark = pytest.mark.analysis
+
+
+def _index(sources: dict) -> ProjectIndex:
+    return ProjectIndex.from_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()})
+
+
+def _callees(idx: ProjectIndex, qual: str) -> list:
+    return [(s.callee, s.name) for s in idx.calls.get(qual, [])]
+
+
+# ---------------------- resolution ----------------------
+
+def test_module_level_def_and_import_chain():
+    idx = _index({
+        "pkg/a.py": """
+            def f():
+                return 1
+            """,
+        "pkg/b.py": """
+            from pkg.a import f
+
+            def g():
+                return f()
+            """,
+        "pkg/c.py": """
+            from pkg.b import f as ff
+
+            def h():
+                return ff()
+            """,
+    })
+    assert _callees(idx, "pkg/b.py::g") == [("pkg/a.py::f", "f")]
+    # the re-export chain (c imports f *through* b) still lands on a.f
+    assert _callees(idx, "pkg/c.py::h")[0][0] == "pkg/a.py::f"
+    assert idx.callers["pkg/a.py::f"] == {"pkg/b.py::g", "pkg/c.py::h"}
+
+
+def test_assignment_alias_and_module_alias():
+    idx = _index({
+        "pkg/a.py": """
+            import pkg.b as pb
+
+            def f():
+                return 1
+
+            g = f
+
+            def caller():
+                return g() + pb.h()
+            """,
+        "pkg/b.py": """
+            def h():
+                return 2
+            """,
+    })
+    got = dict.fromkeys(c for c, _ in _callees(idx, "pkg/a.py::caller"))
+    assert "pkg/a.py::f" in got      # g = f alias
+    assert "pkg/b.py::h" in got      # import pkg.b as pb
+
+
+def test_functools_partial_unwraps_to_the_wrapped_fn():
+    idx = _index({
+        "pkg/a.py": """
+            import functools
+
+            def f(x, y):
+                return x + y
+
+            h = functools.partial(f, 1)
+
+            def caller():
+                return h() + functools.partial(f, 2)(3)
+            """,
+    })
+    callees = [c for c, _ in _callees(idx, "pkg/a.py::caller")
+               if c is not None]
+    assert callees.count("pkg/a.py::f") == 2
+
+
+def test_self_methods_and_constructor():
+    idx = _index({
+        "pkg/a.py": """
+            class C:
+                def __init__(self):
+                    self.n = 0
+
+                def a(self):
+                    return self.b()
+
+                def b(self):
+                    return self.n
+
+            def make():
+                return C()
+            """,
+    })
+    assert _callees(idx, "pkg/a.py::C.a")[0][0] == "pkg/a.py::C.b"
+    assert _callees(idx, "pkg/a.py::make")[0][0] == "pkg/a.py::C.__init__"
+
+
+def test_unresolvable_calls_stay_opaque_never_guessed():
+    idx = _index({
+        "pkg/a.py": """
+            import numpy as np
+
+            def f(xs, cb):
+                np.linalg.norm(xs)
+                cb()
+                return xs
+            """,
+    })
+    sites = {s.name: s for s in idx.calls["pkg/a.py::f"]}
+    # external: opaque, but canonicalized through the alias
+    assert sites["numpy.linalg.norm"].callee is None
+    # a parameter shadows everything: opaque, raw name preserved
+    assert sites["cb"].callee is None
+
+
+def test_shadowed_local_does_not_resolve_to_module_def():
+    idx = _index({
+        "pkg/a.py": """
+            def f():
+                return 1
+
+            def g(f):
+                return f()
+            """,
+    })
+    assert _callees(idx, "pkg/a.py::g") == [(None, "f")]
+
+
+# ---------------------- --changed reverse closure ----------------------
+
+def test_files_calling_two_file_closure():
+    """Satellite fixture: editing a.py must pull its caller b.py (and
+    b's caller c.py, transitively) into a ``--changed`` run."""
+    idx = _index({
+        "pkg/a.py": """
+            def f():
+                return 1
+            """,
+        "pkg/b.py": """
+            from pkg.a import f
+
+            def g():
+                return f()
+            """,
+        "pkg/c.py": """
+            from pkg.b import g
+
+            def h():
+                return g()
+            """,
+        "pkg/d.py": """
+            def unrelated():
+                return 0
+            """,
+    })
+    assert idx.files_calling(["pkg/a.py"]) == {"pkg/b.py", "pkg/c.py"}
+    assert idx.files_calling(["pkg/c.py"]) == set()
+    assert idx.files_calling(["pkg/d.py"]) == set()
+
+
+# ---------------------- taint depth cap ----------------------
+
+def test_returns_taint_respects_the_depth_cap():
+    """Taint survives up to ``max_depth`` call edges; beyond the cap
+    the analysis assumes clean (bounded, never guessing)."""
+    idx = _index({
+        "pkg/a.py": """
+            import time
+
+            def l0():
+                return time.time()
+
+            def l1():
+                return l0()
+
+            def l2():
+                return l1()
+
+            def l3():
+                return l2()
+            """,
+    })
+    ta = TaintAnalysis(idx, source=_wall_source)
+    assert ta.max_depth == MAX_DEPTH == 3
+    # l0 holds the source itself; each wrapper burns one edge
+    assert ta.returns_taint("pkg/a.py::l0", 0) == "time.time"
+    assert ta.returns_taint("pkg/a.py::l2", 2) == "time.time"
+    assert ta.returns_taint("pkg/a.py::l3", 3) == "time.time"
+    # same chain, one depth short: assumed clean past the cap
+    assert ta.returns_taint("pkg/a.py::l3", 2) is None
+
+
+def test_param_sink_and_sanitizer():
+    idx = _index({
+        "pkg/a.py": """
+            import json, time
+
+            def emit(payload):
+                return json.dumps(payload)
+
+            def emit_clean(payload):
+                return json.dumps(sorted(payload))
+            """,
+    })
+    ta = TaintAnalysis(
+        idx, source=_wall_source,
+        sink=lambda s: "dumps" if s.name == "json.dumps" else None,
+        sanitizer=lambda s: s.name == "sorted")
+    assert ta.param_sink("pkg/a.py::emit", 0, 2) == "dumps"
+    # the sanitizer launders the argument before the sink
+    assert ta.param_sink("pkg/a.py::emit_clean", 0, 2) is None
