@@ -8,8 +8,10 @@ passes that flag, before anything traces or compiles,
 - Pallas block/grid/out_shape contract breaks (ATP2xx, `pallas`),
 - silent low-precision accumulation (ATP3xx, `precision`),
 - error-taxonomy drift (ATP4xx, `errors`),
-- tree conventions — the absorbed ``scripts/check_*`` lints and the
-  source-only guard (ATP5xx/ATP601, `conventions`),
+- tree conventions — the absorbed ``scripts/check_*`` lints, the
+  frozen-series pin, and the source-only guard (ATP5xx/ATP601,
+  `conventions`),
+- committed benchmark-trajectory regressions (ATP506, `benchtrend`),
 - torn-write-prone persistence in the durable modules (ATP701,
   `durability`),
 - determinism hazards across call edges — wall-clock into artifacts,
@@ -37,6 +39,7 @@ from attention_tpu.analysis.core import (  # noqa: F401
     repo_root,
 )
 from attention_tpu.analysis import (  # noqa: F401  (pass registration)
+    benchtrend,
     conventions,
     determinism,
     durability,

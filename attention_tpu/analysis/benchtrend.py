@@ -1,0 +1,132 @@
+"""Committed benchmark-trajectory gate (ATP506).
+
+Every PR round appends a ``BENCH_r<NN>.json`` at the repo root — the
+headline attention benchmark replayed on the then-current tree.  Those
+files ARE the performance history, so a silent regression is just a
+diff nobody read.  This pass parses the committed trajectory and fails
+the gate when the headline kernel time (``parsed.detail.tpu_kernel_ms``)
+regresses more than :data:`REGRESSION_PCT` percent between consecutive
+rounds.
+
+The gate keys on kernel milliseconds, NOT ``parsed.value``: the value
+field is a speedup against a serial CPU baseline whose measurement
+basis legitimately changed between rounds (re-measured vs extrapolated
+serial time — see r02 -> r03, a 22.5% value drop with the kernel
+getting *faster*).  Kernel ms is the only monotone-comparable number
+in the trajectory.
+
+``scripts/bench_trend.py`` is the human-facing shell over the same
+functions: prints the per-round trend (ms + MXU), exits nonzero on the
+same problems.  `cli analyze` / ``scripts/check_all.py`` run the pass
+automatically — registration happens on package import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    project_pass,
+    register_code,
+)
+
+ATP506 = register_code(
+    "ATP506", "bench-trend-regression", Severity.ERROR,
+    "committed BENCH_r*.json headline kernel time regressed >10% "
+    "between consecutive rounds (or a round is unparsable)")
+
+#: allowed headline regression between consecutive rounds, percent
+REGRESSION_PCT = 10.0
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def bench_files(root: str) -> list[tuple[int, str]]:
+    """``(round, filename)`` for every committed bench file, by round."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        m = _BENCH_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), name))
+    out.sort()
+    return out
+
+
+def trend_rows(root: str) -> list[dict]:
+    """One row per round: the comparable headline numbers (or an
+    ``error`` field when a file does not parse into them)."""
+    rows = []
+    for rnd, name in bench_files(root):
+        row: dict = {"round": rnd, "file": name}
+        try:
+            with open(os.path.join(root, name)) as f:
+                doc = json.load(f)
+            parsed = doc["parsed"]
+            detail = parsed["detail"]
+            row["kernel_ms"] = float(detail["tpu_kernel_ms"])
+            row["mxu"] = float(detail.get("mxu_utilization_of_peak", 0.0))
+            row["value"] = float(parsed.get("value", 0.0))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            row["error"] = f"{type(e).__name__}: {e}"
+        rows.append(row)
+    return rows
+
+
+def trend_problems(root: str) -> list[str]:
+    """Regression/parse problems over the committed trajectory
+    (legacy-lint strings; empty means the gate passes)."""
+    problems = []
+    prev = None
+    for row in trend_rows(root):
+        if "error" in row:
+            problems.append(f"{row['file']}: unparsable headline "
+                            f"({row['error']})")
+            continue
+        if prev is not None and prev["kernel_ms"] > 0:
+            pct = 100.0 * (row["kernel_ms"] - prev["kernel_ms"]) \
+                / prev["kernel_ms"]
+            if pct > REGRESSION_PCT:
+                problems.append(
+                    f"{row['file']}: headline kernel time regressed "
+                    f"{pct:+.1f}% vs {prev['file']} "
+                    f"({prev['kernel_ms']:g} ms -> "
+                    f"{row['kernel_ms']:g} ms, budget "
+                    f"{REGRESSION_PCT:g}%)")
+        prev = row
+    return problems
+
+
+def render_trend(rows: list[dict]) -> list[str]:
+    """Human-readable per-round trend lines for the script."""
+    out = []
+    prev_ms = None
+    for row in rows:
+        if "error" in row:
+            out.append(f"r{row['round']:02d}  {row['file']}: "
+                       f"UNPARSABLE ({row['error']})")
+            continue
+        delta = ""
+        if prev_ms:
+            pct = 100.0 * (row["kernel_ms"] - prev_ms) / prev_ms
+            delta = f"  ({pct:+.1f}%)"
+        out.append(f"r{row['round']:02d}  kernel {row['kernel_ms']:7.3f} ms"
+                   f"  mxu {row['mxu']:.4f}"
+                   f"  speedup {row['value']:9.1f}{delta}")
+        prev_ms = row["kernel_ms"]
+    return out
+
+
+@project_pass("bench-trend", [ATP506])
+def check_bench_trend(root: str):
+    """The committed BENCH_r*.json trajectory has no >10% headline
+    kernel-time regression between consecutive rounds."""
+    return [Finding(ATP506, p, p.split(":", 1)[0])
+            for p in trend_problems(root)]

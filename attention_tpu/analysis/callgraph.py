@@ -38,7 +38,8 @@ import dataclasses
 import os
 from typing import Iterable
 
-from attention_tpu.analysis.core import dotted_name, iter_source_files
+from attention_tpu.analysis.core import (dotted_name, iter_source_files,
+                                        walk_list)
 
 #: maximum hops when chasing import/alias chains (cycle insurance)
 _RESOLVE_DEPTH = 8
@@ -196,7 +197,7 @@ class ProjectIndex:
         # bounded over-approximation (function-local imports are the
         # idiom here, and a name is never re-imported as two different
         # things in this tree)
-        for node in ast.walk(mod.tree):
+        for node in walk_list(mod.tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     if alias.asname:

@@ -29,6 +29,7 @@ from attention_tpu.analysis.core import (
     file_pass,
     project_pass,
     register_code,
+    walk_list,
 )
 
 ATP501 = register_code(
@@ -47,6 +48,11 @@ ATP503 = register_code(
     "ATP503", "tolerance-ledger-drift", Severity.ERROR,
     "PARITY.md tolerance ledger disagrees with chaos/budgets.py "
     "(absorbed scripts/check_tolerances.py)")
+ATP505 = register_code(
+    "ATP505", "frozen-series-pin", Severity.ERROR,
+    "FROZEN_SERIES drift: a frozen telemetry series is never created, "
+    "created under the wrong instrument kind, or re-typed as a string "
+    "literal in a consumer module")
 ATP601 = register_code(
     "ATP601", "non-source-tracked-file", Severity.ERROR,
     "a git-tracked file under attention_tpu/ or tests/ is a build "
@@ -71,7 +77,7 @@ def obs_name_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
     from attention_tpu.obs.naming import check_name
 
     out = []
-    for node in ast.walk(tree):
+    for node in walk_list(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
@@ -103,7 +109,7 @@ def trace_event_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
     from attention_tpu.obs.naming import check_event
 
     out = []
-    for node in ast.walk(tree):
+    for node in walk_list(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
@@ -313,6 +319,129 @@ def check_tolerances(root: str):
         return [Finding(ATP503, "PARITY.md is missing", "PARITY.md")]
     return [Finding(ATP503, p, "PARITY.md")
             for p in tolerance_problems(path)]
+
+
+# -- ATP505: frozen series pin --------------------------------------------
+
+#: instrument call name -> the FROZEN_SERIES kind it creates
+_INSTRUMENT_KINDS = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram", "digest": "digest"}
+
+#: modules that CONSUME the frozen map (the forecaster stack): they may
+#: only reach a frozen series through its SERIES_* constant, never by
+#: re-typing the dotted name — so a rename in naming.py is a lint
+#: failure, not a silent series fork
+FROZEN_CONSUMER_MODULES = (
+    "attention_tpu/obs/capacity.py",
+    "attention_tpu/obs/forecast.py",
+    "attention_tpu/obs/slo.py",
+)
+
+_FROZEN_DEF_MODULE = "attention_tpu/obs/naming.py"
+
+
+def _series_arg(node: ast.Call, naming) -> str | None:
+    """The telemetry name an instrument call creates, resolving
+    ``SERIES_*`` constant references through ``obs.naming`` (the
+    engine/frontend creation sites all use the constants, so a
+    literal-only scan would see nothing)."""
+    if not node.args:
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return first.value
+    ref = (first.id if isinstance(first, ast.Name)
+           else first.attr if isinstance(first, ast.Attribute) else None)
+    if ref and ref.startswith("SERIES_"):
+        val = getattr(naming, ref, None)
+        return val if isinstance(val, str) else None
+    return None
+
+
+def _doc_constants(tree: ast.Module) -> set[int]:
+    """ids of docstring Constant nodes (exempt from the literal rule:
+    prose may cite a series name; code may not)."""
+    out = set()
+    for node in walk_list(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                out.add(id(body[0].value))
+    return out
+
+
+def frozen_series_findings(index) -> list[Finding]:
+    """ATP505 findings over an already-built project index."""
+    from attention_tpu.obs import naming
+
+    frozen = naming.FROZEN_SERIES
+    #: frozen name -> [(path, line, call_name)] creation sites
+    created: dict[str, list[tuple[str, int, str]]] = {}
+    findings: list[Finding] = []
+    for rel in sorted(index.modules):
+        mod = index.modules[rel]
+        for node in walk_list(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            call = (func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None)
+            if call not in _INSTRUMENT_KINDS:
+                continue
+            name = _series_arg(node, naming)
+            if name in frozen:
+                created.setdefault(name, []).append(
+                    (rel, node.lineno, call))
+    for name in sorted(frozen):
+        sites = created.get(name, [])
+        if not sites:
+            findings.append(Finding(
+                ATP505,
+                f"frozen series {name!r} ({frozen[name]}) is never "
+                f"created by any instrument call in the tree",
+                _FROZEN_DEF_MODULE))
+            continue
+        for rel, line, call in sites:
+            kind = _INSTRUMENT_KINDS[call]
+            if kind != frozen[name]:
+                findings.append(Finding(
+                    ATP505,
+                    f"frozen series {name!r} is registered as a "
+                    f"{frozen[name]} but created here via {call}()",
+                    rel, line))
+    for rel in FROZEN_CONSUMER_MODULES:
+        mod = index.modules.get(rel)
+        if mod is None:
+            continue
+        docs = _doc_constants(mod.tree)
+        for node in walk_list(mod.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in frozen
+                    and id(node) not in docs):
+                findings.append(Finding(
+                    ATP505,
+                    f"frozen series name {node.value!r} re-typed as a "
+                    f"literal — import its SERIES_* constant from "
+                    f"obs/naming.py instead",
+                    rel, node.lineno, node.col_offset))
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
+@project_pass("frozen-series", [ATP505], needs_index=True)
+def check_frozen_series(root: str, index=None):
+    """Every FROZEN_SERIES name is really created (kind-correct), and
+    forecaster-stack consumers never re-type one as a literal."""
+    from attention_tpu.analysis.core import build_index
+
+    if index is None:
+        index = build_index(root)
+    return frozen_series_findings(index)
 
 
 # -- ATP601: source-only tree guard ---------------------------------------

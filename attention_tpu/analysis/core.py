@@ -173,6 +173,32 @@ def dotted_name(node: ast.expr) -> str | None:
     return ".".join(reversed(parts))
 
 
+#: id(tree) -> (tree, flattened walk).  The tree is held strongly so
+#: its id cannot be recycled under us; capped so a long-lived process
+#: feeding synthetic trees (tests) cannot grow it without bound.
+_WALK_CACHE: dict[int, tuple[ast.AST, list[ast.AST]]] = {}
+_WALK_CACHE_MAX = 1024
+
+
+def walk_list(tree: ast.AST) -> list[ast.AST]:
+    """``list(ast.walk(tree))`` memoized by tree identity.
+
+    Several passes walk the same parsed module top to bottom (purity
+    twice, precision via traced_functions, obs-naming, pallas, the
+    index build): flattening once and sharing the list is the single
+    biggest win in the tree-wide time budget.  Callers must not mutate
+    the returned list.
+    """
+    hit = _WALK_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    nodes = list(ast.walk(tree))
+    if len(_WALK_CACHE) >= _WALK_CACHE_MAX:
+        _WALK_CACHE.clear()
+    _WALK_CACHE[id(tree)] = (tree, nodes)
+    return nodes
+
+
 def iter_scope(node: ast.AST) -> Iterator[ast.AST]:
     """Walk ``node``'s subtree but do NOT descend into nested
     function/class scopes (their bodies are separate scopes)."""

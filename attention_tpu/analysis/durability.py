@@ -31,6 +31,7 @@ from attention_tpu.analysis.core import (
     dotted_name,
     file_pass,
     register_code,
+    walk_list,
 )
 
 ATP701 = register_code(
@@ -71,12 +72,12 @@ def _scopes(tree: ast.Module):
     part of the enclosing function's scope — a helper closure that
     does the os.replace still makes the write atomic) plus the
     module's own top-level statements."""
-    funcs = [n for n in ast.walk(tree)
+    funcs = [n for n in walk_list(tree)
              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     owned = set()
     for fn in funcs:
         owned.update(id(n) for n in ast.walk(fn) if n is not fn)
-    yield tree, [n for n in ast.walk(tree)
+    yield tree, [n for n in walk_list(tree)
                  if id(n) not in owned and n not in funcs]
     for fn in funcs:
         if id(fn) not in owned:  # nested defs ride their enclosing scope

@@ -38,6 +38,7 @@ from attention_tpu.analysis.core import (
     dotted_name,
     file_pass,
     register_code,
+    walk_list,
 )
 
 ATP401 = register_code(
@@ -71,7 +72,7 @@ def check_errors(path: str, tree: ast.Module, src: str):
     if not any(path.startswith(p) for p in _TYPED_PATHS):
         return []
     findings: list[Finding] = []
-    for node in ast.walk(tree):
+    for node in walk_list(tree):
         if not isinstance(node, ast.Raise):
             continue
         name = _raised_name(node)

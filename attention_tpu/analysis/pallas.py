@@ -33,6 +33,7 @@ from attention_tpu.analysis.core import (
     dotted_name,
     file_pass,
     register_code,
+    walk_list,
 )
 
 ATP201 = register_code(
@@ -153,7 +154,7 @@ def _kernel_def(call: ast.Call, tree: ast.Module):
     name = _kernel_arg_name(call.args[0])
     if not name:
         return None
-    for node in ast.walk(tree):
+    for node in walk_list(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if node.name == name:
                 return node
@@ -213,7 +214,7 @@ def _check_store_dtypes(call: ast.Call, tree: ast.Module, path: str,
 def check_pallas(path: str, tree: ast.Module, src: str):
     """BlockSpec/grid/out_shape self-consistency at pallas_call sites."""
     findings: list[Finding] = []
-    for call in ast.walk(tree):
+    for call in walk_list(tree):
         if not isinstance(call, ast.Call):
             continue
         if dotted_name(call.func) not in _PALLAS_CALL:

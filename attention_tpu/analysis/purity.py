@@ -40,6 +40,7 @@ from attention_tpu.analysis.core import (
     dotted_name,
     file_pass,
     iter_scope,
+    walk_list,
     register_code,
 )
 
@@ -95,11 +96,20 @@ def _kernel_arg_name(node: ast.expr) -> str | None:
     return None
 
 
+_TRACED_CACHE: dict[int, tuple[ast.Module, list]] = {}
+
+
 def traced_functions(tree: ast.Module) -> list[ast.FunctionDef]:
-    """Top-level traced scopes: jit-decorated defs + Pallas kernels."""
+    """Top-level traced scopes: jit-decorated defs + Pallas kernels.
+
+    Memoized by tree identity — purity and precision both call this on
+    the same parsed module in one analyze() run."""
+    hit = _TRACED_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
     defs: dict[str, list] = {}
     aliases: dict[str, str] = {}  # x = partial(kernel, ...) at any level
-    for node in ast.walk(tree):
+    for node in walk_list(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs.setdefault(node.name, []).append(node)
         elif isinstance(node, ast.Assign) and len(node.targets) == 1:
@@ -117,7 +127,7 @@ def traced_functions(tree: ast.Module) -> list[ast.FunctionDef]:
             seen.add(id(fn))
             out.append(fn)
 
-    for node in ast.walk(tree):
+    for node in walk_list(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if any(_is_jit_decorator(d) for d in node.decorator_list):
                 add(node)
@@ -128,6 +138,9 @@ def traced_functions(tree: ast.Module) -> list[ast.FunctionDef]:
                 name = aliases.get(name, name)
                 for fn in defs.get(name or "", []):
                     add(fn)
+    if len(_TRACED_CACHE) >= 512:
+        _TRACED_CACHE.clear()
+    _TRACED_CACHE[id(tree)] = (tree, out)
     return out
 
 

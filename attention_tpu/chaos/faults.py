@@ -740,11 +740,16 @@ def default_frontend_config(num_replicas: int = 3, **overrides):
     OOM windows visibly migrate requests."""
     from attention_tpu.frontend import FrontendConfig, RetryPolicy
 
+    from attention_tpu.obs.forecast import ForecastPolicy
+
     kw: dict[str, Any] = dict(
         num_replicas=num_replicas, seed=0,
         retry=RetryPolicy(max_retries=4, base_delay_ticks=1,
                           max_delay_ticks=8),
         stall_ticks=3,
+        # forecasting on (passive, advisory off) so every campaign
+        # exercises invariant 13 under its storm
+        forecast=ForecastPolicy(),
     )
     kw.update(overrides)
     return FrontendConfig(**kw)
@@ -819,6 +824,10 @@ def run_frontend_plan(model, params, config: EngineConfig,
     # submitted request; judge them (incl. gray + crash campaigns,
     # which all funnel through this runner)
     violations += inv.trace_completeness_violations(frontend)
+    # invariant 13: campaigns enable forecasting (see
+    # default_frontend_config) — the observatory report must be a
+    # pure function of the recorded samples, storm or no storm
+    violations += inv.forecast_determinism_violations(frontend)
     if snapshot_roundtrip and drained:
         for handle in frontend.replicas:
             if handle.alive:

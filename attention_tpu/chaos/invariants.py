@@ -71,6 +71,16 @@ The observability layer (ISSUE 12) adds one more:
     recorded destination, and no chain exists for an unknown request.
     Fault campaigns run inside ``obs.trace.capture()`` so the chains
     exist even with telemetry disabled.
+
+The forecasting layer (ISSUE 14) adds one more:
+
+13. **Forecast determinism** — when the front end ran with forecasting
+    enabled (campaigns do, see `default_frontend_config`), the
+    observatory report is a pure function of the recorded samples:
+    computing it twice yields byte-identical canonical JSON, every
+    number in it is finite, and rebuilding it from its own embedded
+    samples (`obs.capacity.rebuild_report`) reproduces it exactly —
+    under kill, gray, and crash storms alike.
 """
 
 from __future__ import annotations
@@ -478,6 +488,36 @@ def trace_completeness_violations(frontend) -> list[str]:
                         f"{ev.get('replica')!r}, dest was "
                         f"{ev.get('dest')!r}")
     return _report("trace_completeness", problems)
+
+
+def forecast_determinism_violations(frontend) -> list[str]:
+    """Invariant 13: the observatory report is reproducible.
+
+    Three checks over the same front end: compute-twice byte parity,
+    no non-finite numbers, and dump-and-rebuild byte parity (the
+    ``cli obs forecast`` contract).  A front end constructed without a
+    `ForecastPolicy` has nothing to judge."""
+    import json
+
+    if getattr(frontend, "forecast", None) is None:
+        return []
+    from attention_tpu.obs import capacity as _capacity
+
+    problems: list[str] = []
+    a = json.dumps(frontend.forecast_report(), sort_keys=True)
+    b = json.dumps(frontend.forecast_report(), sort_keys=True)
+    if a != b:
+        problems.append(
+            "forecast report not reproducible: two computations over "
+            "the same samples differ")
+    if "NaN" in a or "Infinity" in a:
+        problems.append("forecast report contains non-finite numbers")
+    rebuilt = _capacity.rebuild_report(json.loads(a))
+    if json.dumps(rebuilt, sort_keys=True) != a:
+        problems.append(
+            "forecast report does not rebuild byte-identically from "
+            "its own embedded samples")
+    return _report("forecast_determinism", problems)
 
 
 def snapshot_roundtrip_violations(engine) -> list[str]:

@@ -229,6 +229,20 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
     model, params = _build_sim_model(args)
     if args.trace:
         trace = load_trace(args.trace)
+    elif args.diurnal:
+        from attention_tpu.engine import diurnal_trace
+
+        trace = diurnal_trace(
+            args.num_requests, vocab=args.vocab, seed=args.seed,
+            period=args.diurnal_period, base_rate=args.base_rate,
+            peak_rate=args.peak_rate, tenants=args.tenants,
+            rag_every=args.rag_every,
+            rag_prefill_len=args.rag_prefill_len,
+            prompt_len_min=args.prompt_len_min,
+            prompt_len_max=args.prompt_len_max,
+            max_tokens=args.max_tokens,
+            temperature=args.temperature,
+        )
     elif args.bursty:
         from attention_tpu.engine import bursty_trace
 
@@ -358,6 +372,16 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
     supervisor = (SupervisorPolicy(suspect_after=args.suspect_after)
                   if args.suspect_after is not None
                   else SupervisorPolicy())
+    forecast_policy = None
+    if args.forecast or args.forecast_advisory:
+        from attention_tpu.frontend import ForecastPolicy
+
+        season = args.forecast_season
+        if season is None and args.diurnal:
+            season = args.diurnal_period
+        forecast_policy = ForecastPolicy(
+            season_ticks=season, horizon=args.forecast_horizon,
+            advisory=args.forecast_advisory)
     frontend = ServingFrontend(
         model, params, config,
         FrontendConfig(
@@ -368,6 +392,7 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
             snapshot_every=args.snapshot_every,
             supervisor=supervisor,
             standbys=args.standbys,
+            forecast=forecast_policy,
         ),
     )
     if args.chaos_plan or gray_plan is not None:
@@ -411,6 +436,30 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
                               "budget_remaining": ob["budget_remaining"],
                               "violations": ob["violations"]}
                              for ob in slo_report["fleet"]["slo"]}}}
+    # forecast + capacity observatory (obs.forecast/capacity): a
+    # deterministic document over the tracker's per-tick series,
+    # persisted as forecast.json for `cli obs forecast`
+    forecast_doc = None
+    if frontend.forecast is not None:
+        from attention_tpu.obs import capacity as capacity_mod
+        from attention_tpu.obs import forecast as forecast_mod
+
+        forecast_doc = frontend.forecast_report()
+        forecast_mod.publish(forecast_doc)
+        capacity_mod.publish(forecast_doc)
+        pblk = next((b for b in forecast_doc["series"]
+                     if b["name"] == forecast_mod.PRESSURE_SERIES), None)
+        fleet = forecast_doc["capacity"]["fleet"]
+        out["forecast"] = {
+            "pressure_next": (pblk["forecast"][0]["mean"]
+                              if pblk and pblk["forecast"] else None),
+            "one_step_mape": (pblk["backtest"]["one_step_mape"]
+                              if pblk else None),
+            "headroom": fleet["headroom"],
+            "cost_per_token": fleet["cost_per_token"],
+            "time_to_saturation":
+                forecast_doc["capacity"]["time_to_saturation"],
+        }
     if args.outputs:
         out["outputs"] = outputs
     if args.obs_out:
@@ -418,6 +467,8 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
 
         obs.dump(args.obs_out)
         obs.write_slo(args.obs_out, slo_report)
+        if forecast_doc is not None:
+            obs.write_forecast(args.obs_out, forecast_doc)
         _logger.info("wrote telemetry dump: %s", args.obs_out)
     print(json.dumps(out))
     return 0
@@ -509,6 +560,41 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--tenants", type=int, default=2)
     ss.add_argument("--burst-every", type=int, default=6)
     ss.add_argument("--burst-size", type=int, default=3)
+    # diurnal trace knobs (engine.sim.diurnal_trace)
+    ss.add_argument("--diurnal", action="store_true",
+                    help="synthesize a sinusoidal diurnal trace (one "
+                         "day of --diurnal-period ticks between "
+                         "--base-rate and --peak-rate req/tick, with "
+                         "periodic RAG prefill bursts) instead of the "
+                         "plain one")
+    ss.add_argument("--diurnal-period", type=int, default=48,
+                    help="ticks per simulated day")
+    ss.add_argument("--base-rate", type=float, default=1.0,
+                    help="trough arrival rate, requests/tick")
+    ss.add_argument("--peak-rate", type=float, default=4.0,
+                    help="peak arrival rate, requests/tick")
+    ss.add_argument("--rag-every", type=int, default=7,
+                    help="every Nth diurnal request is a long-prefill "
+                         "RAG burst")
+    ss.add_argument("--rag-prefill-len", type=int, default=64,
+                    help="shared retrieval-header length for RAG "
+                         "bursts (0 disables them)")
+    # load forecasting + capacity observatory (obs.forecast/capacity;
+    # front-end path only)
+    ss.add_argument("--forecast", action="store_true",
+                    help="track per-tick fleet series and emit the "
+                         "forecast + capacity report (front-end path "
+                         "only; never changes scheduling)")
+    ss.add_argument("--forecast-horizon", type=int, default=8,
+                    help="forecast horizon in ticks")
+    ss.add_argument("--forecast-season", type=int, default=None,
+                    help="seasonal period in ticks (default: "
+                         "--diurnal-period when --diurnal, else no "
+                         "seasonal term)")
+    ss.add_argument("--forecast-advisory", action="store_true",
+                    help="log would-have-acted forecast events into "
+                         "the event log (still never acts); implies "
+                         "--forecast")
     # resilient multi-replica front end (attention_tpu.frontend)
     ss.add_argument("--replicas", type=int, default=0,
                     help="serve through the resilient front end with "
@@ -917,6 +1003,40 @@ def _cmd_obs_report(args: argparse.Namespace) -> int:
         print(f"  {s['name']}{_lbl(s['labels'])}: count={s['count']} "
               f"p50={p['p50']:.3f} p90={p['p90']:.3f} "
               f"p99={p['p99']:.3f} p999={p['p999']:.3f}")
+    # forecast + capacity observatory, when the run dumped one
+    fdoc = None
+    if args.run:
+        from attention_tpu import obs as obs_mod
+
+        fdoc = obs_mod.load_forecast(args.run)
+    if fdoc is not None:
+        from attention_tpu.obs.forecast import PRESSURE_SERIES
+
+        print("== forecast ==")
+        cap = fdoc["capacity"]
+        print(f"  horizon={fdoc['horizon']} "
+              f"ticks={cap['fleet']['ticks']} "
+              f"headroom={cap['fleet']['headroom']:g} "
+              f"cost_per_token={cap['fleet']['cost_per_token']}")
+        for blk in fdoc["series"]:
+            st = blk["state"]
+            season = (f" season[{len(st['seasonal'])}]"
+                      if st["seasonal"] else "")
+            print(f"  {blk['name']}: level={st['level']:g} "
+                  f"trend={st['trend']:g}{season} "
+                  f"mape={blk['backtest']['one_step_mape']:g} "
+                  f"coverage={blk['backtest']['coverage']:g}")
+            if blk["name"] == PRESSURE_SERIES:
+                for row in blk["forecast"]:
+                    print(f"    h={row['h']} tick={row['tick']} "
+                          f"mean={row['mean']:g} "
+                          f"[{row['lo']:g}, {row['hi']:g}]")
+        for name, tts in sorted(cap["time_to_saturation"].items()):
+            when = (f"tick {tts['tick']} (h={tts['h']}, "
+                    f"pressure {tts['pressure']:g})"
+                    if tts["tick"] is not None
+                    else "beyond horizon")
+            print(f"  saturation[{name}] @ {tts['watermark']:g}: {when}")
     print("== spans ==")
     agg: dict[str, list[float]] = {}
     for e in events:
@@ -1004,6 +1124,33 @@ def _cmd_obs_slo(args: argparse.Namespace) -> int:
               "with --replicas and --obs-out?)", file=sys.stderr)
         return 1
     print(json.dumps(report, indent=1, sort_keys=True))
+    return 0
+
+
+def _cmd_obs_forecast(args: argparse.Namespace) -> int:
+    """Print a run's forecast + capacity report (obs.forecast /
+    obs.capacity) in its canonical JSON form.  Without ``--horizon``
+    this is byte-identical to the committed forecast.json (same-seed
+    determinism, the pinned property); with it, the report is rebuilt
+    from the dump's embedded samples at the requested horizon."""
+    import json
+
+    from attention_tpu import obs
+    from attention_tpu.obs import capacity as capacity_mod
+
+    if not args.run:
+        print("obs forecast requires --run "
+              "(a `serve-sim --obs-out` directory)", file=sys.stderr)
+        return 1
+    doc = obs.load_forecast(args.run)
+    if doc is None:
+        print(f"no forecast.json under {args.run} (was serve-sim run "
+              "with --replicas and --forecast and --obs-out?)",
+              file=sys.stderr)
+        return 1
+    if args.horizon is not None:
+        doc = capacity_mod.rebuild_report(doc, horizon=args.horizon)
+    print(json.dumps(doc, indent=1, sort_keys=True))
     return 0
 
 
@@ -1202,7 +1349,8 @@ def main(argv: list[str] | None = None) -> int:
     for name, fn in (("report", _cmd_obs_report),
                      ("export", _cmd_obs_export),
                      ("trace", _cmd_obs_trace),
-                     ("slo", _cmd_obs_slo)):
+                     ("slo", _cmd_obs_slo),
+                     ("forecast", _cmd_obs_forecast)):
         sp = obsub.add_parser(name)
         sp.add_argument("--run", default=None,
                         help="telemetry dump directory written by "
@@ -1222,6 +1370,11 @@ def main(argv: list[str] | None = None) -> int:
                             help="print the full journey of one "
                                  "request id (default: list every "
                                  "chain, one line each)")
+        if name == "forecast":
+            sp.add_argument("--horizon", type=int, default=None,
+                            help="rebuild the report from the dump's "
+                                 "embedded samples at this horizon "
+                                 "(default: print the dump verbatim)")
         sp.set_defaults(fn=fn)
 
     _setup_logging()

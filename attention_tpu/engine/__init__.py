@@ -61,6 +61,7 @@ from attention_tpu.engine.scheduler import (  # noqa: F401
 )
 from attention_tpu.engine.sim import (  # noqa: F401
     bursty_trace,
+    diurnal_trace,
     load_trace,
     replay,
     sampling_of,

@@ -39,12 +39,14 @@ from attention_tpu.frontend.degrade import (  # noqa: F401
 )
 from attention_tpu.frontend.frontend import (  # noqa: F401
     FRONTEND_TERMINAL,
+    ForecastTracker,
     FrontendConfig,
     FrontendRequest,
     FrontendRequestState,
     ServingFrontend,
     replay_frontend,
 )
+from attention_tpu.obs.forecast import ForecastPolicy  # noqa: F401
 from attention_tpu.frontend.migrate import (  # noqa: F401
     MigrationRecord,
     drain_replica,

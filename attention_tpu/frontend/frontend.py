@@ -40,7 +40,9 @@ import os
 from typing import Any, Callable, Sequence
 
 from attention_tpu import obs
+from attention_tpu.obs import capacity as _capacity
 from attention_tpu.obs import trace as _trace
+from attention_tpu.obs.forecast import ForecastPolicy, HoltForecaster, _r6
 from attention_tpu.obs.naming import (
     SERIES_TPOT_DIGEST,
     SERIES_TTFT_DIGEST,
@@ -235,6 +237,11 @@ class FrontendConfig:
     supervisor: SupervisorPolicy = dataclasses.field(
         default_factory=SupervisorPolicy)
     standbys: int = 0
+    # load forecasting (obs.forecast): None = disabled, and disabled
+    # means ZERO work in the tick loop — the same contract telemetry
+    # honors.  Even when set it is passive bookkeeping; only the
+    # advisory flag inside the policy makes it *log* (never act).
+    forecast: ForecastPolicy | None = None
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -267,6 +274,109 @@ class FrontendConfig:
         self.shed.validate()
         self.degrade.validate()
         self.supervisor.validate()
+        if self.forecast is not None:
+            self.forecast.validate()
+
+
+def _cumulative_series(pairs, n: int) -> list[float]:
+    """Per-tick running mean of ``(tick, value)`` marks over ticks
+    ``0..n-1`` (0.0 before the first mark) — the tick-indexed view of
+    the latency digests the forecaster consumes."""
+    marks = sorted(pairs)
+    out: list[float] = []
+    i = 0
+    total = 0.0
+    count = 0
+    for t in range(n):
+        while i < len(marks) and marks[i][0] <= t:
+            total += marks[i][1]
+            count += 1
+            i += 1
+        out.append(total / count if count else 0.0)
+    return out
+
+
+class ForecastTracker:
+    """Per-tick fleet sample recorder + incremental pressure forecaster.
+
+    Exists only when ``FrontendConfig.forecast`` is set; every hook in
+    the serving hot path is a single ``tracker is None`` check, the
+    zero-overhead contract `frontend.degrade` documents for telemetry
+    applied to forecasting.  The tracker never reads the obs registry
+    and is never consulted for control flow: ``forecast_pressure`` is
+    an advisory surface, and the advisory hooks only *log* what
+    forecast-driven admission would have done.
+    """
+
+    def __init__(self, policy: ForecastPolicy):
+        self.policy = policy
+        # per-tick sample series (virtual ticks; index == tick)
+        self.pressure: list[float] = []
+        self.queue_depth: list[float] = []
+        self.admissions: list[float] = []
+        self.tokens: list[float] = []
+        #: tokens emitted per replica over the whole run (capacity input)
+        self.replica_tokens: dict[str, int] = {}
+        self._pressure_fc = HoltForecaster(policy)
+        self._tokens_total = 0
+        self._tokens_seen = 0
+        #: events_log prefix already counted for the admissions series
+        self.events_seen = 0
+        #: one-step-ahead mean-pressure forecast after the last tick
+        self.forecast_pressure: float | None = None
+
+    def note_token(self, replica_id: str) -> None:
+        self._tokens_total += 1
+        self.replica_tokens[replica_id] = (
+            self.replica_tokens.get(replica_id, 0) + 1)
+
+    def record_tick(self, pressure: float, queue_depth: int,
+                    admissions: int) -> float:
+        """Append one sample row; returns the one-step forecast of the
+        mean fleet pressure (what next tick is predicted to look like)."""
+        self.pressure.append(float(pressure))
+        self.queue_depth.append(float(queue_depth))
+        self.admissions.append(float(admissions))
+        self.tokens.append(float(self._tokens_total - self._tokens_seen))
+        self._tokens_seen = self._tokens_total
+        self._pressure_fc.observe(pressure)
+        self.forecast_pressure = self._pressure_fc.predict(1)
+        return self.forecast_pressure
+
+    def report(self, rows: list[dict[str, Any]], *, alive: int,
+               shed_pressure: float, downclass_pressure: float,
+               horizon: int | None = None) -> dict[str, Any]:
+        """The combined observatory document (`obs.capacity`) over the
+        recorded samples plus tick-indexed TTFT/TPOT series derived
+        from the latency rows.  Pure: calling it twice yields the same
+        bytes — the chaos ``forecast_determinism`` invariant."""
+        n = len(self.pressure)
+        samples = {
+            "pressure": self.pressure,
+            "queue_depth": self.queue_depth,
+            "admissions": self.admissions,
+            "tokens": self.tokens,
+            "ttft": _cumulative_series(
+                ((r["first_token_tick"],
+                  float(r["first_token_tick"] - r["submit_tick"]))
+                 for r in rows if r["first_token_tick"] is not None), n),
+            "tpot": _cumulative_series(
+                ((r["finish_tick"],
+                  (r["finish_tick"] - r["first_token_tick"])
+                  / (r["output_tokens"] - 1))
+                 for r in rows if r["first_token_tick"] is not None
+                 and r["output_tokens"] >= 2), n),
+        }
+        inputs = {
+            "ticks": n,
+            "alive": alive,
+            "last_pressure": self.pressure[-1] if self.pressure else 0.0,
+            "replica_tokens": dict(sorted(self.replica_tokens.items())),
+        }
+        return _capacity.observatory_report(
+            samples, inputs, policy=self.policy, horizon=horizon,
+            shed_pressure=shed_pressure,
+            downclass_pressure=downclass_pressure)
 
 
 class ServingFrontend:
@@ -310,6 +420,9 @@ class ServingFrontend:
         self.events_log: list[tuple] = []
         #: every drain decision, in order (`frontend.migrate`)
         self.migrations: list[MigrationRecord] = []
+        #: load forecaster (None = disabled = zero tick-loop work)
+        self.forecast = (ForecastTracker(config.forecast)
+                         if config.forecast is not None else None)
         # deterministic mirrors of the obs counters (telemetry is off
         # by default; the summary must not depend on it)
         self.counts = {
@@ -424,6 +537,8 @@ class ServingFrontend:
         fr.tokens.append(int(token))
         fr.emitters.append(replica_id)
         fr.waiting_since = None
+        if self.forecast is not None:
+            self.forecast.note_token(replica_id)
         if self.on_token is not None:
             self.on_token(fr, int(token))
 
@@ -948,6 +1063,8 @@ class ServingFrontend:
             (_STEP_DOWN if new > old else _RECOVER).inc()
             for handle in self.replicas:
                 self._apply_ladder_to(handle)
+        if self.forecast is not None:
+            self._observe_forecast(t, mean)
         if obs.enabled():
             _LEVEL_G.set(self.ladder.level)
             _PRESSURE_G.set(mean)
@@ -958,7 +1075,58 @@ class ServingFrontend:
                 _R_UTIL_G.set(load["page_utilization"],
                               replica=handle.replica_id)
 
+    def _observe_forecast(self, t: int, mean: float) -> None:
+        """Feed the per-tick sample row; then — advisory mode only —
+        log what forecast-driven admission WOULD have done.  Nothing
+        here feeds back into routing, shedding, or the ladder: the
+        forecast stays a measurement until the elastic-scaling PR."""
+        tracker = self.forecast
+        depth = 0
+        for handle in self.replicas:
+            if handle.alive:
+                load = handle.load()
+                depth += load["waiting"] + load["running"]
+        admits = sum(1 for ev in self.events_log[tracker.events_seen:]
+                     if ev[0] == "admit")
+        tracker.events_seen = len(self.events_log)
+        pred = tracker.record_tick(mean, depth, admits)
+        if not tracker.policy.advisory:
+            return
+        shed_wm = self.config.shed.shed_pressure
+        down_wm = self.config.shed.downclass_pressure
+        if pred >= shed_wm and mean < shed_wm:
+            self.events_log.append(
+                ("forecast", t, "would_shed", _r6(pred), _r6(mean)))
+        elif pred >= down_wm and mean < down_wm:
+            self.events_log.append(
+                ("forecast", t, "would_downclass", _r6(pred), _r6(mean)))
+
+    @property
+    def forecast_pressure(self) -> float | None:
+        """One-step-ahead mean-pressure forecast (None while
+        forecasting is disabled).  Advisory surface for the supervisor
+        / ladder dashboards; control flow never reads it (the
+        zero-overhead contract in `frontend.degrade`)."""
+        return (None if self.forecast is None
+                else self.forecast.forecast_pressure)
+
     # -- reporting --------------------------------------------------------
+
+    def forecast_report(self, *,
+                        horizon: int | None = None) -> dict[str, Any]:
+        """The observatory document (`obs.capacity.observatory_report`)
+        over this run's recorded samples; ValueError while forecasting
+        is disabled."""
+        if self.forecast is None:
+            raise ValueError(
+                "forecasting is disabled (FrontendConfig.forecast is "
+                "None); construct the front end with a ForecastPolicy")
+        return self.forecast.report(
+            self.latency_rows(),
+            alive=sum(1 for h in self.replicas if h.alive),
+            shed_pressure=self.config.shed.shed_pressure,
+            downclass_pressure=self.config.shed.downclass_pressure,
+            horizon=horizon)
 
     def outputs(self) -> dict[str, list[int]]:
         """Streamed tokens per request, submission order."""

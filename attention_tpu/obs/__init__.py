@@ -42,9 +42,11 @@ from attention_tpu.obs.export import (  # noqa: F401
     dump,
     jsonl_lines,
     load_dump,
+    load_forecast,
     load_slo,
     load_traces,
     prom_text,
+    write_forecast,
     write_jsonl,
     write_slo,
 )
@@ -83,6 +85,8 @@ from attention_tpu.obs.spans import (  # noqa: F401
     record_event,
     span,
 )
+from attention_tpu.obs import capacity  # noqa: F401
+from attention_tpu.obs import forecast  # noqa: F401
 from attention_tpu.obs import slo  # noqa: F401
 from attention_tpu.obs import spans as _spans
 from attention_tpu.obs import trace  # noqa: F401

@@ -1,0 +1,157 @@
+"""Capacity accounting on top of the forecaster: the observatory.
+
+The ROADMAP's elastic-autoscaling item needs four numbers before any
+scaling decision is measurable — this module computes all of them from
+plain deterministic inputs (no registry reads; telemetry stays
+optional):
+
+* **per-replica effective tokens/tick** — tokens each replica actually
+  emitted over the run, normalized by virtual ticks;
+* **fleet headroom** — ``1 - last observed mean pressure``, the
+  fraction of fleet capacity still unspent;
+* **cost-per-token** — replica-ticks burned per emitted token
+  (``alive_replicas * ticks / tokens``): the unit a scale-in decision
+  minimizes;
+* **time-to-saturation** — the first *predicted* tick at which the
+  pressure forecast crosses the shed / downclass watermarks, straight
+  off the :mod:`attention_tpu.obs.forecast` horizon table.
+
+:func:`observatory_report` assembles the combined ``forecast.json``
+document (forecast blocks + capacity block + the raw samples), and
+:func:`rebuild_report` recomputes it byte-identically from a loaded
+dump — the contract behind ``cli obs forecast --run DIR [--horizon H]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from attention_tpu.obs import forecast as _forecast
+from attention_tpu.obs import registry as _registry
+from attention_tpu.obs.forecast import ForecastPolicy, _r6
+from attention_tpu.obs.naming import (
+    SERIES_CAPACITY_HEADROOM,
+    SERIES_COST_PER_TOKEN,
+)
+
+#: default watermarks, mirrored from frontend.degrade.ShedPolicy
+DEFAULT_SHED_PRESSURE = 0.92
+DEFAULT_DOWNCLASS_PRESSURE = 0.75
+
+
+def capacity_report(inputs: dict[str, Any],
+                    pressure_block: dict[str, Any] | None = None, *,
+                    shed_pressure: float = DEFAULT_SHED_PRESSURE,
+                    downclass_pressure: float = DEFAULT_DOWNCLASS_PRESSURE,
+                    ) -> dict[str, Any]:
+    """Deterministic capacity block.
+
+    ``inputs``: ``{"ticks": int, "alive": int, "last_pressure": float,
+    "replica_tokens": {replica_id_str: tokens}}`` — replica ids are
+    strings so the block round-trips through JSON byte-identically.
+    """
+    ticks = int(inputs.get("ticks", 0))
+    alive = int(inputs.get("alive", 0))
+    last_pressure = float(inputs.get("last_pressure", 0.0))
+    per = inputs.get("replica_tokens", {}) or {}
+    rows = []
+    total = 0
+    for rid in sorted(per):
+        tok = int(per[rid])
+        total += tok
+        rows.append({
+            "replica": str(rid),
+            "tokens": tok,
+            "tokens_per_tick": _r6(tok / ticks) if ticks else 0.0,
+        })
+    headroom = min(1.0, max(0.0, 1.0 - last_pressure))
+    cost = _r6(alive * ticks / total) if total else None
+    saturation = {}
+    for name, wm in (("downclass", downclass_pressure),
+                     ("shed", shed_pressure)):
+        row = (_forecast.crossing(pressure_block, wm)
+               if pressure_block is not None else None)
+        saturation[name] = {
+            "watermark": _r6(wm),
+            "h": row["h"] if row else None,
+            "tick": row["tick"] if row else None,
+            "pressure": row["mean"] if row else None,
+        }
+    return {
+        "replicas": rows,
+        "fleet": {
+            "ticks": ticks,
+            "alive_replicas": alive,
+            "tokens": total,
+            "tokens_per_tick": _r6(total / ticks) if ticks else 0.0,
+            "headroom": _r6(headroom),
+            "cost_per_token": cost,
+        },
+        "time_to_saturation": saturation,
+    }
+
+
+def observatory_report(samples: dict[str, Any],
+                       capacity_inputs: dict[str, Any], *,
+                       policy: ForecastPolicy | None = None,
+                       horizon: int | None = None,
+                       shed_pressure: float = DEFAULT_SHED_PRESSURE,
+                       downclass_pressure: float = DEFAULT_DOWNCLASS_PRESSURE,
+                       ) -> dict[str, Any]:
+    """The full forecast+capacity document serve-sim dumps as
+    ``forecast.json``.  Carries the raw samples so the report can be
+    rebuilt (at any horizon) from the dump alone."""
+    p = policy or ForecastPolicy()
+    doc = _forecast.forecast_report(samples, policy=p, horizon=horizon)
+    pblock = next((b for b in doc["series"]
+                   if b["name"] == _forecast.PRESSURE_SERIES), None)
+    doc["watermarks"] = {"shed": _r6(shed_pressure),
+                         "downclass": _r6(downclass_pressure)}
+    doc["capacity"] = capacity_report(
+        capacity_inputs, pblock,
+        shed_pressure=shed_pressure,
+        downclass_pressure=downclass_pressure)
+    doc["samples"] = {name: [float(v) for v in samples[name]]
+                      for name in sorted(samples)}
+    doc["capacity_inputs"] = {
+        "ticks": int(capacity_inputs.get("ticks", 0)),
+        "alive": int(capacity_inputs.get("alive", 0)),
+        "last_pressure": float(capacity_inputs.get("last_pressure", 0.0)),
+        "replica_tokens": {
+            str(k): int(v)
+            for k, v in sorted(
+                (capacity_inputs.get("replica_tokens", {}) or {}).items())
+        },
+    }
+    return doc
+
+
+def rebuild_report(doc: dict[str, Any], *,
+                   horizon: int | None = None) -> dict[str, Any]:
+    """Recompute an observatory report from its own embedded samples.
+
+    With ``horizon=None`` the rebuild is byte-identical to ``doc``
+    (pinned by test); a different horizon re-runs the same state over
+    a longer/shorter table."""
+    p = ForecastPolicy.from_dict(doc["policy"])
+    h = int(doc["horizon"] if horizon is None else horizon)
+    return observatory_report(
+        doc["samples"], doc["capacity_inputs"], policy=p, horizon=h,
+        shed_pressure=float(doc["watermarks"]["shed"]),
+        downclass_pressure=float(doc["watermarks"]["downclass"]))
+
+
+def publish(report: dict[str, Any]) -> None:
+    """Mirror the capacity headline gauges onto the frozen registry
+    series (no-op while telemetry is disabled)."""
+    if not _registry.is_enabled():
+        return
+    cap = report.get("capacity", report)
+    fleet = cap["fleet"]
+    head = _registry.gauge(SERIES_CAPACITY_HEADROOM,
+                           "fleet capacity headroom (1 = idle)")
+    head.set(fleet["headroom"])
+    if fleet["cost_per_token"] is not None:
+        cost = _registry.gauge(SERIES_COST_PER_TOKEN,
+                               "replica-ticks per emitted token")
+        cost.set(fleet["cost_per_token"])

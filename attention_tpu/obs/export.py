@@ -37,6 +37,7 @@ DUMP_METRICS = "metrics.json"
 DUMP_EVENTS = "events.jsonl"
 DUMP_TRACES = "traces.jsonl"
 DUMP_SLO = "slo.json"
+DUMP_FORECAST = "forecast.json"
 DUMP_DEVICE = "device"
 
 #: percentile-key -> Prometheus quantile-label spelling
@@ -280,6 +281,25 @@ def write_slo(out_dir: str, report: dict[str, Any]) -> None:
 def load_slo(run_dir: str) -> dict[str, Any] | None:
     """The dump's SLO report, or None if the run wrote none."""
     path = os.path.join(run_dir, DUMP_SLO)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_forecast(out_dir: str, report: dict[str, Any]) -> None:
+    """Persist an `obs.capacity.observatory_report` next to the metrics
+    dump, in canonical form (sorted keys) so same-seed runs are
+    byte-identical."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, DUMP_FORECAST), "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_forecast(run_dir: str) -> dict[str, Any] | None:
+    """The dump's forecast report, or None if the run wrote none."""
+    path = os.path.join(run_dir, DUMP_FORECAST)
     if not os.path.exists(path):
         return None
     with open(path) as f:

@@ -1,0 +1,285 @@
+"""Deterministic load forecasting over the frozen fleet series.
+
+Holt double exponential smoothing (EWMA level + trend) with an
+optional additive seasonal term (Holt-Winters) sized to the sim's
+diurnal period, updated one observation per front-end tick::
+
+    level_t = alpha * (x_t - season_t) + (1 - alpha) * (level + trend)
+    trend_t = beta  * (level_t - level) + (1 - beta)  * trend
+    season_t' = gamma * (x_t - level_t) + (1 - gamma) * season_t
+    forecast(h) = level + h * trend + season_{t+h}
+
+Every prediction is *backtested* as it is made: before folding in
+observation ``x_t`` the forecaster records its own one-step-ahead
+error, so the report carries a rolling MAPE and a residual-quantile
+error band (``lo``/``hi`` widen with sqrt(h)) whose empirical coverage
+is reported alongside.  All arithmetic is over virtual front-end ticks
+(never wall time — ATP801-clean) and every container is emitted in
+sorted order with a pinned ``generated_at``, so ``forecast_report`` is
+byte-deterministic: same seed + same series -> same report, the
+property ``cli obs forecast`` and the chaos ``forecast_determinism``
+invariant pin.
+
+This module is pure: it consumes plain per-tick sample lists (fed by
+``ServingFrontend``'s ``ForecastTracker``) so it imports nothing above
+the obs layer.  Registry mirrors land under the frozen names in
+:mod:`attention_tpu.obs.naming` and only while telemetry is enabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Iterable
+
+from attention_tpu.obs import registry as _registry
+from attention_tpu.obs.naming import SERIES_FORECAST_PRESSURE
+
+#: report format version (bumped on breaking shape changes)
+FORECAST_REPORT_VERSION = 1
+
+#: report-local name of the pressure sample series (the block the
+#: capacity layer reads watermark crossings from)
+PRESSURE_SERIES = "pressure"
+
+
+def _r6(x: float) -> float:
+    return round(float(x), 6)
+
+
+@dataclasses.dataclass(frozen=True)
+class ForecastPolicy:
+    """Smoothing constants + horizon for one forecaster instance.
+
+    ``season_ticks=None`` disables the seasonal term (plain Holt);
+    set it to the workload's diurnal period to enable Holt-Winters.
+    ``advisory`` gates the would-have-acted event hooks in the
+    front end — it never changes routing or shedding decisions.
+    """
+
+    alpha: float = 0.5
+    beta: float = 0.3
+    gamma: float = 0.3
+    season_ticks: int | None = None
+    horizon: int = 8
+    backtest_window: int = 64
+    advisory: bool = False
+
+    def validate(self) -> None:
+        if not 0.0 < self.alpha <= 1.0:
+            raise ValueError("forecast alpha must be in (0, 1]")
+        if not 0.0 <= self.beta <= 1.0:
+            raise ValueError("forecast beta must be in [0, 1]")
+        if not 0.0 <= self.gamma <= 1.0:
+            raise ValueError("forecast gamma must be in [0, 1]")
+        if self.season_ticks is not None and self.season_ticks < 2:
+            raise ValueError("forecast season_ticks must be >= 2 ticks")
+        if self.horizon < 1:
+            raise ValueError("forecast horizon must be >= 1")
+        if self.backtest_window < 2:
+            raise ValueError("forecast backtest_window must be >= 2")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "alpha": self.alpha,
+            "beta": self.beta,
+            "gamma": self.gamma,
+            "season_ticks": self.season_ticks,
+            "horizon": self.horizon,
+            "backtest_window": self.backtest_window,
+            "advisory": self.advisory,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ForecastPolicy":
+        p = cls(
+            alpha=float(d["alpha"]),
+            beta=float(d["beta"]),
+            gamma=float(d["gamma"]),
+            season_ticks=(None if d.get("season_ticks") is None
+                          else int(d["season_ticks"])),
+            horizon=int(d["horizon"]),
+            backtest_window=int(d["backtest_window"]),
+            advisory=bool(d.get("advisory", False)),
+        )
+        p.validate()
+        return p
+
+
+class HoltForecaster:
+    """One Holt(-Winters) state machine, fed one sample per tick.
+
+    Seasonal slots initialize to zero and are learned in place, so the
+    first season's predictions lean on level+trend alone — deliberate:
+    no warm-up pass means the update is strictly online and the state
+    after n observations depends only on the n samples and the policy.
+    """
+
+    def __init__(self, policy: ForecastPolicy | None = None):
+        self.policy = policy or ForecastPolicy()
+        self.level = 0.0
+        self.trend = 0.0
+        self.seasonal: list[float] = [0.0] * (self.policy.season_ticks or 0)
+        self.count = 0
+        #: raw first-season buffer (seasonal mode only, dropped after
+        #: the bootstrap re-initialization)
+        self._warmup: list[float] = []
+        #: one-step residuals (actual - predicted), rolling window
+        self.residuals: list[float] = []
+        self.actuals: list[float] = []
+
+    def predict(self, h: int = 1) -> float:
+        """Forecast ``h`` ticks past the last observation."""
+        if self.count == 0:
+            return 0.0
+        out = self.level + h * self.trend
+        if self.seasonal:
+            out += self.seasonal[(self.count + h - 1) % len(self.seasonal)]
+        return out
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        if self.count:  # backtest before the state absorbs x
+            self.residuals.append(x - self.predict(1))
+            self.actuals.append(x)
+            w = self.policy.backtest_window
+            if len(self.residuals) > w:
+                del self.residuals[:-w]
+                del self.actuals[:-w]
+        p = self.policy
+        if self.count == 0:
+            self.level = x
+            if self.seasonal:
+                self._warmup.append(x)
+        elif self.seasonal and self.count < len(self.seasonal):
+            # first season: plain Holt over the raw values while the
+            # buffer fills (seasonal slots are all still zero)
+            self._warmup.append(x)
+            prev = self.level
+            self.level = (p.alpha * x
+                          + (1.0 - p.alpha) * (self.level + self.trend))
+            self.trend = (p.beta * (self.level - prev)
+                          + (1.0 - p.beta) * self.trend)
+            if len(self._warmup) == len(self.seasonal):
+                # classic HW bootstrap: level = first-season mean,
+                # slots = deviations from it, trend restarted (a
+                # drift estimate needs a second season; zero is the
+                # deterministic safe prior)
+                m = len(self.seasonal)
+                self.level = sum(self._warmup) / m
+                self.trend = 0.0
+                self.seasonal = [v - self.level for v in self._warmup]
+                self._warmup = []
+        else:
+            idx = self.count % len(self.seasonal) if self.seasonal else 0
+            s = self.seasonal[idx] if self.seasonal else 0.0
+            prev = self.level
+            self.level = p.alpha * (x - s) + (1.0 - p.alpha) * (
+                self.level + self.trend)
+            self.trend = (p.beta * (self.level - prev)
+                          + (1.0 - p.beta) * self.trend)
+            if self.seasonal:
+                self.seasonal[idx] = (
+                    p.gamma * (x - self.level)
+                    + (1.0 - p.gamma) * self.seasonal[idx])
+        self.count += 1
+
+    def backtest(self) -> dict[str, Any]:
+        """Rolling one-step error stats over the residual window."""
+        n = len(self.residuals)
+        if not n:
+            return {"points": 0, "one_step_mape": 0.0,
+                    "band_p90": 0.0, "coverage": 0.0}
+        # percentage error is undefined at actual ~ 0 (an idle series
+        # would report astronomic MAPE for microscopic misses), so the
+        # mean runs over the meaningfully-nonzero actuals only
+        ape = [abs(r) / abs(a)
+               for r, a in zip(self.residuals, self.actuals)
+               if abs(a) >= 1e-6]
+        ordered = sorted(abs(r) for r in self.residuals)
+        band = ordered[min(n - 1, max(0, math.ceil(0.9 * n) - 1))]
+        covered = sum(1 for r in self.residuals if abs(r) <= band)
+        return {
+            "points": n,
+            "one_step_mape": _r6(sum(ape) / len(ape)) if ape else 0.0,
+            "band_p90": _r6(band),
+            "coverage": _r6(covered / n),
+        }
+
+
+def forecast_series(name: str, values: Iterable[float], *,
+                    policy: ForecastPolicy | None = None,
+                    horizon: int | None = None) -> dict[str, Any]:
+    """One series block: final state, horizon table, backtest stats.
+
+    ``forecast[i]["tick"]`` is the absolute virtual tick predicted
+    (samples cover ticks ``0..n-1``, so ``h=1`` predicts tick ``n``).
+    Error bands widen with sqrt(h) from the backtested one-step band.
+    """
+    p = policy or ForecastPolicy()
+    h = int(p.horizon if horizon is None else horizon)
+    fc = HoltForecaster(p)
+    for v in values:
+        fc.observe(v)
+    bt = fc.backtest()
+    table = []
+    for step in range(1, h + 1):
+        mean = fc.predict(step)
+        band = bt["band_p90"] * math.sqrt(step)
+        table.append({
+            "h": step,
+            "tick": fc.count + step - 1,
+            "mean": _r6(mean),
+            "lo": _r6(mean - band),
+            "hi": _r6(mean + band),
+        })
+    return {
+        "name": name,
+        "ticks": fc.count,
+        "state": {
+            "level": _r6(fc.level),
+            "trend": _r6(fc.trend),
+            "seasonal": [_r6(s) for s in fc.seasonal],
+        },
+        "backtest": bt,
+        "forecast": table,
+    }
+
+
+def forecast_report(series: dict[str, Iterable[float]], *,
+                    policy: ForecastPolicy | None = None,
+                    horizon: int | None = None) -> dict[str, Any]:
+    """Deterministic forecast report over named per-tick sample series."""
+    p = policy or ForecastPolicy()
+    h = int(p.horizon if horizon is None else horizon)
+    return {
+        "version": FORECAST_REPORT_VERSION,
+        "generated_at": 0,  # pinned: reports are seed-deterministic
+        "horizon": h,
+        "policy": p.to_dict(),
+        "series": [forecast_series(name, series[name], policy=p, horizon=h)
+                   for name in sorted(series)],
+    }
+
+
+def crossing(block: dict[str, Any], threshold: float) -> dict[str, Any] | None:
+    """The first horizon row whose mean forecast reaches ``threshold``,
+    or None if the series stays below it over the whole horizon."""
+    for row in block["forecast"]:
+        if row["mean"] >= threshold:
+            return row
+    return None
+
+
+def publish(report: dict[str, Any]) -> None:
+    """Mirror the pressure forecast onto the frozen registry series
+    (no-op while telemetry is disabled)."""
+    if not _registry.is_enabled():
+        return
+    g = _registry.gauge(SERIES_FORECAST_PRESSURE,
+                        "forecast mean fleet pressure by horizon")
+    for blk in report["series"]:
+        if blk["name"] != PRESSURE_SERIES:
+            continue
+        for row in blk["forecast"]:
+            g.set(row["mean"], horizon=str(row["h"]))

@@ -113,6 +113,12 @@ SERIES_SLO_BURN_RATE = "frontend.slo.burn_rate"
 SERIES_SLO_BUDGET = "frontend.slo.budget_remaining"
 #: SLO violation counter, same labels
 SERIES_SLO_VIOLATIONS = "frontend.slo.violations"
+#: horizon-h forecast of mean fleet pressure, labels: horizon
+SERIES_FORECAST_PRESSURE = "frontend.forecast.pressure"
+#: fleet headroom gauge (1.0 = fully idle, 0.0 = saturated)
+SERIES_CAPACITY_HEADROOM = "frontend.capacity.headroom"
+#: cost-per-token gauge, replica-ticks spent per emitted token
+SERIES_COST_PER_TOKEN = "obs.capacity.cost_per_token"
 
 #: every frozen fleet series, name -> instrument kind
 FROZEN_SERIES: dict[str, str] = {
@@ -123,4 +129,7 @@ FROZEN_SERIES: dict[str, str] = {
     SERIES_SLO_BURN_RATE: "gauge",
     SERIES_SLO_BUDGET: "gauge",
     SERIES_SLO_VIOLATIONS: "counter",
+    SERIES_FORECAST_PRESSURE: "gauge",
+    SERIES_CAPACITY_HEADROOM: "gauge",
+    SERIES_COST_PER_TOKEN: "gauge",
 }

@@ -1,0 +1,44 @@
+"""Print the committed benchmark trajectory; fail on regression.
+
+Reads the ``BENCH_r*.json`` files at the repo root (one per PR round),
+prints the per-round headline trend — kernel ms, MXU utilization,
+speedup value — and exits nonzero when the headline kernel time
+regressed more than 10% between consecutive rounds.  Thin shell over
+``attention_tpu.analysis.benchtrend`` (the ATP506 pass `cli analyze` /
+``scripts/check_all.py`` already run), kept so the trend is one
+command away:
+
+    python scripts/bench_trend.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from attention_tpu.analysis.benchtrend import (  # noqa: E402
+    render_trend,
+    trend_problems,
+    trend_rows,
+)
+from attention_tpu.analysis.core import repo_root  # noqa: E402
+
+
+def main() -> int:
+    root = repo_root()
+    rows = trend_rows(root)
+    if not rows:
+        print("no BENCH_r*.json files found", file=sys.stderr)
+        return 1
+    for line in render_trend(rows):
+        print(line)
+    problems = trend_problems(root)
+    for p in problems:
+        print(f"REGRESSION: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -464,6 +464,138 @@ def test_non_source_guard():
     assert {f.code for f in fs} == {"ATP601"}
 
 
+# ---------------------- frozen-series pin (ATP505) ----------------------
+
+def _frozen_index(extra: dict):
+    """A project index holding the REAL naming module plus synthetic
+    creator/consumer sources."""
+    from attention_tpu.analysis.callgraph import ProjectIndex
+
+    with open(os.path.join(_REPO, "attention_tpu/obs/naming.py")) as f:
+        sources = {"attention_tpu/obs/naming.py": f.read()}
+    sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    return ProjectIndex.from_sources(sources)
+
+
+def _all_creators_source():
+    """Source that creates every frozen series via its constant —
+    mirrors how the real creation sites are written."""
+    import attention_tpu.obs.naming as naming
+
+    consts = {v: k for k, v in vars(naming).items()
+              if k.startswith("SERIES_")}
+    lines = ["from attention_tpu.obs import naming",
+             "def wire(obs):"]
+    for name, kind in naming.FROZEN_SERIES.items():
+        lines.append(f"    obs.{kind}(naming.{consts[name]}, 'd')")
+    return "\n".join(lines) + "\n"
+
+
+def test_frozen_series_pin_clean_when_all_created():
+    from attention_tpu.analysis.conventions import frozen_series_findings
+
+    idx = _frozen_index({"attention_tpu/fake/wiring.py":
+                         _all_creators_source()})
+    assert frozen_series_findings(idx) == []
+
+
+def test_frozen_series_pin_fires_on_drift():
+    """All three ATP505 drift classes: a frozen name nobody creates, a
+    creation under the wrong instrument kind, and a consumer re-typing
+    a frozen name as a literal."""
+    from attention_tpu.analysis.conventions import frozen_series_findings
+
+    idx = _frozen_index({
+        "attention_tpu/fake/wiring.py": """
+            from attention_tpu.obs.naming import SERIES_SLO_BUDGET
+            def wire(obs):
+                obs.counter(SERIES_SLO_BUDGET, 'd')  # gauge, not counter
+            """,
+        "attention_tpu/obs/slo.py":
+            'x = "frontend.slo.burn_rate"\n',
+    })
+    fs = frozen_series_findings(idx)
+    assert all(f.code == "ATP505" for f in fs)
+    msgs = [f.message for f in fs]
+    assert any("never created" in m for m in msgs)
+    assert any("created here via counter()" in m for m in msgs)
+    assert any("re-typed as a" in m for m in msgs)
+    # the literal finding lands on the consumer module
+    lit = next(f for f in fs if "re-typed" in f.message)
+    assert lit.path == "attention_tpu/obs/slo.py"
+
+
+def test_frozen_series_pin_ignores_docstring_mentions():
+    from attention_tpu.analysis.conventions import frozen_series_findings
+
+    consumer_src = (
+        '"""Mirrors land under frontend.capacity.headroom."""\n'
+        "def f():\n"
+        '    "and obs.capacity.cost_per_token too"\n'
+    )
+    idx = _frozen_index({
+        "attention_tpu/fake/wiring.py": _all_creators_source(),
+        "attention_tpu/obs/capacity.py": consumer_src,
+    })
+    assert frozen_series_findings(idx) == []
+
+
+def test_frozen_series_pin_runs_in_tree_gate():
+    """The pass is registered, index-aware, and project-scoped, so
+    `cli analyze` / check_all run it automatically."""
+    p = core.PASSES["frozen-series"]
+    assert p.scope == "project" and p.needs_index
+    assert p.codes == ("ATP505",)
+
+
+# ---------------------- bench trend (ATP506) ----------------------
+
+def _write_bench(root, rnd, kernel_ms):
+    with open(os.path.join(root, f"BENCH_r{rnd:02d}.json"), "w") as f:
+        json.dump({"n": rnd, "parsed": {
+            "value": 1000.0, "detail": {
+                "tpu_kernel_ms": kernel_ms,
+                "mxu_utilization_of_peak": 0.9}}}, f)
+
+
+def test_bench_trend_committed_trajectory_is_clean():
+    """The gate must pass on the repo's own committed history — it
+    keys on kernel ms, not the speedup value (whose serial baseline
+    legitimately re-based between rounds)."""
+    from attention_tpu.analysis import benchtrend
+
+    assert benchtrend.trend_problems(_REPO) == []
+    rows = benchtrend.trend_rows(_REPO)
+    assert len(rows) >= 5
+    assert all("error" not in r for r in rows)
+
+
+def test_bench_trend_fires_on_regression(tmp_path):
+    from attention_tpu.analysis import benchtrend
+
+    root = str(tmp_path)
+    _write_bench(root, 1, 3.0)
+    _write_bench(root, 2, 3.2)   # +6.7%: inside budget
+    _write_bench(root, 3, 3.6)   # +12.5%: regression
+    problems = benchtrend.trend_problems(root)
+    assert len(problems) == 1
+    assert "BENCH_r03.json" in problems[0]
+    assert "+12.5%" in problems[0]
+    fs = list(core.PASSES["bench-trend"].fn(root))
+    assert [f.code for f in fs] == ["ATP506"]
+
+
+def test_bench_trend_flags_unparsable_round(tmp_path):
+    from attention_tpu.analysis import benchtrend
+
+    root = str(tmp_path)
+    _write_bench(root, 1, 3.0)
+    with open(os.path.join(root, "BENCH_r02.json"), "w") as f:
+        f.write('{"parsed": {}}')
+    problems = benchtrend.trend_problems(root)
+    assert len(problems) == 1 and "unparsable" in problems[0]
+
+
 # ---------------------- determinism (ATP8xx) ----------------------
 
 def test_atp801_wall_clock_into_artifact_sink():
@@ -806,7 +938,8 @@ def test_every_registered_pass_has_codes_and_stable_ids():
     assert set(core.PASSES) == {"purity", "pallas", "precision",
                                 "errors", "obs-naming", "shipped-table",
                                 "tolerance-ledger", "source-only-tree",
-                                "durability", "determinism"}
+                                "durability", "determinism",
+                                "frozen-series", "bench-trend"}
     for p in core.PASSES.values():
         assert p.codes, p.name
         assert p.scope in ("file", "project")
@@ -818,7 +951,8 @@ def test_every_registered_pass_has_codes_and_stable_ids():
     # stable public ids: retiring/renumbering any of these is a break
     assert {"ATP001", "ATP101", "ATP102", "ATP103", "ATP201", "ATP202",
             "ATP203", "ATP204", "ATP301", "ATP302", "ATP401", "ATP402",
-            "ATP501", "ATP502", "ATP503", "ATP504", "ATP601",
+            "ATP501", "ATP502", "ATP503", "ATP504", "ATP505",
+            "ATP506", "ATP601",
             "ATP701", "ATP801", "ATP802", "ATP803", "ATP804"
             } <= set(core.CODES)
 

@@ -407,6 +407,29 @@ def test_faults_counters_tick_when_obs_enabled(fault_fixture):
         (obs.enable if was else obs.disable)()
 
 
+# ------------------------------------------- forecast invariant (ISSUE 14)
+
+
+def test_forecast_determinism_invariant_wired():
+    """ISSUE 14: every frontend campaign runs with passive forecasting
+    on, so the kill/gray/crash storms all exercise invariant 13 (the
+    observatory report must be reproducible and rebuild byte-identically
+    from its own samples); the checker stays silent when forecasting is
+    off."""
+    from attention_tpu.chaos import invariants as inv
+    from attention_tpu.chaos.faults import default_frontend_config
+
+    fc = default_frontend_config(2)
+    assert fc.forecast is not None
+    assert not fc.forecast.advisory  # passive: behavior-preserving
+
+    class _NoForecast:
+        forecast = None
+
+    assert inv.forecast_determinism_violations(_NoForecast()) == []
+    assert "Forecast determinism" in (inv.__doc__ or "")
+
+
 # ----------------------------------------------------- long campaigns
 
 

@@ -199,6 +199,53 @@ def test_routing_affinity_keeps_prefix_hit_rate(tiny_model):
                for fr in fe.requests.values())
 
 
+# ------------------------------------------------------ diurnal trace
+
+
+def test_diurnal_trace_deterministic_and_shaped():
+    """ISSUE 14 satellite: the diurnal generator is seed-deterministic,
+    carries the bursty-trace resilience schema, rises from trough to
+    peak over one period, and stamps every rag_every-th request with
+    its tenant's long retrieval prefix."""
+    from attention_tpu.engine import diurnal_trace
+
+    kw = dict(vocab=43, seed=5, period=48, base_rate=1.0, peak_rate=4.0,
+              tenants=3, rag_every=7, rag_prefill_len=64,
+              prompt_len_min=4, prompt_len_max=10, max_tokens=3)
+    a = diurnal_trace(96, **kw)
+    assert a == diurnal_trace(96, **kw)  # same seed -> same trace
+
+    arrivals = [r["arrival"] for r in a]
+    assert arrivals == sorted(arrivals)
+    assert all(r["session"].startswith("tenant-") for r in a)
+    assert all(r["priority"] in (0, 1, 2) for r in a)
+
+    # sinusoidal shape: the mid-period (peak-rate) half of the day
+    # packs more arrivals than the trough half
+    period = kw["period"]
+    day = [t % period for t in arrivals]
+    peak_half = sum(1 for t in day if period // 4 <= t < 3 * period // 4)
+    assert peak_half > len(day) - peak_half
+
+    # RAG bursts: every 7th request carries the 64-token tenant header
+    prefixes = {}
+    for i, r in enumerate(a):
+        if (i + 1) % 7 == 0:
+            head = tuple(r["prompt"][:64])
+            assert len(r["prompt"]) >= 64 + kw["prompt_len_min"]
+            prev = prefixes.setdefault(r["session"], head)
+            assert prev == head  # per-tenant header is shared
+        else:
+            assert len(r["prompt"]) <= kw["prompt_len_max"]
+
+    with pytest.raises(ValueError):
+        diurnal_trace(4, vocab=43, period=1)
+    with pytest.raises(ValueError):
+        diurnal_trace(4, vocab=43, base_rate=3.0, peak_rate=2.0)
+    with pytest.raises(ValueError):
+        diurnal_trace(0, vocab=43)
+
+
 # ------------------------------------------------------ retry/backoff
 
 

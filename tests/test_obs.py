@@ -795,3 +795,245 @@ def test_obs_name_lint_tree_is_clean_and_catches_violations(tmp_path):
     assert len(errors) == 4
     assert sum("violates" in e for e in errors) == 3
     assert sum("closed enum" in e for e in errors) == 1
+
+
+# ------------------------------------------- forecast + capacity (ISSUE 14)
+
+
+def _holt_mape(values, policy=None):
+    from attention_tpu.obs import forecast as fc
+
+    block = fc.forecast_series("x", values, policy=policy)
+    return block["backtest"]["one_step_mape"]
+
+
+def test_forecast_policy_validation():
+    from attention_tpu.obs.forecast import ForecastPolicy
+
+    ForecastPolicy().validate()
+    for bad in (dict(alpha=0.0), dict(alpha=1.5), dict(beta=-0.1),
+                dict(gamma=2.0), dict(season_ticks=1), dict(horizon=0),
+                dict(backtest_window=1)):
+        with pytest.raises(ValueError):
+            ForecastPolicy(**bad).validate()
+    rt = ForecastPolicy.from_dict(
+        ForecastPolicy(season_ticks=48, advisory=True).to_dict())
+    assert rt.season_ticks == 48 and rt.advisory
+
+
+def test_forecast_accuracy_floor_step_ramp_diurnal():
+    """ISSUE 14 acceptance: backtested one-step MAPE <= 15% on seeded
+    synthetic step / ramp / diurnal series."""
+    import math as m
+
+    from attention_tpu.obs.forecast import ForecastPolicy
+
+    step = [0.2] * 64 + [0.6] * 64
+    assert _holt_mape(step) <= 0.15
+
+    ramp = [0.01 * t for t in range(1, 129)]
+    assert _holt_mape(ramp) <= 0.15
+
+    diurnal = [0.5 + 0.4 * m.sin(2 * m.pi * t / 48) for t in range(192)]
+    assert _holt_mape(
+        diurnal, ForecastPolicy(season_ticks=48)) <= 0.15
+
+
+def test_forecast_watermark_crossing_within_two_ticks():
+    """ISSUE 14 acceptance: the predicted watermark-crossing tick is
+    within +-2 of the true crossing at horizon <= 8."""
+    import math as m
+
+    from attention_tpu.obs import forecast as fc
+    from attention_tpu.obs.forecast import ForecastPolicy
+
+    # ramp: pressure 0.02*t crosses 0.92 at t = 46; observe 40 ticks
+    ramp = [0.02 * t for t in range(40)]
+    block = fc.forecast_series("pressure", ramp,
+                               policy=ForecastPolicy(), horizon=8)
+    row = fc.crossing(block, 0.92)
+    assert row is not None and abs(row["tick"] - 46) <= 2
+
+    # diurnal: two full seasons learned, cut mid-climb of day three
+    period = 48
+    series = [0.55 + 0.45 * m.sin(2 * m.pi * t / period)
+              for t in range(2 * period + 10)]
+    true_tick = next(t for t in range(2 * period + 10, 4 * period)
+                     if 0.55 + 0.45 * m.sin(2 * m.pi * t / period)
+                     >= 0.92)
+    block = fc.forecast_series(
+        "pressure", series,
+        policy=ForecastPolicy(season_ticks=period), horizon=8)
+    row = fc.crossing(block, 0.92)
+    assert row is not None and abs(row["tick"] - true_tick) <= 2
+
+
+def test_forecast_report_deterministic_and_rebuilds():
+    """Same samples -> byte-identical report; the embedded samples
+    rebuild it byte-identically; a new horizon reshapes the table."""
+    import math as m
+
+    from attention_tpu.obs import capacity as cap
+    from attention_tpu.obs.forecast import ForecastPolicy
+
+    samples = {
+        "pressure": [0.4 + 0.3 * m.sin(2 * m.pi * t / 24)
+                     for t in range(60)],
+        "queue_depth": [float(t % 5) for t in range(60)],
+    }
+    inputs = {"ticks": 60, "alive": 2, "last_pressure": 0.45,
+              "replica_tokens": {"0": 90, "1": 84}}
+    pol = ForecastPolicy(season_ticks=24)
+    a = cap.observatory_report(samples, inputs, policy=pol)
+    b = cap.observatory_report(samples, inputs, policy=pol)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["version"] == 1 and a["generated_at"] == 0
+
+    rebuilt = cap.rebuild_report(json.loads(json.dumps(a)))
+    assert json.dumps(rebuilt, sort_keys=True) == \
+        json.dumps(a, sort_keys=True)
+
+    wider = cap.rebuild_report(a, horizon=12)
+    assert all(len(blk["forecast"]) == 12 for blk in wider["series"])
+
+    fleet = a["capacity"]["fleet"]
+    assert fleet["tokens"] == 174
+    assert fleet["cost_per_token"] == pytest.approx(2 * 60 / 174, abs=1e-6)
+    assert 0.0 <= fleet["headroom"] <= 1.0
+
+
+def _run_frontend_forecast(tiny_model, forecast):
+    """Like _run_frontend but returns the frontend too (forecast
+    tracker state is part of what the tests pin)."""
+    from attention_tpu.engine import bursty_trace
+    from attention_tpu.frontend import (
+        FrontendConfig,
+        ServingFrontend,
+        replay_frontend,
+    )
+
+    model, params = tiny_model
+    trace = bursty_trace(5, vocab=43, seed=7, shared_prefix_len=129,
+                         tenants=2, burst_every=3, burst_size=2,
+                         prompt_len_min=4, prompt_len_max=10,
+                         max_tokens=3)
+    frontend = ServingFrontend(
+        model, params, _engine_config(),
+        FrontendConfig(num_replicas=2, seed=0, forecast=forecast),
+    )
+    summary, outputs = replay_frontend(frontend, trace)
+    return frontend, summary, outputs
+
+
+def test_forecast_zero_overhead_and_advisory_parity(tiny_model):
+    """ISSUE 14 acceptance: forecasting rides the telemetry contract —
+    obs off/on and forecast off/on/advisory all produce byte-identical
+    token streams, summaries, and (modulo advisory 'forecast' tuples)
+    event logs.  The forecaster observes; it never acts."""
+    import jax
+
+    from attention_tpu.frontend import ForecastPolicy
+
+    assert not obs.is_enabled()
+    fe_off, s_off, o_off = _run_frontend_forecast(tiny_model, None)
+    assert fe_off.forecast is None and fe_off.forecast_pressure is None
+    with pytest.raises(ValueError, match="forecasting is disabled"):
+        fe_off.forecast_report()
+
+    fe_on, s_on, o_on = _run_frontend_forecast(
+        tiny_model, ForecastPolicy())
+    assert o_on == o_off and s_on == s_off
+    assert fe_on.events_log == fe_off.events_log
+    assert fe_on.forecast_pressure is not None
+
+    fe_adv, s_adv, o_adv = _run_frontend_forecast(
+        tiny_model, ForecastPolicy(advisory=True))
+    assert o_adv == o_off and s_adv == s_off
+    assert [e for e in fe_adv.events_log if e[0] != "forecast"] == \
+        fe_off.events_log
+
+    # fresh report calls are byte-identical (what invariant 13 pins)
+    rep = fe_on.forecast_report()
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(fe_on.forecast_report(), sort_keys=True)
+    assert {b["name"] for b in rep["series"]} == {
+        "pressure", "queue_depth", "admissions", "tokens",
+        "ttft", "tpot"}
+
+    # telemetry ON changes nothing either (the original contract,
+    # extended over the forecasting hot path)
+    obs.enable()
+    obs.reset()
+    try:
+        jax.clear_caches()
+        _fe2, s2, o2 = _run_frontend_forecast(
+            tiny_model, ForecastPolicy())
+        assert o2 == o_off and s2 == s_off
+    finally:
+        obs.reset()
+        obs.disable()
+
+
+def test_forecast_chaos_invariant_checker(tiny_model):
+    """chaos invariant 13: clean on a healthy forecast-enabled run,
+    silent (no false positives) when forecasting is off."""
+    from attention_tpu.chaos import invariants as inv
+    from attention_tpu.frontend import ForecastPolicy
+
+    fe_on, _s, _o = _run_frontend_forecast(tiny_model, ForecastPolicy())
+    assert inv.forecast_determinism_violations(fe_on) == []
+    fe_off, _s, _o = _run_frontend_forecast(tiny_model, None)
+    assert inv.forecast_determinism_violations(fe_off) == []
+
+
+def test_cli_obs_forecast_from_dump_alone(tmp_path, capsys):
+    """ISSUE 14 acceptance: the forecast + capacity report
+    reconstructs byte-identically from the --obs-out dump alone, and
+    two same-seed runs print it byte-identically."""
+    from attention_tpu.cli import main
+
+    was = obs.is_enabled()
+    args = ["serve-sim", "--replicas", "2", "--num-requests", "4",
+            "--max-tokens", "3", "--prompt-len-max", "8",
+            "--diurnal", "--rag-prefill-len", "0", "--forecast"]
+    try:
+        outs = []
+        for d in ("run1", "run2"):
+            run = tmp_path / d
+            assert main([*args, "--obs-out", str(run)]) == 0
+            capsys.readouterr()
+            assert main(["obs", "forecast", "--run", str(run)]) == 0
+            outs.append(capsys.readouterr().out)
+        assert outs[0] == outs[1]  # byte-identical same-seed report
+        with open(tmp_path / "run1" / "forecast.json") as f:
+            assert f.read() == outs[0]  # CLI == committed dump bytes
+
+        doc = json.loads(outs[0])
+        assert doc["version"] == 1 and doc["generated_at"] == 0
+        assert doc["policy"]["season_ticks"] == 48  # --diurnal default
+        assert doc["watermarks"] == {"shed": 0.92, "downclass": 0.75}
+        assert {b["name"] for b in doc["series"]} == {
+            "pressure", "queue_depth", "admissions", "tokens",
+            "ttft", "tpot"}
+        assert {r["replica"] for r in doc["capacity"]["replicas"]} == \
+            {"replica-0", "replica-1"}
+
+        # --horizon rebuilds from the embedded samples
+        assert main(["obs", "forecast", "--run",
+                     str(tmp_path / "run1"), "--horizon", "3"]) == 0
+        wider = json.loads(capsys.readouterr().out)
+        assert all(len(b["forecast"]) == 3 for b in wider["series"])
+
+        # obs report grows the forecast section
+        assert main(["obs", "report", "--run",
+                     str(tmp_path / "run1")]) == 0
+        text = capsys.readouterr().out
+        assert "== forecast ==" in text
+        assert "saturation[shed] @ 0.92" in text
+
+        # a dump without forecast.json degrades cleanly
+        assert main(["obs", "forecast", "--run", str(tmp_path)]) == 1
+        capsys.readouterr()
+    finally:
+        obs.reset()
+        (obs.enable if was else obs.disable)()
