@@ -934,7 +934,15 @@ def run_crash_campaign(seed: int, snapshot_root: str, *,
     of the six storm invariants each drained plan is checked for
     invariant 7 (round trip on every survivor) and invariant 8
     (every finished stream token-identical to the fault-free run —
-    crash points may cost warmth, never tokens)."""
+    crash points may cost warmth, never tokens).
+
+    Mesh replicas join the same storm by passing ``config`` with
+    ``mesh_shards`` > 1 (and a ``model`` whose KV heads divide by
+    it): every replica then serves through KV-head-sharded kernels,
+    snapshots carry per-shard ``pools.<s>`` sections, and the SAME
+    invariants apply unchanged — the fault-free baseline is computed
+    with the identical config, so parity failures cannot hide behind
+    the sharding."""
     if model is None or params is None:
         model, params = build_sim_model()
     config = config or default_engine_config()

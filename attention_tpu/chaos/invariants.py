@@ -528,7 +528,13 @@ def snapshot_roundtrip_violations(engine) -> list[str]:
     mean the restored engine's serialization (pools, page accounting,
     prefix index, request queues, RNG positions) is byte-identical,
     so its future outputs are too.  Any `SnapshotError` on a
-    freshly-written snapshot is itself a violation."""
+    freshly-written snapshot is itself a violation.
+
+    On a mesh engine (``mesh_shards`` > 1) the snapshot must also
+    carry the per-shard layout: the manifest's ``shards`` count equal
+    to the engine's, and one ``pools.<s>`` section per shard (each
+    with its own CRC) — a single-blob pool section from a sharded
+    engine would silently lose per-shard damage detection."""
     from attention_tpu.engine import snapshot as snap
 
     problems: list[str] = []
@@ -536,6 +542,22 @@ def snapshot_roundtrip_violations(engine) -> list[str]:
     try:
         path = os.path.join(tmpdir, "snap-00000000.atpsnap")
         snap.save(engine, path)
+        info = snap.inspect(path)
+        want_shards = getattr(engine.config, "mesh_shards", 0) or 1
+        if info.get("shards") != want_shards:
+            problems.append(
+                f"manifest shards {info.get('shards')} != engine "
+                f"mesh_shards {want_shards}"
+            )
+        pool_names = sorted(
+            s["name"] for s in info.get("sections", [])
+            if s["name"] == "pools" or s["name"].startswith("pools.")
+        )
+        want_names = sorted(snap._pool_section_names(want_shards))
+        if pool_names != want_names:
+            problems.append(
+                f"pool sections {pool_names} != expected {want_names}"
+            )
         clone = snap.restore(path, engine.model, engine.params)
         a = snap.state_fingerprint(engine)
         b = snap.state_fingerprint(clone)

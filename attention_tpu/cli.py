@@ -291,6 +291,7 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         prefill_chunk=args.prefill_chunk,
         token_budget=args.token_budget,
         watermark_pages=args.watermark_pages,
+        mesh_shards=args.mesh_shards,
     )
     if (args.snapshot_dir is None) != (args.snapshot_every is None):
         print("--snapshot-dir and --snapshot-every must be set "
@@ -650,6 +651,13 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--prefill-chunk", type=int, default=32)
     ss.add_argument("--token-budget", type=int, default=128)
     ss.add_argument("--watermark-pages", type=int, default=1)
+    ss.add_argument("--mesh-shards", type=int, default=0,
+                    help="serve through KV-head-sharded kernels on a "
+                         "1D 'tp' mesh of N local devices (0 = "
+                         "single-device; tokens are identical either "
+                         "way; --kv-heads must divide by N; on CPU "
+                         "set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=N)")
     # telemetry (attention_tpu.obs)
     ss.add_argument("--obs", action="store_true",
                     help="enable the unified telemetry subsystem for "

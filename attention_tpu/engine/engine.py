@@ -47,6 +47,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from attention_tpu import obs
 from attention_tpu.obs import trace as _trace
@@ -75,6 +76,20 @@ _TIMED_OUT = obs.counter("engine.requests.timed_out",
 # asserted against this; the ops.*.calls counters tick per jit trace)
 _LAUNCHES = obs.counter("engine.step.launches",
                         "jitted model launches dispatched by the step loop")
+# mesh-serving surface: how many KV-head shards the per-step launches
+# lower onto (1 = single-device), and what the shard fan-in costs.  In
+# the zero-collective head-sharded design the kernels exchange nothing;
+# the only cross-shard cost is reassembling the replicated logits at
+# the step's single host sync, which is exactly what the histogram
+# times.
+_MESH_SHARDS = obs.gauge("engine.mesh.shards",
+                         "KV-head shards the engine's jitted launches "
+                         "lower onto (1 = single-device)")
+_COLLECTIVE_MS = obs.histogram("engine.step.collective_ms",
+                               "per-step device sync incl. cross-shard "
+                               "logits reassembly on a mesh engine",
+                               buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 50.0,
+                                        100.0, 500.0))
 
 #: consecutive non-finite-logits steps a request is held back before
 #: the finite guard gives up and samples anyway — must exceed any
@@ -135,6 +150,13 @@ class EngineConfig:
     # double-buffer: stage next step's page-table rows on host while
     # the current launch runs on device (ragged mode only)
     async_steps: bool = False
+    # 0 = single-device (default).  N >= 1 serves every per-step jitted
+    # launch — both step modes — through the KV-head-sharded kernels on
+    # a 1D "tp" mesh of the first N devices: one pool slice per head
+    # shard, page tables replicated, host-side packing unchanged.
+    # Requires num_kv_heads % N == 0 and N available devices (typed
+    # MeshConfigError otherwise, raised at engine construction).
+    mesh_shards: int = 0
 
     def validate(self) -> None:
         if self.page_size % 128:
@@ -155,6 +177,11 @@ class EngineConfig:
             raise ValueError(
                 f"watermark_pages {self.watermark_pages} outside "
                 f"[0, num_pages={self.num_pages})"
+            )
+        if self.mesh_shards < 0:
+            raise ValueError(
+                f"mesh_shards {self.mesh_shards} must be >= 0 "
+                "(0 = single-device)"
             )
 
     @property
@@ -187,14 +214,57 @@ class ServingEngine:
         self.on_finish = on_finish
         self.on_timeout = on_timeout
 
+        # mesh mode: a 1D "tp" mesh of the first mesh_shards devices;
+        # the step launches run the model's head-sharded cached paths
+        # (a clone with tp_axis set — same params, same math per head)
+        # over pools placed one KV-head slice per shard.  Host-side
+        # state — allocator, watermarks, prefix cache, packing — never
+        # shards: page ids are head-agnostic, so one logical pool and
+        # one accounting source of truth serve every shard.
+        if config.mesh_shards:
+            from attention_tpu.parallel.serving import MeshConfigError
+
+            devices = jax.devices()
+            if config.mesh_shards > len(devices):
+                raise MeshConfigError(
+                    f"mesh_shards {config.mesh_shards} exceeds the "
+                    f"{len(devices)} available device(s)"
+                )
+            if model.num_kv_heads % config.mesh_shards:
+                raise MeshConfigError(
+                    f"kv heads {model.num_kv_heads} not divisible by "
+                    f"mesh_shards {config.mesh_shards}"
+                )
+            self.mesh = Mesh(
+                np.asarray(devices[:config.mesh_shards]), ("tp",)
+            )
+            try:
+                self._step_model = model.clone(tp_axis="tp",
+                                               mesh=self.mesh)
+            except TypeError as e:
+                raise MeshConfigError(
+                    f"model {type(model).__name__} lacks the "
+                    f"tp_axis/mesh fields mesh serving clones "
+                    f"(TinyDecoder-family contract): {e}"
+                )
+            self._pool_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, "tp", None, None)
+            )
+        else:
+            self.mesh = None
+            self._step_model = model
+            self._pool_sharding = None
+
         head_dim = model.dim // model.num_q_heads
         dtype = config.cache_dtype or model.dtype
         pool_shape = (config.num_pages, model.num_kv_heads,
                       config.page_size, head_dim)
-        self._k_pools = [jnp.zeros(pool_shape, dtype)
+        self._k_pools = [self._place_pool(jnp.zeros(pool_shape, dtype))
                          for _ in range(model.depth)]
-        self._v_pools = [jnp.zeros(pool_shape, dtype)
+        self._v_pools = [self._place_pool(jnp.zeros(pool_shape, dtype))
                          for _ in range(model.depth)]
+        if obs.is_enabled():
+            _MESH_SHARDS.set(float(config.mesh_shards or 1))
 
         self.pool = PagePool(config.num_pages)
         self.allocator = BlockAllocator(
@@ -494,6 +564,11 @@ class ServingEngine:
                 pad_tokens = baseline_pad
                 if total:
                     occupancy = total / (total + baseline_pad)
+        if self.mesh is not None and obs.is_enabled():
+            # the mesh engine's only cross-shard cost: the step's
+            # single device sync, where the sharded launch's
+            # replicated logits reassemble on host
+            _COLLECTIVE_MS.observe(self._last_fetch_s * 1e3)
         wall_s = time.perf_counter() - t0
         m = StepMetrics(
             step=self._step,
@@ -598,6 +673,16 @@ class ServingEngine:
             rows[i, : len(req.pages)] = req.pages
         return rows
 
+    def _place_pool(self, arr):
+        """Device placement for one per-layer pool: one KV-head slice
+        per shard on a mesh engine, plain single-device otherwise.
+        Snapshot restore routes reconstructed pools through this too,
+        so a restored mesh engine's pools land sharded again."""
+        arr = jnp.asarray(arr)
+        if self._pool_sharding is None:
+            return arr
+        return jax.device_put(arr, self._pool_sharding)
+
     def _fetch_logits(self, logits_dev) -> np.ndarray:
         """The step loop's ONLY device sync: materialize the launch's
         logits on host.  Isolated in one hook so (a) the async loop can
@@ -620,7 +705,8 @@ class ServingEngine:
         if obs.is_enabled():
             _LAUNCHES.inc(mode="two_call")
         logits, new_caches = _paged_apply(
-            self.model, self.params, jnp.asarray(tokens, jnp.int32), caches
+            self._step_model, self.params,
+            jnp.asarray(tokens, jnp.int32), caches
         )
         for layer, c in enumerate(new_caches):
             self._k_pools[layer] = c.k_pool
@@ -668,7 +754,7 @@ class ServingEngine:
         if obs.is_enabled():
             _LAUNCHES.inc(mode="ragged")
         logits_dev, new_caches = _ragged_apply(
-            self.model, self.params,
+            self._step_model, self.params,
             jnp.asarray(batch.tokens, jnp.int32), caches,
         )
         for layer, c in enumerate(new_caches):

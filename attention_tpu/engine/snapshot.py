@@ -12,7 +12,12 @@ section    contents
 ``meta``   format version, `EngineConfig` fields, model fingerprint
            (vocab/dim/depth/heads/dtype/impl), engine step, seq counter
 ``pools``  raw per-layer K/V page-pool payloads (``tobytes``; dtype and
-           shape recorded in ``meta`` — bf16 round-trips via ml_dtypes)
+           shape recorded in ``meta`` — bf16 round-trips via ml_dtypes).
+           A mesh engine (``mesh_shards`` = N > 1) writes ``pools.0``
+           .. ``pools.N-1`` instead — each shard's contiguous KV-head
+           slice of every pool, independently CRC'd — and the manifest
+           records ``shards: N``; restore reassembles along the head
+           dim and re-places the pools on the reader's mesh
 ``state``  `PagePool` free list (exact order) + refcounts, prefix-cache
            index (keys, pages, parent/children links, LRU stamps),
            allocator counters, scheduler knobs
@@ -72,12 +77,20 @@ from attention_tpu.engine.journal import (
     list_journals,
 )
 from attention_tpu.engine.request import Request, RequestState, SamplingParams
+from attention_tpu.parallel.serving import MeshConfigError
 
 SNAPSHOT_MAGIC = "atp-snapshot"
 SNAPSHOT_VERSION = 1
 SNAPSHOT_SUFFIX = ".atpsnap"
 
-#: manifest section order; every snapshot carries exactly these
+#: manifest section order for a single-device snapshot.  A mesh
+#: engine's snapshot (``EngineConfig.mesh_shards`` = N > 1) replaces
+#: the one ``pools`` section with N ``pools.<s>`` sections — one
+#: contiguous KV-head slice of every per-layer pool per shard, each
+#: with its own CRC — and the manifest records ``shards``: N (absent
+#: or 1 = the single-device layout).  Damage to ONE shard slice is
+#: therefore detected per shard, and a migrating reader reassembles
+#: the logical pools by concatenating the slices along the head dim.
 SECTIONS = ("meta", "pools", "state", "requests")
 
 _SNAP_RE = re.compile(r"^snap-(\d{8})\.atpsnap$")
@@ -232,10 +245,21 @@ def _serialize_sections(engine: ServingEngine) -> list[tuple[str, bytes]]:
         "pool_dtype": _dtype_name(engine._k_pools[0].dtype),
         "pool_shape": list(engine._k_pools[0].shape),
     }
-    pools = b"".join(
-        np.asarray(a).tobytes()
-        for a in (*engine._k_pools, *engine._v_pools)
-    )
+    shards = getattr(engine.config, "mesh_shards", 0) or 1
+    hosted = [np.asarray(a) for a in (*engine._k_pools, *engine._v_pools)]
+    if shards == 1:
+        pool_sections = [("pools", b"".join(a.tobytes() for a in hosted))]
+    else:
+        # one section per head shard, each carrying that shard's
+        # contiguous KV-head slice of every per-layer pool — exactly
+        # the bytes the shard's device holds, CRC'd independently so
+        # single-shard damage is a typed per-shard refusal
+        hh = hosted[0].shape[1] // shards
+        pool_sections = [
+            (f"pools.{s}", b"".join(
+                a[:, s * hh:(s + 1) * hh].tobytes() for a in hosted))
+            for s in range(shards)
+        ]
     alloc = engine.allocator
     sched = engine.scheduler
     state = {
@@ -268,16 +292,26 @@ def _serialize_sections(engine: ServingEngine) -> list[tuple[str, bytes]]:
         [_request_to_dict(r, "waiting") for r in sched.waiting]
         + [_request_to_dict(r, "running") for r in sched.running]
     )
-    return [("meta", _jbytes(meta)), ("pools", pools),
+    return [("meta", _jbytes(meta)), *pool_sections,
             ("state", _jbytes(state)), ("requests", _jbytes(requests))]
+
+
+def _pool_section_names(shards: int) -> tuple[str, ...]:
+    """The pool section names a ``shards``-way snapshot must carry."""
+    if shards == 1:
+        return ("pools",)
+    return tuple(f"pools.{s}" for s in range(shards))
 
 
 def serialize(engine: ServingEngine) -> bytes:
     """Deterministic snapshot bytes (manifest line + section payloads)."""
     sections = _serialize_sections(engine)
+    shards = sum(1 for name, _ in sections
+                 if name == "pools" or name.startswith("pools."))
     manifest = {
         "magic": SNAPSHOT_MAGIC,
         "version": SNAPSHOT_VERSION,
+        "shards": shards,
         "sections": [
             {"name": name, "nbytes": len(payload),
              "crc32": zlib.crc32(payload)}
@@ -386,7 +420,13 @@ def _read_sections(path: str) -> tuple[dict, dict[str, bytes]]:
         offset += nbytes
     if offset != len(blob):
         raise _corrupt(path, f"{len(blob) - offset} trailing bytes")
-    for name in SECTIONS:
+    shards = manifest.get("shards", 1)
+    if not isinstance(shards, int) or isinstance(shards, bool) \
+            or shards < 1:
+        raise _corrupt(path, f"bad shards count {shards!r}")
+    required = ("meta", *_pool_section_names(shards),
+                "state", "requests")
+    for name in required:
         if name not in sections:
             raise _corrupt(path, f"missing section {name!r}")
     return manifest, sections
@@ -420,6 +460,7 @@ def inspect(path: str) -> dict:
     requests = json.loads(sections["requests"])
     out.update({
         "version": manifest["version"],
+        "shards": manifest.get("shards", 1),
         "sections": manifest["sections"],
         "nbytes": os.path.getsize(path),
         "step": meta["step"],
@@ -441,7 +482,7 @@ def restore(path: str, model, params, *,
     """Reconstruct an engine whose subsequent outputs are byte-identical
     to the snapshotted one's.  Raises `SnapshotCorruptError` on any
     validation failure (the caller's cue to fall back cold)."""
-    _, sections = _read_sections(path)
+    manifest, sections = _read_sections(path)
     try:
         meta = json.loads(sections["meta"])
         state = json.loads(sections["state"])
@@ -460,23 +501,53 @@ def restore(path: str, model, params, *,
         if cfg.get("cache_dtype") is not None:
             cfg["cache_dtype"] = _np_dtype(cfg["cache_dtype"])
         config = EngineConfig(**cfg)
-        engine = ServingEngine(model, params, config,
-                               on_token=on_token, on_finish=on_finish,
-                               on_timeout=on_timeout)
+        try:
+            engine = ServingEngine(model, params, config,
+                                   on_token=on_token,
+                                   on_finish=on_finish,
+                                   on_timeout=on_timeout)
+        except MeshConfigError as e:
+            # the snapshot itself is fine — this HOST can't provide
+            # the mesh geometry it was cut on.  Plain SnapshotError
+            # (not ...Corrupt...) so recovery still falls back cold
+            # without counting the file as damaged.
+            raise SnapshotError(
+                f"{path}: snapshot needs mesh geometry this host "
+                f"cannot provide: {e}"
+            )
         dtype = _np_dtype(meta["pool_dtype"])
         shape = tuple(meta["pool_shape"])
+        n_arrays = 2 * model.depth
         nb = int(np.prod(shape)) * dtype.itemsize
-        pools = sections["pools"]
-        if len(pools) != 2 * model.depth * nb:
+        shards = manifest.get("shards", 1)
+        if shape[1] % shards:
             raise _corrupt(
                 path,
-                f"pools section holds {len(pools)} bytes, expected "
-                f"{2 * model.depth * nb}",
+                f"pool head dim {shape[1]} not divisible by "
+                f"{shards} shard section(s)",
             )
+        # each pools.<s> section holds every per-layer array's slice
+        # of 1/shards of the KV heads; reassembly concatenates the
+        # slices back along the head dim (axis 1)
+        slice_nb = nb // shards
+        slice_shape = (shape[0], shape[1] // shards, *shape[2:])
+        parts: list[list[np.ndarray]] = [[] for _ in range(n_arrays)]
+        for name in _pool_section_names(shards):
+            payload = sections[name]
+            if len(payload) != n_arrays * slice_nb:
+                raise _corrupt(
+                    path,
+                    f"section {name!r} holds {len(payload)} bytes, "
+                    f"expected {n_arrays * slice_nb}",
+                )
+            for i in range(n_arrays):
+                parts[i].append(np.frombuffer(
+                    payload[i * slice_nb:(i + 1) * slice_nb],
+                    dtype=dtype).reshape(slice_shape))
         arrays = [
-            jnp.asarray(np.frombuffer(
-                pools[i * nb:(i + 1) * nb], dtype=dtype).reshape(shape))
-            for i in range(2 * model.depth)
+            engine._place_pool(
+                p[0] if shards == 1 else np.concatenate(p, axis=1))
+            for p in parts
         ]
         engine._k_pools = arrays[:model.depth]
         engine._v_pools = arrays[model.depth:]

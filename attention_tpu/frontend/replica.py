@@ -27,6 +27,15 @@ is what keeps deadline translation exact: a replica's engine counts
 steps from ITS OWN birth (warm restore keeps the restored step), so
 the handle converts front-end ticks to local engine steps via
 ``start_tick``.
+
+Mesh-sharded replicas need NOTHING here: ``EngineConfig.mesh_shards``
+rides inside the config this handle already holds, so every replica
+built from it serves through KV-head-sharded kernels, snapshots land
+in the per-shard layout, and warm/cold restart logic is unchanged —
+`recover_engine` reassembles the per-shard pool sections and the cold
+path just builds a fresh mesh engine.  Token streams are identical to
+a single-device replica's by the engine's parity contract, so the
+front end's retry/dedup bookkeeping composes untouched.
 """
 
 from __future__ import annotations

@@ -660,12 +660,6 @@ class GQASelfAttention(nn.Module):
                 f"impl {self.impl!r} has no ragged paged-step path "
                 "(supported: ['flash'])"
             )
-        if self.tp_axis is not None:
-            raise ValueError(
-                "the ragged packed step has no head-sharded form yet; "
-                "serve tensor-parallel engines with "
-                "step_mode='two_call'"
-            )
         if self.rope and self.attn_sinks and self.window is not None:
             raise ValueError(
                 "rope+sinks needs the per-sequence rotated sink read "
@@ -673,6 +667,21 @@ class GQASelfAttention(nn.Module):
                 "not carry; serve such models with "
                 "step_mode='two_call'"
             )
+        if self.tp_axis is not None:
+            # head-sharded single-launch step: append + ragged
+            # attention run per KV-head shard inside one shard_map
+            # (pools and new rows shard, host-packed indices
+            # replicate) — the mesh serving engine's ragged lowering
+            from attention_tpu.parallel.serving import (
+                head_sharded_ragged_step,
+            )
+
+            out, cache = head_sharded_ragged_step(
+                q, cache, k, v, mesh=self.mesh, axis_name=self.tp_axis,
+                softcap=self.softcap, window=self.window,
+                sinks=self.attn_sinks or None,
+            )
+            return out.astype(q.dtype), cache
         cache = ragged_paged_append(cache, k, v)
         out = ragged_paged_attention(
             q, cache, softcap=self.softcap, window=self.window,

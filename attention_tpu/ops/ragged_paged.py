@@ -35,6 +35,16 @@ cliff property the two fixed shapes bought, kept.
 (shapes are static under jit, values are not) so the engine can pick
 the tile per step without threading a static argument through
 ``model.apply``.
+
+Mesh sharding: both `ragged_paged_append` and `ragged_paged_attention`
+are per-KV-head independent — no cross-head reduction anywhere — so
+`parallel.serving.head_sharded_ragged_step` runs them inside one
+``shard_map`` with the pools and new K/V rows split on the head axis
+and every host-packed index array (page table, ``cu_q_lens``,
+``kv_lens``, ``distribution``, token placement) replicated verbatim.
+Each shard executes this SAME kernel on its contiguous head slice;
+zero collectives, and the packed-token axis (and therefore the pad
+economics above) is untouched by the shard count.
 """
 
 from __future__ import annotations

@@ -14,10 +14,12 @@ from attention_tpu.parallel.ring import (  # noqa: F401
     ring_attention_diff,
 )
 from attention_tpu.parallel.serving import (  # noqa: F401
+    MeshConfigError,
     cache_sharded_decode,
     head_sharded_decode,
     head_sharded_decode_paged,
     head_sharded_decode_quantized,
     head_sharded_prefill,
+    head_sharded_ragged_step,
 )
 from attention_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -23,6 +23,11 @@ the way the batch path does:
   * :func:`head_sharded_prefill` — the batch flash kernel (cached
     prefill / chunked append) under the same head sharding, so a
     ``tp_axis`` model's whole generate loop stays sharded.
+  * :func:`head_sharded_ragged_step` — the serving engine's packed
+    single-launch step (`ops.ragged_paged` append + attention) under
+    the same head sharding: pools and new K/V rows shard by KV head,
+    every host-packed index array replicates, both halves run inside
+    one shard_map — ``EngineConfig.mesh_shards`` lowers onto this.
 
 Both are `shard_map`s over a 1D mesh axis and compose with an outer
 batch/data-parallel axis via pjit.
@@ -43,6 +48,19 @@ from attention_tpu.parallel.kv_sharded import merge_partials
 from attention_tpu.parallel.mesh import default_mesh, shard_map
 
 
+class MeshConfigError(ValueError):
+    """A sharded serving call's geometry cannot split over the mesh.
+
+    Raised at CALL time when the KV-head count does not divide by the
+    mesh-axis size (an uneven split would silently mis-slice the
+    contiguous head chunk GQA groups depend on), or by the serving
+    engine when ``EngineConfig.mesh_shards`` asks for more devices
+    than the runtime exposes.  Subclasses ValueError so existing
+    argument-validation callers keep working; typed so mesh-serving
+    callers can distinguish "fix your shard count" from a kernel
+    bug."""
+
+
 def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
                        operand_specs):
     """Shared tensor-parallel scaffold for every cache type: validate
@@ -55,7 +73,9 @@ def _head_sharded_call(q, hkv, mesh, axis_name, kernel, operands,
         mesh = default_mesh(axis_name)
     n_dev = mesh.shape[axis_name]
     if hkv % n_dev:
-        raise ValueError(f"kv heads {hkv} not divisible by mesh size {n_dev}")
+        raise MeshConfigError(
+            f"kv heads {hkv} not divisible by mesh size {n_dev}"
+        )
     # q is (B, H, d) for decode, (B, H, S, d) for prefill — heads at dim 1
     q_spec = P(None, axis_name, *([None] * (q.ndim - 2)))
 
@@ -248,6 +268,84 @@ def head_sharded_decode_paged(
         q, cache.k_pool.shape[1], mesh, axis_name, kernel,
         (cache,), (cache_specs,),
     )
+
+
+def head_sharded_ragged_step(
+    q: jax.Array,      # (1, Hq, T, d) packed token axis
+    cache,             # ops.ragged_paged.RaggedPagedStep
+    k_new: jax.Array,  # (1, Hkv, T, d) this step's new K rows
+    v_new: jax.Array,  # (1, Hkv, T, d)
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "tp",
+    softcap: float | None = None,
+    window: int | None = None,
+    sinks: int | None = None,
+):
+    """The packed serving step (append + ragged attention) with KV
+    heads sharded over ``axis_name`` — the engine's single-launch
+    lowering made tensor-parallel.
+
+    Both halves of the step run INSIDE one shard_map so the pool
+    scatter and the attention read stay a single per-shard program:
+    the physical pools (P, Hkv, page_size, d) and this step's new K/V
+    rows shard along their KV-head dim, while every host-packed index
+    array — page tables, ``kv_lens``, ``cu_q_lens``, the decode/
+    prefill ``distribution``, per-token position/slot, the ``q_span``
+    tile marker — replicates (page ids and packing are head-agnostic).
+    Contiguous head chunks keep GQA groups aligned per shard (the
+    `head_sharded_decode` layout), so each device appends to and
+    scores only its own head slice: zero collectives per step.  The
+    post-append ``kv_lens`` is recomputed identically on every shard
+    from replicated inputs, so the returned cache's replicated
+    out-spec is exact, not approximate.
+
+    Returns ``(out, cache)`` exactly like the single-device
+    ``ragged_paged_append`` + ``ragged_paged_attention`` pair.
+    """
+    from attention_tpu.ops.ragged_paged import (
+        RaggedPagedStep,
+        ragged_paged_append,
+        ragged_paged_attention,
+    )
+
+    if mesh is None:
+        mesh = default_mesh(axis_name)
+    n_dev = mesh.shape[axis_name]
+    hkv = cache.k_pool.shape[1]
+    if hkv % n_dev:
+        raise MeshConfigError(
+            f"kv heads {hkv} not divisible by mesh size {n_dev}"
+        )
+    if q.shape[1] % n_dev:
+        raise MeshConfigError(
+            f"q heads {q.shape[1]} not divisible by mesh size {n_dev}"
+        )
+    head_spec = P(None, axis_name, None, None)
+    rep1 = P(None)
+    cache_specs = RaggedPagedStep(
+        k_pool=head_spec, v_pool=head_spec,
+        page_table=P(None, None), kv_lens=rep1, cu_q_lens=rep1,
+        distribution=rep1, token_pos=rep1, token_slot=rep1,
+        q_span=rep1,
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(head_spec, cache_specs, head_spec, head_spec),
+        out_specs=(head_spec, cache_specs),
+    )
+    def run(q_local, cache_local, k_local, v_local):
+        cache_local = ragged_paged_append(cache_local, k_local, v_local)
+        out = ragged_paged_attention(
+            q_local, cache_local, softcap=softcap, window=window,
+            sinks=sinks,
+        )
+        return out, cache_local
+
+    return run(q, cache, k_new, v_new)
 
 
 @functools.partial(

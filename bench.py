@@ -35,6 +35,7 @@ request trace, with per-step scheduler metrics in ``detail``.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import math
 import os
@@ -608,6 +609,44 @@ def _bench_engine(args) -> dict:
     # fold the engine aggregate into the telemetry registry too
     # (to_run_record routes through obs.record_run; no-op when disabled)
     engine.metrics.to_run_record(config="bench-engine")
+
+    def _mean_sync_ms(metrics):
+        # per-step device time: the step's single blocking fetch (mesh
+        # engines reassemble replicated logits inside it), wall minus
+        # host-side packing — busy steps only, idle steps never launch
+        syncs = [(m.wall_s - m.host_overhead_s) * 1e3
+                 for m in metrics.steps
+                 if m.num_decode_reqs or m.num_prefill_reqs]
+        return sum(syncs) / len(syncs) if syncs else 0.0
+
+    mesh_detail = None
+    if args.mesh_shards:
+        # same trace through a KV-head-sharded engine: report per-shard
+        # kernel time and the collective overhead vs the single-device
+        # run above (identical schedule, so the delta is the mesh cost)
+        mesh_config = dataclasses.replace(config,
+                                          mesh_shards=args.mesh_shards)
+        replay(ServingEngine(model, params, mesh_config), trace[:2])
+        mesh_engine = ServingEngine(model, params, mesh_config)
+        t0 = _time.perf_counter()
+        _mesh_summary, mesh_outputs = replay(mesh_engine, trace)
+        mesh_s = _time.perf_counter() - t0
+        single_sync_ms = _mean_sync_ms(engine.metrics)
+        mesh_sync_ms = _mean_sync_ms(mesh_engine.metrics)
+        mesh_detail = {
+            "shards": args.mesh_shards,
+            "mesh_tokens_per_s": round(
+                sum(len(v) for v in mesh_outputs.values()) / mesh_s, 2),
+            "per_shard_kernel_ms": round(
+                mesh_sync_ms / args.mesh_shards, 4),
+            "single_device_kernel_ms": round(single_sync_ms, 4),
+            "collective_overhead_ms": round(
+                mesh_sync_ms - single_sync_ms, 4),
+            # the tentpole contract, checked right here in the bench:
+            # sharding must never change a token
+            "tokens_match_single_device": mesh_outputs == outputs,
+        }
+
     return {
         "metric": "engine continuous-batching decode throughput vs "
         "sequential generate_paged (same model, same requests, CPU/TPU "
@@ -632,6 +671,7 @@ def _bench_engine(args) -> dict:
             "mean_host_overhead_ms": summary.get(
                 "mean_host_overhead_ms", 0.0),
             "summary": summary,
+            "mesh": mesh_detail,
             "per_step": [m.to_dict() for m in engine.metrics.steps],
         },
     }
@@ -651,6 +691,14 @@ def main(argv=None) -> int:
     p.add_argument("--engine-prompt", type=int, default=96,
                    help="max prompt body length (engine arm)")
     p.add_argument("--engine-dim", type=int, default=64)
+    p.add_argument(
+        "--mesh-shards", type=int, default=0,
+        help="engine arm: ALSO run the trace through a KV-head-sharded "
+        "mesh engine (EngineConfig.mesh_shards=N) and report per-shard "
+        "kernel ms + collective overhead vs the single-device run "
+        "(needs >= N local devices; on CPU set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N)",
+    )
     p.add_argument("--seq", type=int, default=32768)
     p.add_argument("--dim", type=int, default=128)
     p.add_argument(
