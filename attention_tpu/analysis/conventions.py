@@ -157,17 +157,24 @@ def legacy_obs_check_file(path: str) -> list[str]:
 
 # -- ATP502: shipped tuning table -----------------------------------------
 
-# which tile fields each family's lookup adapter actually reads
+# which entry fields each family's lookup adapter actually reads; the
+# forward/decode/ragged adapters also honor a measured "max_mode"
+# rescaling-math variant (ops.flash._tuned_max_mode) — the backward
+# families recompute through the forward's own dispatch and carry none
 FAMILY_FIELDS = {
-    "flash_fwd": {"block_q", "block_k"},
+    "flash_fwd": {"block_q", "block_k", "max_mode"},
     "flash_bwd": {"block_q", "block_k"},
     "flash_bwd_fused": {"block_q", "block_k"},
-    "decode": {"block_k"},
+    "decode": {"block_k", "max_mode"},
     "paged": {"page_size"},
-    "ragged": {"block_q"},
+    "ragged": {"block_q", "max_mode"},
 }
 
 META_FIELDS = {"ms", "source", "recorded"}
+
+# fields a family MAY carry but need not (an entry without max_mode
+# reads as "no measured opinion": the kernel keeps its call default)
+OPTIONAL_FIELDS = {"max_mode"}
 
 
 def _load_no_duplicates(path: str):
@@ -214,7 +221,8 @@ def shipped_table_problems(path: str) -> list[str]:
             continue
         allowed = FAMILY_FIELDS[fields["kernel"]] | META_FIELDS
         extra = set(entry) - allowed
-        missing = FAMILY_FIELDS[fields["kernel"]] - set(entry)
+        missing = (FAMILY_FIELDS[fields["kernel"]] - OPTIONAL_FIELDS
+                   - set(entry))
         if extra:
             problems.append(f"{key}: unknown fields {sorted(extra)}")
         if missing:

@@ -41,6 +41,18 @@ FAMILY_BUDGETS: dict[str, float] = {
                              # the contract (it is contract-grade)
     "int4": 0.25,            # full-band worst case (~0.20 measured)
     "int4_short": 0.35,      # windowed / short-band (~0.29 measured)
+    "flashd": CONTRACT_TOL,  # FLASH-D rescaling variant: same fp32
+                             # softmax math reassociated (the division
+                             # moves into the tile update), measured
+                             # ~5e-7 fp32 / ~8e-3 bf16 vs online —
+                             # held to the contract across every
+                             # max_mode-threading family
+    "amla": CONTRACT_TOL,    # AMLA rescaling variant: pow2 rescales
+                             # are BIT-EXACT (exponent-field adds);
+                             # only the quantized max shifts which
+                             # exp2 rounding each term sees — measured
+                             # at online's own error scale, held to
+                             # the contract likewise
 }
 
 #: minimum attended-band width (KV rows) for int4's full-band budget
@@ -48,13 +60,21 @@ INT4_FULL_BAND = 64
 
 
 def tolerance_for(family: str, *, window: int | None = None,
-                  min_band: int | None = None) -> float:
+                  min_band: int | None = None,
+                  max_mode: str | None = None) -> float:
     """The ledger's budget for one sampled config.
 
     ``min_band`` is the narrowest softmax band any query in the case
     attends (min over sequences of ``min(length, window)``); int4's
-    budget widens below :data:`INT4_FULL_BAND` rows.
+    budget widens below :data:`INT4_FULL_BAND` rows.  ``max_mode``
+    names the rescaling-math variant the case lowers: the flashd/amla
+    variants carry their OWN ledger rows (one budget per variant,
+    whichever family threads it — the variant changes the in-kernel
+    recurrence, not the family's masking), while online/bound keep the
+    family's row (bound is bit-identical softmax by max-invariance).
     """
+    if max_mode in ("flashd", "amla"):
+        return FAMILY_BUDGETS[max_mode]
     if family == "int4" and (
         window is not None
         or (min_band is not None and min_band < INT4_FULL_BAND)

@@ -32,6 +32,15 @@ FAMILIES = ("flash", "decode", "paged", "ragged", "int8", "int4")
 #: the paged kernels' page granule (ops.paged)
 PAGE_SIZE = 128
 
+#: families that thread a ``max_mode`` rescaling-math variant to their
+#: kernel, and the variants each can lower ("bound" is forward-only;
+#: the quantized/paged decode kernels take no max_mode at all)
+MAX_MODE_FAMILIES: dict[str, tuple[str, ...]] = {
+    "flash": ("online", "bound", "flashd", "amla"),
+    "decode": ("online", "flashd", "amla"),
+    "ragged": ("online", "flashd", "amla"),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class FuzzConfig:
@@ -54,11 +63,18 @@ class FuzzConfig:
     sinks: int | None = None
     softcap: float | None = None
     ragged: bool = False            # decode families: varied lengths
+    max_mode: str = "online"        # rescaling-math variant (ops kernels)
     seed: int = 0
 
     def validate(self) -> None:
         if self.family not in FAMILIES:
             raise ValueError(f"unknown family {self.family!r}")
+        if self.max_mode != "online" and self.max_mode not in \
+                MAX_MODE_FAMILIES.get(self.family, ()):
+            raise ValueError(
+                f"family {self.family!r} cannot lower max_mode "
+                f"{self.max_mode!r}"
+            )
         if self.heads % self.kv_heads:
             raise ValueError(
                 f"heads {self.heads} not a multiple of kv_heads "
@@ -89,6 +105,7 @@ class FuzzConfig:
             and self.window is None
             and self.sinks is None
             and self.softcap is None
+            and self.max_mode == "online"
         )
 
     def to_json(self) -> str:
@@ -124,13 +141,20 @@ _DTYPES = ("float32", "bfloat16")
 
 
 def sample_config(rng: np.random.Generator, *,
-                  families: Sequence[str] = FAMILIES) -> FuzzConfig:
+                  families: Sequence[str] = FAMILIES,
+                  max_mode: str = "online") -> FuzzConfig:
     """Draw one config.  Consumes a deterministic number of rng draws
-    per family, so a campaign is reproducible from its seed alone."""
+    per family, so a campaign is reproducible from its seed alone.
+    ``max_mode`` pins the rescaling-math variant for families that can
+    lower it (the per-variant oracle campaigns); families that cannot
+    keep the online default — the draw sequence is unchanged either
+    way, so the same seed samples the same shapes per variant."""
     family = _choice(rng, list(families))
     heads, kv_heads = _choice(rng, _HEAD_GRID)
     softcap = _choice(rng, _SOFTCAP)
     seed = int(rng.integers(2**31 - 1))
+    mm = (max_mode if max_mode in MAX_MODE_FAMILIES.get(family, ())
+          else "online")
 
     if family == "flash":
         m = n = _choice(rng, _FLASH_MN)
@@ -142,7 +166,7 @@ def sample_config(rng: np.random.Generator, *,
         return FuzzConfig(family=family, m=m, n=n, heads=heads,
                           kv_heads=kv_heads, head_dim=d, dtype=dtype,
                           causal=causal, window=window, sinks=sinks,
-                          softcap=softcap, seed=seed)
+                          softcap=softcap, max_mode=mm, seed=seed)
 
     batch = int(rng.integers(1, 3))
     n = _choice(rng, _CACHE_N)
@@ -158,18 +182,19 @@ def sample_config(rng: np.random.Generator, *,
     return FuzzConfig(family=family, m=batch, n=n, heads=heads,
                       kv_heads=kv_heads, head_dim=d, dtype=dtype,
                       window=window, sinks=sinks, softcap=softcap,
-                      ragged=ragged, seed=seed)
+                      ragged=ragged, max_mode=mm, seed=seed)
 
 
 def sample_campaign(seed: int, cases: int, *,
-                    families: Sequence[str] = FAMILIES
+                    families: Sequence[str] = FAMILIES,
+                    max_mode: str = "online"
                     ) -> list[FuzzConfig]:
     """The deterministic case list for one fuzz campaign: same seed →
     byte-identical configs, independent of which cases later fail."""
     rng = np.random.default_rng(seed)
     out = []
     for _ in range(cases):
-        cfg = sample_config(rng, families=families)
+        cfg = sample_config(rng, families=families, max_mode=max_mode)
         cfg.validate()
         out.append(cfg)
     return out

@@ -270,6 +270,7 @@ def _run_ragged(config: FuzzConfig, q, k, v, lengths, dt) -> np.ndarray:
     out = ragged_paged_attention(
         jnp.asarray(qp, dt), cache, softcap=config.softcap,
         window=config.window, sinks=config.sinks,
+        max_mode=config.max_mode,
     )
     return np.asarray(out, np.float64)[0, :, :total]
 
@@ -286,7 +287,7 @@ def _run_kernel(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
 
         out = flash_attention(
             jnp.asarray(q, dt), jnp.asarray(k, dt), jnp.asarray(v, dt),
-            causal=config.causal, **kw,
+            causal=config.causal, max_mode=config.max_mode, **kw,
         )
         return np.asarray(out, np.float64)
 
@@ -298,7 +299,8 @@ def _run_kernel(config: FuzzConfig, q, k, v, lengths) -> np.ndarray:
         from attention_tpu.ops.decode import flash_decode
 
         out = flash_decode(jnp.asarray(q, dt), jnp.asarray(k, dt),
-                           jnp.asarray(v, dt), lens, **kw)
+                           jnp.asarray(v, dt), lens,
+                           max_mode=config.max_mode, **kw)
     elif config.family == "paged":
         from attention_tpu.ops.paged import PagePool, paged_from_dense, \
             paged_flash_decode
@@ -355,7 +357,7 @@ def run_case(config: FuzzConfig, *,
         want = _decode_oracle(config, qr, kr, vr, lengths)
         min_band = int(np.min(lengths))
     tol = tolerance_for(config.family, window=config.window,
-                        min_band=min_band)
+                        min_band=min_band, max_mode=config.max_mode)
     stats = verify_scan(want, got, threshold=tol)
     result = CaseResult(
         config=config, ok=stats.ok, tolerance=tol,
@@ -394,14 +396,18 @@ class CampaignReport:
 
 def run_campaign(seed: int, cases: int, *,
                  families: Sequence[str] = FAMILIES,
+                 max_mode: str = "online",
                  defect: Callable[[np.ndarray], np.ndarray] | None = None,
                  log: Callable[[str], None] | None = None
                  ) -> CampaignReport:
     """Sample and run ``cases`` configs; fully deterministic in
-    ``seed`` (the case list is fixed before any case runs)."""
+    ``seed`` (the case list is fixed before any case runs).
+    ``max_mode`` pins the rescaling-math variant on families that can
+    lower it (the per-variant oracle campaigns)."""
     results = []
     for i, config in enumerate(sample_campaign(seed, cases,
-                                               families=families)):
+                                               families=families,
+                                               max_mode=max_mode)):
         r = run_case(config, defect=defect)
         if log is not None:
             log(f"case {i}: {config.family} "

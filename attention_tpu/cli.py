@@ -689,6 +689,7 @@ def _cmd_tune(args: argparse.Namespace) -> int:
                 kv_heads=args.kv_heads, batch=args.batch,
                 dtype=args.dtype, causal=args.causal,
                 window=args.window, sinks=args.sinks, stats=args.stats,
+                max_mode=args.max_mode,
                 repeats=args.repeats, cache_path=args.cache,
                 write=not args.dry_run,
                 log=_logger.info,
@@ -730,6 +731,7 @@ def _cmd_chaos_fuzz(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
     report = run_campaign(args.seed, args.cases, families=families,
+                          max_mode=args.max_mode,
                           defect=_chaos_defect(args), log=_logger.info)
     if args.repro_dir and report.failures:
         import os
@@ -1227,6 +1229,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="tune the partials (stats-emitting) forward")
     tn.add_argument("--window", type=int, default=None)
     tn.add_argument("--sinks", type=int, default=None)
+    tn.add_argument("--max-mode", default="bound",
+                    choices=["online", "bound", "flashd", "amla", "auto"],
+                    help="rescaling-math variant to measure; 'auto' "
+                         "races every variant the family can lower and "
+                         "records the winner in the cache entry")
     tn.add_argument("--repeats", type=int, default=3,
                     help="median-of-k timing repeats per candidate")
     tn.add_argument("--cache", default=None,
@@ -1252,6 +1259,11 @@ def main(argv: list[str] | None = None) -> int:
     cf.add_argument("--families", default=None,
                     help="comma-separated subset of "
                          "flash,decode,paged,int8,int4 (default: all)")
+    cf.add_argument("--max-mode", default="online",
+                    choices=["online", "bound", "flashd", "amla"],
+                    help="pin the rescaling-math variant for families "
+                         "that can lower it (per-variant oracle "
+                         "campaigns; others keep online)")
     cf.add_argument("--inject-failure", action="store_true",
                     help="apply the synthetic defect to every kernel "
                          "output (pipeline self-test: forces failures)")

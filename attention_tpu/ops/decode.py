@@ -50,13 +50,41 @@ from attention_tpu.ops.flash import (
     _compiler_params,
     _flash_tile,
     _should_interpret,
+    _tuned_max_mode,
     check_softcap,
 )
 
 # Op-dispatch telemetry (attention_tpu.obs, off by default): one tick
 # per host-side dispatch; calls inside an enclosing jit tick per trace.
+# `ops.decode.lowered` ticks at TRACE time inside the jitted bodies and
+# records which rescaling-math variant each dispatch actually lowered
+# (the decode analog of `ops.flash.lowered`).
 _DECODE_CALLS = obs.counter(
     "ops.decode.calls", "flash_decode dispatches by cache shape bucket")
+_DECODE_LOWERED = obs.counter(
+    "ops.decode.lowered",
+    "decode kernel lowerings by requested/resolved max mode")
+
+#: max_mode values the decode kernels accept — "bound" is forward-only
+#: (it needs the key-norm prefetch the decode grid does not carry).
+DECODE_MAX_MODES = ("online", "flashd", "amla", "auto")
+
+
+def _resolve_decode_max_mode(max_mode: str, *, batch, h, hkv, n, d,
+                             dtype, window, sinks) -> str:
+    """Validate and statically resolve a decode-side ``max_mode``:
+    "auto" consults the tuning tables (decode family key), anything the
+    table cannot legally pick falls back to the online oracle."""
+    if max_mode not in DECODE_MAX_MODES:
+        raise ValueError(
+            f"unknown decode max_mode {max_mode!r}; one of "
+            f"{DECODE_MAX_MODES} (bound mode is forward-only)")
+    if max_mode != "auto":
+        return max_mode
+    return _tuned_max_mode(
+        "decode", dtype=dtype, allowed=("online", "flashd", "amla"),
+        heads=h, kv_heads=hkv, seq=n, dim=d, batch=batch,
+        window=window, sinks=sinks)
 
 
 def _decode_kernel(
@@ -64,6 +92,7 @@ def _decode_kernel(
     *, hkv: int, block_k: int, block_q: int, n: int,
     softcap2: float | None = None, window: int | None = None,
     sinks: int | None = None, chunk: int | None = None,
+    variant: str = "online",
 ):
     """One (batch*kv-head, kv-block) grid step of cached decode.
 
@@ -112,6 +141,7 @@ def _decode_kernel(
                 kv_idx=j, q_idx=0,
                 n_true=n, block_k=block_k, causal=False, block_q=block_q,
                 softcap2=softcap2, kv_min=kv_min, sinks=sinks,
+                variant=variant,
             )
         else:
             _flash_tile(
@@ -120,16 +150,20 @@ def _decode_kernel(
                 kv_idx=j, q_idx=0,
                 n_true=n, block_k=block_k, causal=True, block_q=block_q,
                 softcap2=softcap2, window=window, sinks=sinks,
-                pos_mod=chunk,
+                pos_mod=chunk, variant=variant,
             )
 
     @pl.when(j == num_j - 1)
     def _finalize():
-        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
-        # empty-cache guard, the reference's 1/gsum div-by-zero guard
-        # (attention-mpi.c:358-362)
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        if variant == "flashd":
+            # the accumulator is already normalized — no epilogue divide
+            o_ref[0] = acc_scr[...].astype(o_ref.dtype)
+        else:
+            l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+            # empty-cache guard, the reference's 1/gsum div-by-zero
+            # guard (attention-mpi.c:358-362)
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
 def check_band(window, sinks) -> None:
@@ -225,7 +259,7 @@ def _default_block_k(batch: int, h: int, hkv: int, n: int, d: int,
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_k", "interpret", "softcap", "window",
-                     "sinks"),
+                     "sinks", "max_mode"),
 )
 def _flash_decode_jit(
     q: jax.Array,        # (B, H, d)
@@ -239,6 +273,7 @@ def _flash_decode_jit(
     softcap: float | None = None,
     window: int | None = None,
     sinks: int | None = None,
+    max_mode: str = "online",
 ) -> jax.Array:
     """softmax(q K[:len]^T * scale) V[:len] per sequence -> (B, H, dv).
 
@@ -246,7 +281,10 @@ def _flash_decode_jit(
     ``window`` attends only the last ``window`` valid rows per sequence
     (sliding-window serving on a dense/ragged cache — each query sits at
     its sequence's position ``len-1``); ``sinks`` additionally pins the
-    first ``sinks`` rows (StreamingLLM), requires ``window``."""
+    first ``sinks`` rows (StreamingLLM), requires ``window``.
+    ``max_mode`` picks the rescaling math ("online"/"flashd"/"amla",
+    same softmax — see `flash_attention`); "auto" consults the tuning
+    tables and falls back to "online"."""
     check_softcap(softcap)
     check_band(window, sinks)
     if q.ndim != 3 or k_cache.ndim != 4 or v_cache.ndim != 4:
@@ -283,6 +321,12 @@ def _flash_decode_jit(
     if block_k is None:
         block_k = _default_block_k(b, h, hkv, n, d, q.dtype, window, sinks)
     block_k = _pick_block_k(n, block_k)
+    variant = _resolve_decode_max_mode(
+        max_mode, batch=b, h=h, hkv=hkv, n=n, d=d, dtype=q.dtype,
+        window=window, sinks=sinks)
+    if obs.is_enabled():
+        _DECODE_LOWERED.inc(requested=max_mode, lowered=variant,
+                            entry="decode")
     kc = k_cache.reshape(b * hkv, n, d)
     vc = v_cache.reshape(b * hkv, n, dv)
 
@@ -313,7 +357,7 @@ def _flash_decode_jit(
             _decode_kernel, hkv=hkv, block_k=block_k, block_q=group_pad,
             n=n,
             softcap2=None if softcap is None else softcap * _LOG2E,
-            window=window, sinks=sinks,
+            window=window, sinks=sinks, variant=variant,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, group_pad, dv), v_cache.dtype),
@@ -345,7 +389,7 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_k", "interpret", "softcap", "window",
-                     "sinks"),
+                     "sinks", "max_mode"),
 )
 def _flash_decode_chunk_jit(
     q: jax.Array,          # (B, H, S, d) — S new tokens per sequence
@@ -359,6 +403,7 @@ def _flash_decode_chunk_jit(
     softcap: float | None = None,
     window: int | None = None,
     sinks: int | None = None,
+    max_mode: str = "online",
 ) -> jax.Array:
     """Score S appended tokens per sequence in ONE cache stream
     -> (B, H, S, dv).
@@ -409,6 +454,12 @@ def _flash_decode_chunk_jit(
     if block_k is None:
         block_k = _default_block_k(b, h, hkv, n, d, q.dtype, window, sinks)
     block_k = _pick_block_k(n, block_k)
+    variant = _resolve_decode_max_mode(
+        max_mode, batch=b, h=h, hkv=hkv, n=n, d=d, dtype=q.dtype,
+        window=window, sinks=sinks)
+    if obs.is_enabled():
+        _DECODE_LOWERED.inc(requested=max_mode, lowered=variant,
+                            entry="chunk")
     kc = k_cache.reshape(b * hkv, n, d)
     vc = v_cache.reshape(b * hkv, n, dv)
     w_eff = None if window is None else window + s_chunk - 1
@@ -440,7 +491,7 @@ def _flash_decode_chunk_jit(
             _decode_kernel, hkv=hkv, block_k=block_k, block_q=rows_pad,
             n=n,
             softcap2=None if softcap is None else softcap * _LOG2E,
-            window=window, sinks=sinks, chunk=s_chunk,
+            window=window, sinks=sinks, chunk=s_chunk, variant=variant,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * hkv, rows_pad, dv),

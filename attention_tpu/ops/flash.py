@@ -247,6 +247,31 @@ def _tuned_flash_tiles(heads, m, d, *, window, returns_stats, causal,
     return bq, bk
 
 
+def _tuned_max_mode(kernel: str, *, dtype=None, default: str = "online",
+                    allowed=None, **kf_kwargs) -> str:
+    """Tuning-table rescaling-math pick for ``max_mode="auto"`` calls,
+    or ``default`` on a miss/invalid entry.
+
+    Shared by the flash forward, decode, and ragged dispatchers: each
+    passes its own family name plus `key_fields` kwargs (and its own
+    ``allowed`` set — the decode-side kernels cannot lower "bound",
+    which needs the forward kernel's key-norm prefetch).  The fallback
+    is the online oracle — NOT bound — so an empty-cache CPU run of an
+    "auto" call lowers exactly the kernel the plain default would.
+    """
+    try:
+        from attention_tpu.tuning.lookup import key_fields, lookup
+
+        entry = lookup(kernel, dtype=dtype,
+                       **key_fields(kernel, **kf_kwargs))
+    except Exception:  # noqa: BLE001 - tuning must never break dispatch
+        return default
+    if entry is None:
+        return default
+    mode = entry.get("max_mode")
+    return mode if mode in (allowed or MAX_MODES) else default
+
+
 def _vmem_limit_supported() -> bool:
     """Whether this pallas accepts ``vmem_limit_bytes`` — the big-tile
     forward default and the fused backward both NEED the raised budget;
@@ -302,7 +327,7 @@ def _flash_kernel(
     softcap2: float | None = None,
     sinks: int | None = None,
     sink_blocks: int = 0,
-    bound_mode: bool = False,
+    variant: str = "online",
 ):
     """One (head, q-block, kv-block) grid step of online-softmax attention.
 
@@ -314,17 +339,32 @@ def _flash_kernel(
     shard includes padding from an indivisible global sequence).
     ``window`` (static) keeps only the last ``window`` positions per row
     (sliding-window attention; requires causal).
-    ``bound_mode`` (the VFA idea, PAPERS.md: global-max precompute)
-    replaces the online max recurrence with a per-row upper bound on the
-    scores, computed in-kernel at the first KV step from the resident Q
-    block and the prefetched per-KV-head max key norm (``knmax_ref``,
-    Cauchy-Schwarz: |q·k| <= ||q||·max||k||): softmax is invariant to
-    which max is subtracted, so using a bound instead of the true
-    running max gives the same normalized output and lse while deleting
-    the row-max reduce, the corr exp2, the accumulator rescale and the
-    m-scratch traffic from the serial VPU chain.  ``l`` then accumulates
-    per-lane and reduces once at finalize.  The m scratch holds the
-    bound (written once, read per tile) instead of the running max.
+    ``variant`` picks the rescaling math (all variants compute the same
+    softmax; they differ in which per-tile VPU work the recurrence
+    carries — see `_softmax_variant_update`):
+
+      * ``"online"`` — the classic running rmax/rsum recurrence.
+      * ``"bound"`` (the VFA idea, PAPERS.md: global-max precompute) —
+        replaces the online max recurrence with a per-row upper bound on
+        the scores, computed in-kernel at the first KV step from the
+        resident Q block and the prefetched per-KV-head max key norm
+        (``knmax_ref``, Cauchy-Schwarz: |q·k| <= ||q||·max||k||):
+        softmax is invariant to which max is subtracted, so using a
+        bound instead of the true running max gives the same normalized
+        output and lse while deleting the row-max reduce, the corr exp2,
+        the accumulator rescale and the m-scratch traffic from the
+        serial VPU chain.  ``l`` then accumulates per-lane and reduces
+        once at finalize.  The m scratch holds the bound (written once,
+        read per tile) instead of the running max.
+      * ``"flashd"`` (FLASH-D, PAPERS.md) — keeps the accumulator
+        NORMALIZED throughout: the division is folded into the tile
+        update, the m scratch carries the running log-sum-exp, and the
+        finalize has no ``l``-division epilogue.
+      * ``"amla"`` (AMLA, PAPERS.md) — quantizes the running max to
+        integers so every rescale factor is a power of two, applied as
+        an integer add on the fp32 exponent field instead of a
+        multiply.
+
     ``rest`` = ([q_seg, kv_seg,] o_ref, m_out, l_out, acc, m, l).
     """
     if segmented:
@@ -362,7 +402,7 @@ def _flash_kernel(
 
     @pl.when(jb == 0)
     def _init():
-        if bound_mode:
+        if variant == "bound":
             # Cauchy-Schwarz bound from the resident (pre-scaled) Q
             # block and this head's prefetched max key norm; softcap
             # tightens it (|cap·tanh(s/cap)| <= min(|s|, cap)).
@@ -411,7 +451,7 @@ def _flash_kernel(
         block_q=block_q,
         q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
         softcap2=softcap2,
-        bound_mode=bound_mode,
+        variant=variant,
     )
     # Round-5 measured NEGATIVE result: splitting the body into an
     # interior fast path (mask chain statically compiled out for tiles
@@ -431,25 +471,32 @@ def _flash_kernel(
     @pl.when(jb == pl.num_programs(2) - 1)
     def _finalize():
         acc = acc_scr[...]
-        if bound_mode:
+        if variant == "bound":
             # l accumulated per lane: one cross-lane reduce, here only
             l = jnp.sum(l_scr[...], axis=-1, keepdims=True)
         else:
             l = jnp.max(l_scr[...], axis=-1, keepdims=True)
-        if normalize:
+        if normalize and variant != "flashd":
             # 1/gsum normalization with the divide-by-zero guard the
             # reference applies (attention-mpi.c:358-362).
             l_safe = jnp.where(l == 0.0, 1.0, l)
             o_ref[0] = (acc / l_safe).astype(out_dtype)
         else:
+            # flashd carries the accumulator normalized — the division
+            # already happened inside the tile updates, so the epilogue
+            # is a plain cast either way.
             o_ref[0] = acc.astype(out_dtype)
         if m_out_ref is not None:
             # Stats leave the kernel in the natural-log domain (the
             # distributed pmax/psum merge computes exp(lmax - gmax)).
             # In bound mode m_scr holds the bound — any value >= the
-            # true row max yields the same merge and lse.
+            # true row max yields the same merge and lse; in flashd it
+            # holds the running log-sum-exp with l == 1 (the merge
+            # identity sum_i out_i*exp(lse_i-gmax) / sum_i exp(lse_i-
+            # gmax) is the standard two-phase combine); in amla the
+            # integer-quantized max — still the actually-subtracted max.
             m_out_ref[0] = m_scr[...] * _LN2
-            if bound_mode:
+            if variant == "bound":
                 l_out_ref[0] = jnp.broadcast_to(l, l_out_ref[0].shape)
             else:
                 l_out_ref[0] = l_scr[...]
@@ -469,7 +516,7 @@ def _flash_tile(
     q_ref, k_ref, v_ref, acc_scr, m_scr, l_scr,
     *, valid, q_offset, kv_offset, kv_idx, q_idx, n_true, block_k, causal,
     block_q, q_seg_ref=None, kv_seg_ref=None, window=None, softcap2=None,
-    sinks=None, kv_min=None, bound_mode=False, pos_mod=None,
+    sinks=None, kv_min=None, variant="online", pos_mod=None,
 ):
     """The per-tile online-softmax update (body of `_flash_kernel`; also
     the tile body of the decode kernel, `ops/decode.py`).  ``valid`` is a
@@ -538,7 +585,7 @@ def _flash_tile(
             mask = jnp.logical_and(mask, q_ids == kv_ids)
         s = jnp.where(mask, s, NEG_INF)
 
-    if bound_mode:
+    if variant == "bound":
         # Bound mode (VFA): the per-row score max is replaced by the
         # upper bound `_init` stored in m_scr, so there is no running
         # max, no corr, no accumulator rescale — the whole tile update
@@ -562,7 +609,8 @@ def _flash_tile(
         acc_scr[...] += pv
         return
 
-    p, corr = _online_softmax_update(s, m_scr, l_scr, masked=masked)
+    p, update_acc = _softmax_variant_update(s, m_scr, l_scr,
+                                            variant=variant, masked=masked)
 
     pv = jax.lax.dot_general(
         p.astype(v_ref.dtype),
@@ -570,7 +618,7 @@ def _flash_tile(
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    acc_scr[...] = acc_scr[...] * corr + pv
+    acc_scr[...] = update_acc(acc_scr[...], pv)
 
 
 def _online_softmax_update(s, m_scr, l_scr, *, masked):
@@ -599,6 +647,118 @@ def _online_softmax_update(s, m_scr, l_scr, *, masked):
     m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
     l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
     return p, corr
+
+
+#: valid per-tile rescaling-math variants (see `_softmax_variant_update`);
+#: ``"auto"`` additionally resolves through the tuning tables at dispatch.
+MAX_MODES = ("online", "bound", "flashd", "amla")
+
+
+def _softmax_variant_update(s, m_scr, l_scr, *, variant, masked):
+    """Per-tile softmax-recurrence dispatch shared by the flash forward,
+    decode, and ragged kernel bodies (which differ only in how they index
+    Q/K/V around this update).
+
+    Returns ``(p, update_acc)``: the probability tile to feed the P·V
+    matmul and a closure ``update_acc(acc, pv) -> new_acc`` folding the
+    variant's rescale math into the accumulator update.  ``"bound"`` is
+    NOT dispatched here — it needs the prefetched key-norm bound and has
+    its own tile body in `_flash_tile`.
+    """
+    if variant == "flashd":
+        return _flashd_update(s, m_scr, l_scr, masked=masked)
+    if variant == "amla":
+        return _amla_update(s, m_scr, l_scr, masked=masked)
+    p, corr = _online_softmax_update(s, m_scr, l_scr, masked=masked)
+    return p, lambda acc, pv: acc * corr + pv
+
+
+def _flashd_update(s, m_scr, l_scr, *, masked):
+    """FLASH-D (PAPERS.md, arXiv:2505.14201): hidden softmax division.
+
+    The accumulator is kept NORMALIZED at every step — the tile update
+    divides the probability tile and the carried accumulator by the
+    running denominator as it goes, so there is no per-block rescale
+    multiply against the old un-normalized accumulator and no final
+    ``l``-division epilogue.  The m scratch carries the running
+    log-sum-exp ``mu = log2(sum_j exp2(s_j))`` instead of the running
+    max (itself the nonlinear part of the paper's recurrence); the l
+    scratch is pinned to 1 so the stats contract ``out_unnorm = out *
+    l * exp(m)/exp(m)`` holds with ``l == 1`` and ``m == lse`` — the
+    distributed two-phase merge is unchanged.
+    """
+    mu_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)  # running lse
+    b = jnp.maximum(mu_prev, jnp.max(s, axis=-1, keepdims=True))
+    if masked:
+        # guards: a fully-masked tile on an empty history has b = -inf
+        p = jnp.where(b == NEG_INF, 0.0, jnp.exp2(s - b))
+        a = jnp.where(mu_prev == NEG_INF, 0.0, jnp.exp2(mu_prev - b))
+    else:
+        # unmasked: b is a real (finite) row max, exp2(-inf - b)
+        # underflows to the right 0 on its own
+        p = jnp.exp2(s - b)
+        a = jnp.exp2(mu_prev - b)
+    # t = exp2(-b) * (sum of ALL exponentials so far): the new
+    # denominator, pre-divided out of both p and the carried acc
+    t = a + jnp.sum(p, axis=-1, keepdims=True)
+    rt = jnp.where(t == 0.0, 0.0, 1.0 / t)
+    # mu_new = log2(sum_j exp2(s_j)); t == 0 only when b == -inf, and
+    # -inf + log2(0) = -inf keeps the empty-row sentinel exact
+    mu_new = b + jnp.log2(t)
+    m_scr[...] = jnp.broadcast_to(mu_new, m_scr.shape)
+    l_scr[...] = jnp.ones_like(l_scr)
+    corr = a * rt
+    return p * rt, lambda acc, pv: acc * corr + pv
+
+
+def _amla_update(s, m_scr, l_scr, *, masked):
+    """AMLA (PAPERS.md, arXiv:2509.25224): rescale multiplies become
+    exponent-field integer adds.
+
+    The running max is quantized UP to an integer (scores are already
+    log2-domain from the Q prescale, so integer units = powers of two):
+    every rescale factor ``exp2(m_prev - m_next)`` then has an exact
+    fp32 representation with an all-zero mantissa delta, and multiplying
+    the accumulator / denominator by it reduces to adding the (negative)
+    integer ``m_prev - m_next`` to their exponent fields
+    (`_exponent_add`) — no VPU multiply, bit-exact.  Ceiling (not floor)
+    keeps ``s - m_next <= 0`` so ``p <= 1`` retains bound-mode's
+    overflow-free property with at most one extra log2 unit of
+    underflow headroom spent.
+    """
+    m_prev = jnp.max(m_scr[...], axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, jnp.ceil(jnp.max(s, axis=-1,
+                                                  keepdims=True)))
+    if masked:
+        p = jnp.where(m_next == NEG_INF, 0.0, jnp.exp2(s - m_next))
+    else:
+        p = jnp.exp2(s - m_next)
+    # diff <= 0 and integer-valued (both maxes are ceil-quantized);
+    # fully-masked history (m_prev == -inf) rescales nothing: diff = 0
+    diff = jnp.where(m_prev == NEG_INF, 0.0,
+                     m_prev - m_next).astype(jnp.int32)
+    l_prev = jnp.max(l_scr[...], axis=-1, keepdims=True)
+    l_next = _exponent_add(l_prev, diff) + jnp.sum(p, axis=-1,
+                                                   keepdims=True)
+    m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+    return p, lambda acc, pv: _exponent_add(acc, diff) + pv
+
+
+def _exponent_add(x, e):
+    """``x * 2**e`` as an integer add on the fp32 exponent field.
+
+    ``e`` is a non-positive int32 (broadcastable against ``x``).  Exact
+    for every normal fp32 input; zeros pass through and results whose
+    biased exponent would leave the normal range flush to zero (the
+    rescale factor is < 2^-126 there — the product is below any budget
+    in the ledger).  The sign bit is untouched: with the result exponent
+    in [1, 254] the add never borrows past bit 30.
+    """
+    bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+    exp = jax.lax.shift_right_logical(bits, 23) & 0xFF
+    shifted = jax.lax.bitcast_convert_type(bits + (e << 23), jnp.float32)
+    return jnp.where((x == 0.0) | (exp + e <= 0), 0.0, shifted)
 
 
 def _bound_overshoot_estimate(q, k, knmax, offsets, *, m, n, group,
@@ -717,7 +877,7 @@ def _flash_call(
 ):
     h, m, d = q.shape
     hkv, n, dv = v.shape
-    if max_mode not in ("online", "bound"):
+    if max_mode not in MAX_MODES + ("auto",):
         raise ValueError(f"unknown max_mode {max_mode!r}")
     if h % hkv != 0:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
@@ -784,7 +944,16 @@ def _flash_call(
         )
     grid = (h, m_pad // block_q, sink_blocks + band_blocks)
 
-    bound_mode = max_mode == "bound"
+    variant = max_mode
+    if variant == "auto":
+        # measured dispatch: the tuning tables (user cache, then the
+        # shipped table) pick the rescaling math per (shape, dtype,
+        # flags); a miss resolves to the online oracle — on CPU (no
+        # tpu-* entries apply) "auto" is byte-identical to the default.
+        variant = _tuned_max_mode(
+            "flash_fwd", dtype=q.dtype, heads=h, seq=m, dim=d,
+            causal=causal, window=window, stats=return_stats)
+    bound_mode = variant == "bound"
     if bound_mode and window is not None:
         # Measured (round 5, device clock): on banded grids the bound
         # kernel's VPU saving is within noise of the online kernel
@@ -818,11 +987,12 @@ def _flash_call(
         # ways.  Grid work scales with h*m*n (halved causal), so the
         # dispatch uses score elements, mirroring the measurement.
         bound_mode = False
+    if variant == "bound" and not bound_mode:
+        variant = "online"
     if obs.is_enabled():
-        # trace-time: one tick per lowering, recording whether a
-        # requested bound mode statically resolved to online
-        _FLASH_LOWERED.inc(requested=max_mode,
-                           lowered="bound" if bound_mode else "online")
+        # trace-time: one tick per lowering, recording the static
+        # resolution (auto -> table pick, bound -> online demotions)
+        _FLASH_LOWERED.inc(requested=max_mode, lowered=variant)
     softcap2 = None if softcap is None else softcap * _LOG2E
     kernel_kwargs = dict(
         n_true=n,
@@ -975,8 +1145,8 @@ def _flash_call(
     n_eff = band_blocks * block_k
     flops = 2 * h * m_pad * n_eff * (d + dv)
 
-    def _run(bound: bool):
-        kern = functools.partial(_flash_kernel, bound_mode=bound,
+    def _run(variant_: str):
+        kern = functools.partial(_flash_kernel, variant=variant_,
                                  **kernel_kwargs)
         if not return_stats:
             kern = functools.partial(_no_stat_kernel, kern)
@@ -1013,7 +1183,7 @@ def _flash_call(
             _logger.warning(
                 "_UNSAFE_SKIP_GUARD is set — bound-mode overshoot "
                 "guard DISABLED (triage only)")
-            outs = _run(True)
+            outs = _run("bound")
         else:
             # The cond's STRUCTURE costs ~30-50 us per call on this
             # toolchain regardless of branch content — measured round 5
@@ -1029,9 +1199,10 @@ def _flash_call(
             # IS the measured optimum among every structure tried; the
             # flat cost is the price of the no-silent-zeros guarantee.
             outs = jax.lax.cond(bound_safe,
-                                lambda: _run(True), lambda: _run(False))
+                                lambda: _run("bound"),
+                                lambda: _run("online"))
     else:
-        outs = _run(False)
+        outs = _run(variant)
 
     out = outs[0][:, :m]
     if return_stats:
@@ -1168,6 +1339,13 @@ def _flash_attention_jit(
     overshoot could leave fp32 exp2 range (adversarial norms, outlier K
     channels), the call self-demotes to the online kernel
     (`_bound_overshoot_estimate`), so the result is exact either way.
+    ``max_mode="flashd"`` (FLASH-D) folds the softmax division into the
+    accumulator update (no rescale multiply, no division epilogue);
+    ``max_mode="amla"`` (AMLA) quantizes the running max to powers of
+    two so rescales become exponent-field integer adds — both same
+    semantics, fuzzed against the fp64 oracle (`chaos`).
+    ``max_mode="auto"`` asks the tuning tables (measured per shape,
+    dtype, flags) and falls back to ``"online"`` on a miss.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)

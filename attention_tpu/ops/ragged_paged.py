@@ -68,16 +68,27 @@ from attention_tpu.ops.flash import (
     _STAT_LANES,
     NEG_INF,
     _compiler_params,
-    _online_softmax_update,
     _should_interpret,
+    _softmax_variant_update,
+    _tuned_max_mode,
     check_softcap,
 )
 
 # Op-dispatch telemetry (attention_tpu.obs, off by default): one tick
 # per host-side dispatch; calls inside an enclosing jit tick per trace.
+# `ops.ragged.lowered` ticks at TRACE time inside the jitted body and
+# records which rescaling-math variant the dispatch actually lowered
+# (the ragged equivalent of `ops.flash.lowered`).
 _RAGGED_CALLS = obs.counter(
     "ops.ragged.calls",
     "ragged paged-attention dispatches by (tokens, capacity, dim) bucket")
+_RAGGED_LOWERED = obs.counter(
+    "ops.ragged.lowered",
+    "ragged kernel lowerings by requested/resolved max mode")
+
+#: max_mode values the ragged kernel accepts — "bound" is forward-only
+#: (it needs the key-norm prefetch this grid does not carry).
+RAGGED_MAX_MODES = ("online", "flashd", "amla", "auto")
 
 
 class RaggedPagedStep(NamedTuple):
@@ -127,15 +138,26 @@ class RaggedPagedStep(NamedTuple):
 
 
 def packed_bucket(n_tokens: int, *, minimum: int = 8) -> int:
-    """Packed-axis width for ``n_tokens`` real tokens: the next power
-    of two (>= ``minimum``), so the number of distinct jit signatures
-    over a serving life is O(log max_tokens) instead of one per batch
-    composition."""
+    """Packed-axis width for ``n_tokens`` real tokens.
+
+    Two tiers per octave: the next power of two, refined down to the
+    3·2^k midpoint (8, 16, 24, 32, 48, 64, 96, ...) when the midpoint
+    still covers ``n_tokens`` and keeps the width 8-aligned (so
+    ``width * group`` stays sublane-legal for every GQA group).  The
+    midpoint tier halves the worst-case pow2 pad tail (a 33-token step
+    pads to 48, not 64) while only DOUBLING the signature count — still
+    O(log max_tokens) distinct jit shapes over a serving life, the
+    no-recompile-cliff property the pow2 buckets bought.  Idempotent:
+    every returned width buckets to itself."""
     if n_tokens < 0:
         raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
     w = max(minimum, 1)
     while w < n_tokens:
         w *= 2
+    mid = 3 * w // 4
+    if w >= 4 and mid >= n_tokens and mid >= max(minimum, 1) \
+            and mid % 8 == 0:
+        w = mid
     return w
 
 
@@ -181,6 +203,7 @@ def _ragged_kernel(
     acc_scr, m_scr, l_scr,
     *, s_slots: int, group: int, page: int, q_tile: int, t_pad: int,
     softcap2, window: int | None, sinks: int | None,
+    variant: str = "online",
 ):
     """One (kv-head * slot, logical-page) grid step.
 
@@ -242,18 +265,24 @@ def _ragged_kernel(
                 win = jnp.logical_or(win, col < sinks)
             mask = jnp.logical_and(mask, win)
         s = jnp.where(mask, s, NEG_INF)
-        p, corr = _online_softmax_update(s, m_scr, l_scr, masked=True)
+        p, update_acc = _softmax_variant_update(
+            s, m_scr, l_scr, variant=variant, masked=True)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        acc_scr[...] = acc_scr[...] * corr + pv
+        acc_scr[...] = update_acc(acc_scr[...], pv)
 
     @pl.when(jnp.logical_and(j == num_j - 1, active))
     def _finalize():
-        l = jnp.max(l_scr[...], axis=-1, keepdims=True)
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        res = acc_scr[...] / l_safe
+        if variant == "flashd":
+            # the accumulator is already normalized (flashd's hidden
+            # division) — the per-slot epilogue loses its divide
+            res = acc_scr[...]
+        else:
+            l = jnp.max(l_scr[...], axis=-1, keepdims=True)
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            res = acc_scr[...] / l_safe
         # poisoned slots (bad append, length -1) emit NaN, loudly
         res = jnp.where(raw_len < 0, jnp.nan, res)
         row = jax.lax.broadcasted_iota(jnp.int32, res.shape, 0)
@@ -267,7 +296,8 @@ def _ragged_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "interpret", "softcap", "window", "sinks"),
+    static_argnames=("scale", "interpret", "softcap", "window", "sinks",
+                     "max_mode"),
 )
 def _ragged_paged_attention_jit(
     q: jax.Array,            # (1, Hq, T, d) packed token axis
@@ -278,6 +308,7 @@ def _ragged_paged_attention_jit(
     softcap: float | None = None,
     window: int | None = None,
     sinks: int | None = None,
+    max_mode: str = "online",
 ) -> jax.Array:
     """softmax(q K^T * scale) V for every packed token through its
     slot's page table, causal within each request — (1, Hq, T, dv).
@@ -285,7 +316,11 @@ def _ragged_paged_attention_jit(
     ``kv_lens`` must be POST-append (run `ragged_paged_append` first);
     pad tokens return zeros, poisoned slots NaN.  ``window``/``sinks``
     are the decode kernels' per-request logical band, applied before
-    page translation so out-of-window pages never DMA."""
+    page translation so out-of-window pages never DMA.  ``max_mode``
+    picks the rescaling math ("online"/"flashd"/"amla" — the per-slot
+    masked read-modify-write finalize is exactly the epilogue flashd
+    and amla cheapen); "auto" consults the tuning tables (ragged
+    family) and falls back to "online"."""
     check_softcap(softcap)
     check_band(window, sinks)
     if q.ndim != 4 or q.shape[0] != 1:
@@ -324,6 +359,18 @@ def _ragged_paged_attention_jit(
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _should_interpret()
+    if max_mode not in RAGGED_MAX_MODES:
+        raise ValueError(
+            f"unknown ragged max_mode {max_mode!r}; one of "
+            f"{RAGGED_MAX_MODES} (bound mode is forward-only)")
+    variant = max_mode
+    if variant == "auto":
+        variant = _tuned_max_mode(
+            "ragged", dtype=q.dtype, allowed=("online", "flashd", "amla"),
+            heads=h, kv_heads=hkv, seq=cache.max_tokens, dim=d,
+            batch=s_slots, window=window, sinks=sinks)
+    if obs.is_enabled():
+        _RAGGED_LOWERED.inc(requested=max_mode, lowered=variant)
 
     lens = jnp.asarray(cache.kv_lens, jnp.int32)
     cu = jnp.asarray(cache.cu_q_lens, jnp.int32)
@@ -351,7 +398,7 @@ def _ragged_paged_attention_jit(
         _ragged_kernel, s_slots=s_slots, group=group, page=page,
         q_tile=q_tile, t_pad=t_pad,
         softcap2=None if softcap is None else softcap * _LOG2E,
-        window=window, sinks=sinks,
+        window=window, sinks=sinks, variant=variant,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,

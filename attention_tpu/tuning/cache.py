@@ -34,8 +34,10 @@ Keys are 5 pipe-separated fields::
 
 Entry values carry any of ``block_q``/``block_k``/``page_size`` (all
 must be positive multiples of 128 — ``validate_entry`` and the
-``scripts/check_shipped_table.py`` lint enforce it) plus provenance
-fields the kernels ignore.
+``scripts/check_shipped_table.py`` lint enforce it), optionally a
+``max_mode`` rescaling-math variant (one of :data:`MAX_MODE_VALUES`;
+the forward/decode/ragged kernels' ``max_mode="auto"`` dispatch reads
+it), plus provenance fields the kernels ignore.
 """
 
 from __future__ import annotations
@@ -51,6 +53,12 @@ KERNELS = ("flash_fwd", "flash_bwd", "flash_bwd_fused", "decode", "paged",
            "ragged")
 
 _TILE_FIELDS = ("block_q", "block_k", "page_size")
+
+#: legal values for an entry's optional ``max_mode`` field — the
+#: rescaling-math variants ops.flash/decode/ragged_paged can lower
+#: (ops.flash.MAX_MODES; spelled out here so a corrupt cache cannot
+#: import ops at validation time)
+MAX_MODE_VALUES = ("online", "bound", "flashd", "amla")
 
 _BUCKET_RE = re.compile(r"^g(\d+)-m(\d+)-n(\d+)-d(\d+)$")
 _FLAG_RE = re.compile(r"^[a-z_]+=\d+$")
@@ -113,8 +121,9 @@ def parse_key(key: str) -> dict:
 
 
 def validate_entry(entry: dict) -> None:
-    """Raise ValueError unless the entry carries at least one tile field
-    and every tile field is a positive multiple of 128."""
+    """Raise ValueError unless the entry carries at least one tile field,
+    every tile field is a positive multiple of 128, and ``max_mode``
+    (when present) names a known rescaling-math variant."""
     if not isinstance(entry, dict):
         raise ValueError(f"entry must be a dict, got {type(entry).__name__}")
     tiles = [f for f in _TILE_FIELDS if f in entry]
@@ -126,6 +135,11 @@ def validate_entry(entry: dict) -> None:
             raise ValueError(
                 f"{f}={v!r} must be a positive multiple of 128"
             )
+    if "max_mode" in entry and entry["max_mode"] not in MAX_MODE_VALUES:
+        raise ValueError(
+            f"max_mode={entry['max_mode']!r} must be one of "
+            f"{MAX_MODE_VALUES}"
+        )
 
 
 def default_cache_path() -> str:

@@ -126,6 +126,7 @@ def _measure_factory(kernel: str, cand, *, heads, kv_heads, seq, dim,
         def dstep(x, kcc, vcc, ll):
             return flash_decode(x, kcc, vcc, ll, block_k=cand,
                                 window=window, sinks=sinks,
+                                max_mode=max_mode,
                                 interpret=interpret)
 
         return dstep, q, (kc, vc, lens)
@@ -171,6 +172,16 @@ def tune(kernel: str, *, seq: int, dim: int, heads: int = 1,
          log=None) -> dict:
     """Search one kernel family's space at one shape; persist the winner.
 
+    ``max_mode="auto"`` widens the race to the cross product of tiles
+    and the family's rescaling-math variants
+    (:func:`space.max_mode_candidates`) and records the winning variant
+    in the entry's ``max_mode`` field — the value the kernels'
+    ``max_mode="auto"`` dispatch later reads back.  An explicit
+    ``max_mode`` pins the variant (and is recorded likewise for
+    mode-capable families); the default ``"bound"`` measures each
+    family's historical forward default (decode/ragged cannot lower
+    bound and fall to ``"online"``).
+
     Returns a record: per-candidate ``ms`` (or ``error`` for candidates
     that failed to compile/run), the winning entry, the cache key it was
     stored under, and whether it was written.  Raises RuntimeError only
@@ -187,8 +198,24 @@ def tune(kernel: str, *, seq: int, dim: int, heads: int = 1,
     if not cands:
         raise RuntimeError(
             f"no shape-legal candidates for {kernel} at seq={seq}")
+    mode_cands = space.max_mode_candidates(kernel)
+    if max_mode == "auto":
+        # joint (tile, mode) race; families without a mode field keep
+        # the forward's historical default
+        mode_list = list(mode_cands) or ["bound"]
+    else:
+        mode_list = [max_mode]
+        if mode_cands and max_mode not in mode_cands:
+            if max_mode != "bound":
+                raise ValueError(
+                    f"{kernel} cannot lower max_mode {max_mode!r}; one "
+                    f"of {mode_cands + ('auto',)}")
+            # decode/ragged cannot lower "bound" (the tune() default,
+            # kept for CLI compatibility): measure their online default
+            mode_list = ["online"]
     results: dict = {}
     best_cand = None
+    best_mode = None
     best_s = None
     force_two_kernel = kernel == "flash_bwd"
     if force_two_kernel:
@@ -200,30 +227,32 @@ def tune(kernel: str, *, seq: int, dim: int, heads: int = 1,
         _bwd._FORCE_TWO_KERNEL = True
     try:
         for cand in cands:
-            label = (f"{cand[0]}x{cand[1]}" if isinstance(cand, tuple)
-                     else str(cand))
-            try:
-                with obs.span("tuning.search.measure"):
-                    step, x, operands = _measure_factory(
-                        kernel, cand, heads=heads, kv_heads=kv_heads,
-                        seq=seq, dim=dim, batch=batch, dtype=dtype,
-                        causal=causal, window=window, sinks=sinks,
-                        stats=stats, max_mode=max_mode,
-                        interpret=interpret)
-                    sec = float(timer(step, x, operands, repeats))
-                _CANDIDATES.inc(kernel=kernel)
-            except Exception as e:  # noqa: BLE001 - VMEM overflow et al.
-                results[label] = {"error": f"{type(e).__name__}: "
-                                           f"{str(e)[:160]}"}
-                _SKIPPED.inc(kernel=kernel, error=type(e).__name__)
+            base = (f"{cand[0]}x{cand[1]}" if isinstance(cand, tuple)
+                    else str(cand))
+            for mode in mode_list:
+                label = f"{base}@{mode}" if len(mode_list) > 1 else base
+                try:
+                    with obs.span("tuning.search.measure"):
+                        step, x, operands = _measure_factory(
+                            kernel, cand, heads=heads, kv_heads=kv_heads,
+                            seq=seq, dim=dim, batch=batch, dtype=dtype,
+                            causal=causal, window=window, sinks=sinks,
+                            stats=stats, max_mode=mode,
+                            interpret=interpret)
+                        sec = float(timer(step, x, operands, repeats))
+                    _CANDIDATES.inc(kernel=kernel)
+                except Exception as e:  # noqa: BLE001 - VMEM overflow
+                    results[label] = {"error": f"{type(e).__name__}: "
+                                               f"{str(e)[:160]}"}
+                    _SKIPPED.inc(kernel=kernel, error=type(e).__name__)
+                    if log:
+                        log(f"  {label}: SKIP ({type(e).__name__})")
+                    continue
+                results[label] = {"ms": round(sec * 1e3, 4)}
                 if log:
-                    log(f"  {label}: SKIP ({type(e).__name__})")
-                continue
-            results[label] = {"ms": round(sec * 1e3, 4)}
-            if log:
-                log(f"  {label}: {sec * 1e3:.3f} ms")
-            if best_s is None or sec < best_s:
-                best_s, best_cand = sec, cand
+                    log(f"  {label}: {sec * 1e3:.3f} ms")
+                if best_s is None or sec < best_s:
+                    best_s, best_cand, best_mode = sec, cand, mode
     finally:
         if force_two_kernel:
             _bwd._FORCE_TWO_KERNEL = prev_force
@@ -238,6 +267,8 @@ def tune(kernel: str, *, seq: int, dim: int, heads: int = 1,
         entry = {"page_size": int(best_cand)}
     else:
         entry = {"block_q": int(best_cand[0]), "block_k": int(best_cand[1])}
+    if mode_cands:
+        entry["max_mode"] = best_mode
     entry.update({
         "ms": round(best_s * 1e3, 4),
         "source": "measured",

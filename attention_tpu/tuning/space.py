@@ -46,6 +46,28 @@ PAGED_PAGE_SIZES = (128, 256, 512, 1024, 2048, 4096)
 # packed-step kernel; the engine divides by the group to get tokens.
 RAGGED_BLOCK_Q = (128, 256, 512)
 
+# Rescaling-math variants per family (the max_mode dispatch dimension).
+# "bound" leads for the forward because the r05 key-norm-bound skip won
+# the device clock there; decode/ragged cannot lower it (no key-norm
+# prefetch on the cache read path), so their lists start at online.
+FLASH_FWD_MAX_MODES = ("bound", "online", "flashd", "amla")
+DECODE_MAX_MODES = ("online", "flashd", "amla")
+RAGGED_MAX_MODES = ("online", "flashd", "amla")
+
+
+def max_mode_candidates(kernel: str) -> tuple:
+    """Rescaling-math variants ``tune(max_mode="auto")`` races for one
+    family; empty for families whose entries carry no max_mode (the
+    backward kernels recompute through the forward's own dispatch, and
+    paged/quantized decode take no max_mode at all)."""
+    if kernel == "flash_fwd":
+        return FLASH_FWD_MAX_MODES
+    if kernel == "decode":
+        return DECODE_MAX_MODES
+    if kernel == "ragged":
+        return RAGGED_MAX_MODES
+    return ()
+
 
 def _ceil_to(x: int, mult: int) -> int:
     return -(-x // mult) * mult

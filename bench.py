@@ -714,10 +714,14 @@ def main(argv=None) -> int:
         help="m=n at which the serial C oracle is timed (then extrapolated)",
     )
     p.add_argument(
-        "--max-mode", choices=("online", "bound"), default="bound",
-        help="flash softmax-max strategy; 'bound' (default) is the "
+        "--max-mode",
+        choices=("online", "bound", "flashd", "amla", "auto"),
+        default="bound",
+        help="flash rescaling-math strategy; 'bound' (default) is the "
         "VFA-style precomputed bound — same output/lse, ~0.95 vs ~0.81 "
-        "util (scripts/max_mode_exp.py)",
+        "util (scripts/max_mode_exp.py); 'flashd'/'amla' are the "
+        "deferred-division and exponent-add variants; 'auto' reads the "
+        "measured per-device tuning table",
     )
     p.add_argument("--all", action="store_true", help="full config ladder")
     p.add_argument(
@@ -952,6 +956,30 @@ def main(argv=None) -> int:
             }
             if not ok:
                 ladder[name]["implausible_timing"] = True
+        # rescaling-math variant arms at the headline shape: one row
+        # per max_mode the forward can lower — the measured-dispatch
+        # dimension tune(max_mode="auto") races.  The row matching the
+        # run's own --max-mode reuses the headline measurement.
+        from attention_tpu.tuning.space import FLASH_FWD_MAX_MODES
+
+        head_fl = attention_flops(args.seq, args.seq, args.dim, args.dim)
+        variants = {}
+        for mode in FLASH_FWD_MAX_MODES:
+            if mode == args.max_mode:
+                v_s, v_ok = tpu_s, plausible
+            else:
+                v_s, v_ok = _measure_plausible(
+                    lambda m=mode: _bench_flash_s(
+                        args.seq, args.dim, args.repeats, args.block_q,
+                        args.block_k, n_short=2, n_long=8, max_mode=m),
+                    head_fl)
+            variants[mode] = {
+                "ms": round(v_s * 1e3, 3),
+                "util": round(head_fl / v_s / peak_flops(), 4),
+            }
+            if not v_ok:
+                variants[mode]["implausible_timing"] = True
+        ladder["max_mode_variants_headline"] = variants
         # sliding-window config: banded grid, cost ~ window not sequence
         # band FLOPs estimate uses the same effective tile the run uses
         # (explicit flag wins; else for_shape's windowed default)

@@ -60,26 +60,35 @@ def main() -> int:
     # 131k, the GQA 32q/4kv config, the windowed 32k configs), with the
     # big-tile regime pinned on (the v5e measurement the heuristic
     # encodes — big_tiles=True regardless of the regenerating host).
+    # max_mode="bound" is the r05 measured rescaling-math winner for
+    # the forward (the key-norm bound skip); decode/ragged below ship
+    # "online" (they cannot lower bound, and no variant has beaten it
+    # on the v5e clock).
+
+    def fwd_tiles(bs):
+        return {"block_q": int(bs[0]), "block_k": int(bs[1]),
+                "max_mode": "bound"}
+
     for m in (8192, 16384, 32768, 65536, 131072):
         for causal in (False, True):
             for stats in (False, True):
                 put("flash_fwd",
-                    BlockSizes.heuristic_for_shape(
+                    fwd_tiles(BlockSizes.heuristic_for_shape(
                         m, d, returns_stats=stats, causal=causal,
-                        big_tiles=True),
+                        big_tiles=True)),
                     "bfloat16", heads=1, seq=m, dim=d, causal=causal,
                     stats=stats)
     for causal in (False, True):
         put("flash_fwd",
-            BlockSizes.heuristic_for_shape(16384, d, causal=causal,
-                                           big_tiles=True),
+            fwd_tiles(BlockSizes.heuristic_for_shape(
+                16384, d, causal=causal, big_tiles=True)),
             "bfloat16", heads=32, seq=16384, dim=d, causal=causal)
     for window in (256, 1024, 4096):
         for stats in (False, True):
             put("flash_fwd",
-                BlockSizes.heuristic_for_shape(
+                fwd_tiles(BlockSizes.heuristic_for_shape(
                     32768, d, window=window, returns_stats=stats,
-                    causal=True, big_tiles=True),
+                    causal=True, big_tiles=True)),
                 "bfloat16", heads=1, seq=32768, dim=d, causal=True,
                 stats=stats, window=window)
 
@@ -97,13 +106,19 @@ def main() -> int:
     # decode: the bench serving config (b=8, 32q/4kv) across capacities
     for n in (8192, 32768, 131072):
         for window in (None, 1024):
-            put("decode", {"block_k": _DEFAULT_BLOCK_K}, "bfloat16",
-                heads=32, kv_heads=4, batch=8, seq=n, dim=d,
-                window=window)
+            put("decode",
+                {"block_k": _DEFAULT_BLOCK_K, "max_mode": "online"},
+                "bfloat16", heads=32, kv_heads=4, batch=8, seq=n,
+                dim=d, window=window)
 
     # paged: page size == the dense streaming block at the bench shape
     put("paged", {"page_size": 2048}, "bfloat16",
         heads=32, kv_heads=4, batch=8, seq=32768, dim=d)
+
+    # ragged packed step: the serving bench's slot/capacity configs
+    for n in (32768, 131072):
+        put("ragged", {"block_q": 256, "max_mode": "online"},
+            "bfloat16", heads=32, kv_heads=4, batch=8, seq=n, dim=d)
 
     path = shipped_table_path()
     table.save(path)
