@@ -387,6 +387,17 @@ GRAY_FAULT_KINDS = (
     "nan",            # poison the model's output logits with NaN
 )
 
+#: the prefix-store faults (ISSUE 17) — only meaningful against a
+#: front end with ``FrontendConfig.prefix_store`` set; each attacks a
+#: different leg of the fleet-reuse contract (payload integrity,
+#: manifest integrity, the single-flight lease, the byte budget)
+STORE_FAULT_KINDS = FRONTEND_FAULT_KINDS + (
+    "store_poison",   # flip a byte inside a stored record's payload
+    "store_crc",      # flip a byte inside a record's manifest line
+    "lease_kill",     # kill the replica serving the lease leader
+    "store_evict",    # eviction storm: drop every entry at once
+)
+
 
 def random_frontend_plan(seed: int, request_ids: Sequence[str],
                          num_replicas: int, *, num_events: int = 5,
@@ -505,6 +516,48 @@ def random_gray_plan(seed: int, request_ids: Sequence[str],
     return FaultPlan(seed=seed, events=tuple(events))
 
 
+def random_store_plan(seed: int, request_ids: Sequence[str],
+                      num_replicas: int, *, num_events: int = 6,
+                      max_tick: int = 40) -> FaultPlan:
+    """Sample one seeded prefix-store storm: the ISSUE 6 storm kinds
+    plus the four store attacks, with at least one store fault
+    guaranteed per plan (a store storm that never touches the store
+    proves nothing).  ``arg`` on the corruption kinds picks WHICH live
+    entry gets hit (mod the live count at fire time), so replays are
+    deterministic even as the store fills."""
+    rng = np.random.default_rng(seed)
+    store_kinds = ("store_poison", "store_crc", "lease_kill",
+                   "store_evict")
+    events = []
+    for _ in range(num_events):
+        kind = STORE_FAULT_KINDS[int(rng.integers(len(STORE_FAULT_KINDS)))]
+        step = int(rng.integers(1, max_tick))
+        arg, target = 1, None
+        if kind == "replica_kill":
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if rng.random() < 0.9:
+                events.append(FaultEvent(
+                    step=step + int(rng.integers(2, 7)),
+                    kind="replica_restart", target=target))
+        elif kind in ("replica_restart", "oom", "preempt"):
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if kind in ("oom", "preempt"):
+                arg = int(rng.integers(1, 3))
+        elif kind == "cancel":
+            target = request_ids[int(rng.integers(len(request_ids)))]
+        elif kind in ("store_poison", "store_crc"):
+            arg = int(rng.integers(0, 8))
+        events.append(FaultEvent(step=step, kind=kind, arg=arg,
+                                 target=target))
+    if not any(e.kind in store_kinds for e in events):
+        events.append(FaultEvent(
+            step=int(rng.integers(2, max_tick)),
+            kind=store_kinds[int(rng.integers(len(store_kinds)))],
+            arg=int(rng.integers(0, 8))))
+    events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
 def _flip_byte(path: str) -> None:
     """Bit-flip the middle byte of a file in place — lands inside the
     (dominant) pools section of a snapshot, so restore must fail its
@@ -611,6 +664,17 @@ class FrontendFaultInjector:
                 return
             _tear_tail(journals[-1][1], ev.arg)
             self._mark("journal_tear")
+        elif ev.kind in ("store_poison", "store_crc"):
+            self._corrupt_store_entry(ev)
+        elif ev.kind == "lease_kill":
+            self._kill_lease_holder()
+        elif ev.kind == "store_evict":
+            store = getattr(self.frontend, "prefix_store", None)
+            if store is None or not len(store):
+                self.skipped.append("store_evict:empty")
+                return
+            store.evict_all()
+            self._mark("store_evict")
         elif ev.kind in GRAY_FAULT_KINDS:
             handle = self._handle(ev.target)
             if handle is None or not handle.alive:
@@ -619,6 +683,52 @@ class FrontendFaultInjector:
             self._arm_gray(handle, ev.kind, max(1, ev.arg))
         else:
             raise ValueError(f"unknown frontend fault kind {ev.kind!r}")
+
+    def _corrupt_store_entry(self, ev: FaultEvent) -> None:
+        """Flip one byte of a live record in place — in the payload
+        region (``store_poison``: the section CRC must catch it) or in
+        the manifest line (``store_crc``: structural validation must
+        catch it).  Either way the ONLY acceptable outcome downstream
+        is `PrefixStoreCorruptError` handling: count, discard, cold
+        re-prefill — never imported garbage (invariant 14 checks the
+        token streams)."""
+        store = getattr(self.frontend, "prefix_store", None)
+        keys = sorted(store._entries) if store is not None else []
+        if not keys:
+            self.skipped.append(f"{ev.kind}:no-entries")
+            return
+        entry = store._entries[keys[ev.arg % len(keys)]]
+        blob = bytearray(entry.blob)
+        nl = blob.index(b"\n")
+        if ev.kind == "store_poison":
+            pos = nl + 1 + (len(blob) - nl - 1) // 2
+        else:
+            pos = nl // 2
+        blob[pos] ^= 0xFF
+        entry.blob = bytes(blob)
+        self._mark(ev.kind)
+
+    def _kill_lease_holder(self) -> None:
+        """Fail-stop the replica currently prefilling for a
+        single-flight lease leader: the leader rides the retry path to
+        another replica (still holding its lease via the front end's
+        heartbeat), so coalesced waiters must keep waiting and then
+        import — exactly one fleet prefill even across the kill."""
+        store = getattr(self.frontend, "prefix_store", None)
+        if store is None:
+            self.skipped.append("lease_kill:no-store")
+            return
+        victim = None
+        for _key, owner in store.leases.active(
+                now=self.frontend.current_tick):
+            fr = self.frontend.requests.get(owner)
+            if fr is not None and fr.replica_id is not None:
+                victim = fr.replica_id
+                break
+        if victim is None or not self.frontend.kill_replica(victim):
+            self.skipped.append("lease_kill:no-holder")
+            return
+        self._mark("lease_kill")
 
     def _arm_gray(self, handle, kind: str, count: int) -> None:
         """Arm a gray-failure window of ``count`` steps on the target
@@ -817,6 +927,12 @@ def run_frontend_plan(model, params, config: EngineConfig,
     if drained and baseline is not None:
         violations += inv.migration_parity_violations(frontend,
                                                       baseline)
+    if baseline is not None:
+        # invariant 14: a no-op on storeless front ends; with a store
+        # attached, finished streams must match the NO-STORE fault-free
+        # run and the store's byte ledger must balance
+        violations += inv.prefix_import_parity_violations(frontend,
+                                                          baseline)
     violations += inv.termination_violations(drained, error,
                                              max_steps=max_ticks)
     violations += inv.typed_error_violations(error)
@@ -1036,6 +1152,78 @@ def run_gray_campaign(seed: int, snapshot_root: str, *,
                 baseline, r.outputs, finished)
         if log is not None:
             log(f"gray storm {i} (seed {plan.seed}): "
+                f"injected={r.injected} "
+                f"violations={len(r.violations)} "
+                f"states={sorted(set(r.states.values()))} "
+                f"error={r.surfaced_error or 'none'}")
+        reports.append(r)
+    return FrontendCampaignReport(seed=seed, num_replicas=num_replicas,
+                                  baseline_outputs=baseline,
+                                  reports=reports)
+
+
+def shared_prefix_trace(num_requests: int, *, vocab: int, seed: int,
+                        header_tokens: int = 256, tail_tokens: int = 4,
+                        max_tokens: int = 4, max_arrival: int = 6,
+                        ) -> list[dict[str, Any]]:
+    """A RAG-shaped trace: every request shares a ``header_tokens``
+    document header (page-aligned so the store can share it) and adds
+    a short unique question tail.  Greedy decoding keeps the fault-
+    free baseline deterministic.  This is the workload the prefix
+    store exists for — the storm campaign runs it so store faults land
+    while records are actually live and leased."""
+    rng = np.random.default_rng(seed)
+    header = [int(t) for t in rng.integers(1, vocab,
+                                           size=header_tokens)]
+    trace = []
+    for i in range(num_requests):
+        tail = [int(t) for t in rng.integers(1, vocab,
+                                             size=tail_tokens)]
+        trace.append({
+            "id": f"s{i}", "prompt": header + tail,
+            "arrival": int(rng.integers(0, max_arrival)),
+            "max_tokens": max_tokens, "temperature": 0.0,
+        })
+    return trace
+
+
+def run_store_campaign(seed: int, *, num_plans: int = 4,
+                       num_requests: int = 5, num_replicas: int = 2,
+                       events_per_plan: int = 6,
+                       config: EngineConfig | None = None,
+                       model=None, params=None,
+                       log: Callable[[str], None] | None = None,
+                       ) -> FrontendCampaignReport:
+    """The ISSUE 17 store storm: a shared-prefix trace through a
+    store-enabled front end under `random_store_plan` faults (poison,
+    manifest flip, lease-holder kill, eviction storm, plus the ISSUE 6
+    kinds).  The fault-free baseline is a SINGLE storeless engine run,
+    so invariant 14 (prefix import parity) judges every finished
+    stream against tokens the store could not possibly have touched —
+    a poisoned record must cost a re-prefill, never a token."""
+    from attention_tpu.prefixstore import PrefixStoreConfig
+
+    if model is None or params is None:
+        model, params = build_sim_model()
+    config = config or default_engine_config(max_seq_len=384,
+                                             num_pages=24)
+    trace = shared_prefix_trace(num_requests, vocab=model.vocab,
+                                seed=seed)
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    ids = [t["id"] for t in trace]
+    reports = []
+    for i in range(num_plans):
+        plan = random_store_plan(seed * 9007 + i, ids, num_replicas,
+                                 num_events=events_per_plan)
+        r = run_frontend_plan(
+            model, params, config,
+            default_frontend_config(
+                num_replicas, prefix_store=PrefixStoreConfig()),
+            trace, plan, baseline=baseline,
+        )
+        if log is not None:
+            log(f"store storm {i} (seed {plan.seed}): "
                 f"injected={r.injected} "
                 f"violations={len(r.violations)} "
                 f"states={sorted(set(r.states.values()))} "

@@ -81,6 +81,17 @@ The forecasting layer (ISSUE 14) adds one more:
     number in it is finite, and rebuilding it from its own embedded
     samples (`obs.capacity.rebuild_report`) reproduces it exactly —
     under kill, gray, and crash storms alike.
+
+The global prefix tier (ISSUE 17) adds one more:
+
+14. **Prefix import parity** — with a fleet prefix store attached
+    (`frontend.prefix_store`), every FINISHED stream is
+    token-identical to the fault-free no-store run, no matter which
+    replica imported its prefix or how the store was poisoned: a
+    corrupt record must surface as `PrefixStoreCorruptError` handling
+    (count + discard + cold re-prefill), never as wrong tokens.  The
+    store's own byte accounting must also balance.  A no-op on a
+    storeless front end.
 """
 
 from __future__ import annotations
@@ -93,6 +104,8 @@ from typing import Iterable, Mapping
 from attention_tpu import obs
 from attention_tpu.engine.errors import (
     DeadlineExceededError,
+    PrefixLeaseError,
+    PrefixStoreCorruptError,
     ReplicaDeadError,
     ReplicaStateError,
     RequestShedError,
@@ -109,7 +122,8 @@ _VIOLATIONS = obs.counter("chaos.invariant.violations",
 TYPED_ERRORS = (OutOfPagesError, PageAccountingError,
                 DeadlineExceededError, ReplicaDeadError,
                 RequestShedError, SnapshotError, SnapshotCorruptError,
-                ReplicaStateError, StepInterruptedError)
+                ReplicaStateError, StepInterruptedError,
+                PrefixStoreCorruptError, PrefixLeaseError)
 
 
 def _report(invariant: str, problems: list[str]) -> list[str]:
@@ -346,6 +360,54 @@ def migration_parity_violations(
                 f"fault-free {list(baseline.get(rid, []))}"
             )
     return _report("migration_parity", problems)
+
+
+def prefix_import_parity_violations(
+    frontend,
+    baseline: Mapping[str, list[int]],
+) -> list[str]:
+    """Invariant 14: the fleet prefix store never changes tokens.
+
+    Every FINISHED stream of a store-enabled front end must be
+    token-identical to the fault-free NO-STORE run of the same trace —
+    whether its prefix was prefilled cold, imported from the store, or
+    re-prefilled after a poisoned record was rejected.  Wrong tokens
+    are never an acceptable corruption outcome; the only legal
+    responses to a bad record are the typed `PrefixStoreCorruptError`
+    handling path (count + discard + cold prefill) upstream of here.
+    Also pins the store's own byte accounting (``total_bytes`` equals
+    the sum of live entry sizes — an eviction storm must not leak
+    phantom bytes into the budget).  A no-op when the front end runs
+    storeless."""
+    from attention_tpu.frontend.frontend import FrontendRequestState
+
+    store = getattr(frontend, "prefix_store", None)
+    if store is None:
+        return []
+    problems = []
+    for fr in sorted(frontend.requests.values(), key=lambda f: f.seq):
+        if fr.state is not FrontendRequestState.FINISHED:
+            continue
+        want = baseline.get(fr.request_id)
+        if want is None:
+            continue
+        if list(fr.tokens) != list(want):
+            problems.append(
+                f"request {fr.request_id}: store-enabled stream "
+                f"{list(fr.tokens)} != no-store fault-free "
+                f"{list(want)}"
+            )
+    live_bytes = sum(e.nbytes for e in store._entries.values())
+    if live_bytes != store.total_bytes:
+        problems.append(
+            f"store byte accounting drifted: entries hold "
+            f"{live_bytes} bytes, budget ledger says "
+            f"{store.total_bytes}"
+        )
+    for name, value in sorted(store.counts.items()):
+        if value < 0:
+            problems.append(f"store counter {name} negative: {value}")
+    return _report("prefix_import_parity", problems)
 
 
 def no_double_serve_violations(frontend) -> list[str]:

@@ -297,6 +297,11 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
         print("--snapshot-dir and --snapshot-every must be set "
               "together", file=sys.stderr)
         return 2
+    if args.prefix_store and not args.replicas:
+        print("--prefix-store needs the multi-replica front end "
+              "(--replicas > 0): fleet-wide reuse has no meaning on "
+              "one engine", file=sys.stderr)
+        return 2
     if args.replicas:
         return _serve_sim_frontend(args, model, params, config, trace,
                                    gray_plan=gray_plan_doc)
@@ -383,6 +388,12 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
         forecast_policy = ForecastPolicy(
             season_ticks=season, horizon=args.forecast_horizon,
             advisory=args.forecast_advisory)
+    prefix_store = None
+    if args.prefix_store:
+        from attention_tpu.prefixstore import PrefixStoreConfig
+
+        prefix_store = PrefixStoreConfig(
+            max_bytes=args.prefix_store_bytes)
     frontend = ServingFrontend(
         model, params, config,
         FrontendConfig(
@@ -394,6 +405,7 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
             supervisor=supervisor,
             standbys=args.standbys,
             forecast=forecast_policy,
+            prefix_store=prefix_store,
         ),
     )
     if args.chaos_plan or gray_plan is not None:
@@ -634,6 +646,18 @@ def _add_serve_sim_args(ss) -> None:
     ss.add_argument("--snapshot-every", type=int, default=None,
                     help="snapshot period in engine steps / front-end "
                          "ticks; requires --snapshot-dir")
+    # global prefix tier (attention_tpu.prefixstore)
+    ss.add_argument("--prefix-store", action="store_true",
+                    help="attach the fleet-wide prefix store to the "
+                         "multi-replica front end (--replicas > 0): "
+                         "committed prompt pages export as CRC'd "
+                         "records any replica imports on a miss, and "
+                         "identical prompt storms prefill exactly "
+                         "once fleet-wide (single-flight leases); "
+                         "with --snapshot-dir the store persists as "
+                         "its own checksummed section file")
+    ss.add_argument("--prefix-store-bytes", type=int, default=1 << 22,
+                    help="prefix-store byte budget (LRU-evicted)")
     # model knobs (deterministic from --model-seed)
     ss.add_argument("--vocab", type=int, default=64)
     ss.add_argument("--dim", type=int, default=64)

@@ -33,6 +33,8 @@ from attention_tpu.engine.engine import (  # noqa: F401
 )
 from attention_tpu.engine.errors import (  # noqa: F401
     DeadlineExceededError,
+    PrefixLeaseError,
+    PrefixStoreCorruptError,
     ReplicaDeadError,
     ReplicaStateError,
     RequestShedError,

@@ -163,6 +163,23 @@ class BlockAllocator:
             n += 1
         return n
 
+    def cached_chain(self, tokens) -> list[int]:
+        """Physical pages of the longest cached page-aligned prefix of
+        ``tokens`` — `peek_prefix`'s page-id twin, equally
+        side-effect-free (no increfs, no hit stats, no LRU touches).
+        The fleet prefix-store import path reads it to splice
+        store-imported pages onto the end of the locally cached chain
+        before committing the extended prefix."""
+        toks = tuple(tokens)
+        limit = (len(toks) - 1) // self.page_size
+        pages: list[int] = []
+        for i in range(1, limit + 1):
+            entry = self._prefix.get(toks[: i * self.page_size])
+            if entry is None:
+                break
+            pages.append(entry.page)
+        return pages
+
     def lookup_prefix(self, tokens, *, now: int) -> list[int]:
         """Longest cached page-aligned prefix of ``tokens``; increfs and
         returns the matched pages (caller owns one reference each).

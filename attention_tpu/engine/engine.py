@@ -315,6 +315,10 @@ class ServingEngine:
         # write-ahead log between snapshots; attached by SnapshotManager
         # (engine/snapshot.py), None when durability is off
         self.journal: Any = None
+        # fleet prefix store (attention_tpu/prefixstore); attached by
+        # the owning ReplicaHandle (or a test) — None keeps every
+        # intake/commit path byte-identical to the storeless engine
+        self.prefix_store: Any = None
         # request-trace coordinates (obs/trace.py).  A fronting
         # ReplicaHandle stamps these so engine-side events carry
         # (tick, replica, incarnation); standalone engines default to
@@ -373,6 +377,20 @@ class ServingEngine:
             )
         return prompt
 
+    def _import_prefix(self, prompt: tuple[int, ...]) -> int:
+        """Fleet prefix-store import at intake: before admission runs
+        its local `lookup_prefix`, splice any matching store chain
+        into the allocator so the lookup then hits.  A no-op without
+        an attached store; never raises (corruption is counted and
+        the request simply cold-prefills)."""
+        if self.prefix_store is None:
+            return 0
+        from attention_tpu.prefixstore.adapter import import_chain
+
+        return import_chain(
+            self, prompt, now=self.trace_start_tick + self._step
+        )
+
     def add_request(self, prompt, sampling: SamplingParams | None = None,
                     *, request_id: str | None = None,
                     arrival: int | None = None,
@@ -394,6 +412,7 @@ class ServingEngine:
             seq=seq,
             deadline_step=deadline_step,
         )
+        self._import_prefix(prompt)
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
         if _trace.active() and self.trace_owner == "engine":
@@ -450,6 +469,7 @@ class ServingEngine:
                 for _ in range(len(out)):
                     key, _ = jax.random.split(key)
                 self._rng_keys[request_id] = key
+        self._import_prefix(prompt)
         self._wall[req.request_id] = {"added": time.perf_counter()}
         self.scheduler.add(req)
         if self.journal is not None:
@@ -886,6 +906,17 @@ class ServingEngine:
             self.allocator.commit_prefix(
                 req.prompt, req.pages[:full], now=self._step
             )
+            if self.prefix_store is not None:
+                # fleet export rides the local commit: the pages just
+                # became shared-by-reference here, so publish them to
+                # the store (waiters on this chain's single-flight
+                # lease observe the chain and import next tick)
+                from attention_tpu.prefixstore.adapter import export_chain
+
+                export_chain(
+                    self, req.prompt, req.pages[:full],
+                    now=self.trace_start_tick + self._step,
+                )
 
     # -- token emission ---------------------------------------------------
 

@@ -40,9 +40,24 @@ The gray-failure work (ISSUE 10) adds the transient half:
   end records it on the replica's error streak and retries next tick;
   the `ReplicaSupervisor` escalates only when the streak persists.
 
+The global prefix tier (`attention_tpu.prefixstore`, ISSUE 17) adds
+the fleet-reuse half:
+
+* :class:`PrefixStoreCorruptError` — a content-addressed prefix
+  record failed validation (bad magic, CRC mismatch, truncated
+  payload).  The import path treats it exactly like
+  :class:`SnapshotCorruptError` treats a bad snapshot: drop the
+  entry, count it, fall back to cold prefill — wrong tokens are
+  never acceptable, a re-prefill always is.
+* :class:`PrefixLeaseError` — single-flight lease misuse (releasing
+  a lease another request holds, acquiring over a live foreign
+  lease).  Lease *expiry* is not an error — it is the deterministic
+  tick-driven escape hatch when a lease holder dies mid-prefill.
+
 All subclass RuntimeError, the `OutOfPagesError` lineage — the
 ATP401 contract (attention_tpu/analysis/errors.py) extends over
-``frontend/`` so generic raises cannot creep back in.
+``frontend/`` and ``prefixstore/`` so generic raises cannot creep
+back in.
 """
 
 from __future__ import annotations
@@ -111,3 +126,26 @@ class ReplicaStateError(RuntimeError):
     the caller must `kill()` first.  Kept distinct from
     :class:`ReplicaDeadError` (work routed at a *dead* replica) so
     chaos invariants can tell misuse from expected fail-stop."""
+
+
+class PrefixStoreCorruptError(RuntimeError):
+    """A fleet prefix-store record or store file failed validation.
+
+    Bad magic, unsupported version, truncated section, per-section
+    CRC mismatch, byte-accounting drift, or record metadata that does
+    not describe its own payload.  Raised by
+    `prefixstore.records.decode_record` / `prefixstore.store.load_store`;
+    the engine import path catches it, bumps ``prefixstore.corrupt``,
+    discards the poisoned entry, and falls back to cold prefill — a
+    corrupt record may cost a re-prefill, never a wrong token."""
+
+
+class PrefixLeaseError(RuntimeError):
+    """Single-flight prefix lease misuse.
+
+    Releasing a lease owned by a different request, or acquiring over
+    a live lease held by another owner, is a caller bug and raises
+    this.  Tick-driven lease *expiry* (the holder died mid-prefill)
+    is deliberately not an error: waiters observe the expired lease,
+    the next one in deterministic arrival order takes over, and the
+    storm still prefills at most once per lease generation."""

@@ -53,6 +53,7 @@ from attention_tpu.engine.engine import (
 )
 from attention_tpu.engine.errors import (
     DeadlineExceededError,
+    PrefixStoreCorruptError,
     ReplicaDeadError,
     RequestShedError,
     StepInterruptedError,
@@ -76,6 +77,14 @@ from attention_tpu.frontend.supervisor import (
     SupervisorState,
 )
 from attention_tpu.ops.paged import OutOfPagesError
+from attention_tpu.prefixstore.records import chain_key, chain_tokens
+from attention_tpu.prefixstore.store import (
+    STORE_FILENAME,
+    PrefixStore,
+    PrefixStoreConfig,
+    load_store,
+    save_store,
+)
 from attention_tpu.utils.profiling import RunRecord
 
 _SHED = obs.counter("frontend.shed.rejected",
@@ -242,6 +251,14 @@ class FrontendConfig:
     # honors.  Even when set it is passive bookkeeping; only the
     # advisory flag inside the policy makes it *log* (never act).
     forecast: ForecastPolicy | None = None
+    # global prefix tier (attention_tpu.prefixstore): None = disabled
+    # = byte-identical to the storeless front end.  When set, ONE
+    # shared `PrefixStore` is built for the fleet, every replica
+    # engine exports/imports through it, routing consults store hits,
+    # arrivals coalesce behind single-flight prefill leases, and —
+    # with snapshot_dir set — store state persists across warm
+    # restarts as its own CRC'd-section file
+    prefix_store: PrefixStoreConfig | None = None
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -276,6 +293,8 @@ class FrontendConfig:
         self.supervisor.validate()
         if self.forecast is not None:
             self.forecast.validate()
+        if self.prefix_store is not None:
+            self.prefix_store.validate()
 
 
 def _cumulative_series(pairs, n: int) -> list[float]:
@@ -395,6 +414,28 @@ class ServingFrontend:
         self.on_token = on_token
         self.on_finish = on_finish
 
+        # fleet prefix store: built (or warm-reloaded) BEFORE the
+        # replicas so every engine incarnation attaches to the one
+        # shared instance.  A corrupt persisted store is the same
+        # non-event a corrupt snapshot is: typed, counted, start cold.
+        self.prefix_store: PrefixStore | None = None
+        if config.prefix_store is not None:
+            path = (os.path.join(config.snapshot_dir, STORE_FILENAME)
+                    if config.snapshot_dir else None)
+            if path is not None and os.path.exists(path):
+                try:
+                    self.prefix_store = load_store(
+                        path, config.prefix_store)
+                except PrefixStoreCorruptError:
+                    self.prefix_store = PrefixStore(config.prefix_store)
+                    self.prefix_store.note_corrupt()
+            else:
+                self.prefix_store = PrefixStore(config.prefix_store)
+        #: requests coalesced behind a single-flight prefill lease,
+        #: re-evaluated each tick in seq order
+        self._store_wait: list[FrontendRequest] = []
+        self._coalesced_ids: set[str] = set()
+
         self.router = Router()
         self.ladder = DegradationLadder(config.degrade)
         self.supervisor = ReplicaSupervisor(config.supervisor)
@@ -453,6 +494,7 @@ class ServingFrontend:
             on_finish=self._on_engine_finish,
             on_timeout=self._on_engine_timeout,
             spare=spare,
+            prefix_store=self.prefix_store,
         )
 
     # -- intake -----------------------------------------------------------
@@ -566,12 +608,15 @@ class ServingFrontend:
         t = self._tick
         with obs.span("frontend.tick"):
             self._expire_queued(t)
+            self._heartbeat_leases(t)
+            self._admit_store_waiters(t)
             self._admit_arrivals(t)
             self._admit_retries(t)
             self._step_replicas(t)
             self._supervise(t)
             self._migrate_stalled(t)
             self._update_ladder_and_gauges(t)
+            self._persist_prefix_store(t)
         self._tick += 1
         return t
 
@@ -730,6 +775,13 @@ class ServingFrontend:
             self._pending.remove(fr)
         if fr in self._retry:
             self._retry.remove(fr)
+        if fr in self._store_wait:
+            self._store_wait.remove(fr)
+        if self.prefix_store is not None:
+            # a terminal leader frees its single-flight leases NOW
+            # (waiters take over next tick) instead of waiting out
+            # the tick-expiry window
+            self.prefix_store.leases.release_owner(fr.request_id)
         self._trace_event(fr, _TERMINAL_EVENT[state])
         if obs.enabled() and state is FrontendRequestState.FINISHED:
             labels = {"replica": fr.replica_id or "none"}
@@ -745,7 +797,8 @@ class ServingFrontend:
         """Deadline sweep over the FRONT-END queues (pending arrivals
         and the backoff queue); requests live on a replica are swept
         by that engine's own per-step deadline check."""
-        for fr in [f for f in (*self._pending, *self._retry)
+        for fr in [f for f in (*self._pending, *self._retry,
+                               *self._store_wait)
                    if f.deadline is not None and f.deadline <= t]:
             self.counts["deadline_expired"] += 1
             _DEADLINE_EXPIRED.inc()
@@ -803,12 +856,88 @@ class ServingFrontend:
             fr.next_retry = None
             self._assign(fr, t, exclude=fr.last_replica)
 
+    def _heartbeat_leases(self, t: int) -> None:
+        """A prefill lease belongs to a REQUEST, not a replica: while
+        the owning request is live the front end refreshes its leases
+        every tick, so a long prefill (many chunked steps) never loses
+        its flight to mere elapsed time, and a replica kill just moves
+        the same leader through the retry path.  Tick expiry is then
+        purely the dead-leader backstop — an owner that vanished
+        without its terminal release — which is exactly when waiters
+        MUST stop waiting."""
+        if self.prefix_store is None:
+            return
+        for key, owner in self.prefix_store.leases.active(now=t):
+            fr = self.requests.get(owner)
+            if fr is not None and not fr.is_terminal:
+                self.prefix_store.leases.acquire(key, owner, now=t)
+
+    def _admit_store_waiters(self, t: int) -> None:
+        """Re-evaluate every coalesced request (seq order): the leader
+        exporting its chain, its terminal release, or plain lease
+        expiry all flip the gate, and the waiter then assigns — almost
+        always straight into an import hit."""
+        if self.prefix_store is None or not self._store_wait:
+            return
+        waiting = sorted(self._store_wait, key=lambda f: f.seq)
+        self._store_wait = []
+        for fr in waiting:
+            if not fr.is_terminal:
+                self._assign(fr, t)
+
+    def _store_gate(self, fr: FrontendRequest, t: int) -> bool:
+        """Single-flight de-dup: True = proceed to routing, False =
+        coalesced into ``_store_wait`` behind another request's
+        prefill lease.  Deterministic: every input is the tick clock,
+        the store's contents, and seq order."""
+        store = self.prefix_store
+        if store is None or fr.tokens:
+            return True   # resumes re-prefill their own stream
+        ps = self.engine_config.page_size
+        key_toks = chain_tokens(fr.prompt, ps)
+        if key_toks is None:
+            return True   # no full page is shareable
+        if store.has_chain(fr.prompt, ps, now=t):
+            return True   # import will serve it
+        if any(h.alive and h.peek_prefix_pages(fr.prompt) > 0
+               for h in self.replicas):
+            return True   # a replica holds it locally; affinity routes
+        key = chain_key(key_toks)
+        owner = store.leases.holder(key, now=t)
+        if owner is None or owner == fr.request_id:
+            store.leases.acquire(key, fr.request_id, now=t)
+            return True   # this request leads the flight
+        if fr.request_id not in self._coalesced_ids:
+            self._coalesced_ids.add(fr.request_id)
+            store.note_coalesced()
+        self._store_wait.append(fr)
+        return False
+
+    def _persist_prefix_store(self, t: int) -> None:
+        """Store durability rides the snapshot cadence: with both a
+        store and a snapshot directory configured, the whole store
+        lands as its own CRC'd-section file every ``snapshot_every``
+        ticks — same atomic write discipline as engine snapshots, so
+        a warm fleet restart reloads the prefix tier too."""
+        if (self.prefix_store is None
+                or self.config.snapshot_dir is None
+                or self.config.snapshot_every is None
+                or (t + 1) % self.config.snapshot_every != 0):
+            return
+        save_store(
+            self.prefix_store,
+            os.path.join(self.config.snapshot_dir, STORE_FILENAME),
+        )
+
     def _assign(self, fr: FrontendRequest, t: int,
                 exclude: str | None = None) -> None:
+        if not self._store_gate(fr, t):
+            return
         decision = self.router.route(
             fr.prompt, self.replicas, session=fr.session,
             exclude=exclude,
             eligible=self.supervisor.eligible_ids(self.replicas),
+            store=self.prefix_store, now=t,
         )
         if decision is None:
             # nothing admissible (dead, or gated by the supervisor):
@@ -1165,6 +1294,19 @@ class ServingFrontend:
                     if fr.state is FrontendRequestState.FINISHED]
         fin_prompt = sum(len(fr.prompt) for fr in finished)
         fin_cached = sum(fr.prefix_cached_tokens for fr in finished)
+        store_block: dict[str, Any] = {}
+        if self.prefix_store is not None:
+            st = self.prefix_store
+            store_block["prefixstore"] = {
+                **{k: st.counts[k] for k in sorted(st.counts)},
+                "entries": len(st),
+                "bytes": st.total_bytes,
+                # the fleet-level rate: local affinity hits PLUS
+                # store-imported chains, over finished prompt tokens
+                "fleet_prefix_hit_rate": round(
+                    fin_cached / fin_prompt, 4) if fin_prompt else 0.0,
+                "imported_tokens": st.counts["import_tokens"],
+            }
         return {
             "ticks": self._tick,
             "num_requests": len(frs),
@@ -1186,6 +1328,7 @@ class ServingFrontend:
             "degrade_level": self.ladder.level,
             "degrade_step_downs": self.ladder.step_downs,
             "degrade_recoveries": self.ladder.recoveries,
+            **store_block,
             **self.counts,
         }
 

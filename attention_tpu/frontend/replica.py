@@ -69,7 +69,8 @@ class ReplicaHandle:
                  on_token: Callable[[Request, int], None] | None = None,
                  on_finish: Callable[[Request], None] | None = None,
                  on_timeout: Callable[[Request], None] | None = None,
-                 spare: bool = False):
+                 spare: bool = False,
+                 prefix_store: Any = None):
         self.replica_id = replica_id
         self.model = model
         self.params = params
@@ -78,6 +79,12 @@ class ReplicaHandle:
         self.deaths = 0
         self.snapshot_dir = snapshot_dir
         self.snapshot_every = snapshot_every
+        #: fleet prefix store (attention_tpu/prefixstore) every engine
+        #: incarnation of this replica attaches to — the store OUTLIVES
+        #: kills by design (host bytes, not device state), which is
+        #: exactly how a restarted replica re-imports hot prefixes
+        #: instead of re-prefilling them
+        self.prefix_store = prefix_store
         #: "warm" | "cold" | None — how the last restart came back
         self.last_restart_mode: str | None = None
         #: why the last warm restart fell back cold (None after a
@@ -100,6 +107,7 @@ class ReplicaHandle:
         engine = ServingEngine(self.model, self.params, self.config,
                                on_token=on_token, on_finish=on_finish,
                                on_timeout=on_timeout)
+        engine.prefix_store = self.prefix_store
         self._attach_snapshots(engine)
         self._stamp_trace(engine)
         return engine
@@ -196,6 +204,7 @@ class ReplicaHandle:
                 # the restored engine keeps its own step counter, so
                 # anchor the clock translation at its restored step
                 self.start_tick = tick - engine.current_step
+                engine.prefix_store = self.prefix_store
                 self._engine = engine
                 self._attach_snapshots(engine)
                 self._stamp_trace(engine)

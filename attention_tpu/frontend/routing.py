@@ -9,13 +9,20 @@ strict deterministic priority order:
    (side-effect-free `peek_prefix`) and route to the one holding the
    longest committed page prefix of this prompt.  Cache hits survive
    routing by construction.
-2. **Session stickiness** — a request carrying a ``session`` tag
+2. **Store hit** — with a fleet prefix store attached
+   (`attention_tpu.prefixstore`, ISSUE 17) and no replica holding a
+   LONGER local chain, a store chain hit means ANY geometry-compatible
+   replica can import the pages at admission; route least-loaded
+   (spreading the herd is now free) and let the import do the rest.
+   A strictly longer local chain still wins — pages already resident
+   beat pages that must be copied in.
+3. **Session stickiness** — a request carrying a ``session`` tag
    follows its predecessors' replica.  This covers the window where a
    tenant's first request is still PREFILLING: its prefix is not
    committed yet, so a naive prefix-probe scatters the burst across
    replicas and the cache never forms.  Stickiness holds the herd
    together until the prefix lands.
-3. **Least-loaded fallback** — smallest ``(queue_len, used_pages,
+4. **Least-loaded fallback** — smallest ``(queue_len, used_pages,
    replica index)`` among alive replicas; the index tiebreak keeps
    placement deterministic.
 
@@ -42,13 +49,21 @@ _ROUTE_STICKY = obs.counter("frontend.route.sticky_session",
                             "requests routed by session stickiness")
 _ROUTE_LOAD = obs.counter("frontend.route.least_loaded",
                           "requests routed by the load fallback")
+_ROUTE_STORE = obs.counter("frontend.route.store_hit",
+                           "requests routed on a fleet prefix-store hit")
 
 
 @dataclasses.dataclass(frozen=True)
 class RouteDecision:
     replica: ReplicaHandle
-    reason: str              # "prefix" | "sticky" | "least_loaded"
+    reason: str           # "prefix" | "store" | "sticky" | "least_loaded"
     prefix_pages: int = 0
+
+
+def store_page_size(replicas: Sequence[ReplicaHandle]) -> int:
+    """The fleet's page size for store-chain probes (every replica is
+    built from ONE `EngineConfig`, so the handles agree)."""
+    return replicas[0].config.page_size if replicas else 1
 
 
 class Router:
@@ -69,6 +84,7 @@ class Router:
               session: str | None = None,
               exclude: str | None = None,
               eligible: frozenset[str] | set[str] | None = None,
+              store=None, now: int = 0,
               ) -> RouteDecision | None:
         """Pick a replica for ``prompt`` (None when nothing is alive).
 
@@ -89,9 +105,28 @@ class Router:
             pages = r.peek_prefix_pages(prompt)
             if pages > best_pages:
                 best, best_pages = r, pages
-        if best is not None:
+        store_pages = (store.peek_chain(
+            prompt, store_page_size(replicas), now=now)
+            if store is not None else 0)
+        if best is not None and best_pages > store_pages:
             decision = RouteDecision(best, "prefix", best_pages)
             _ROUTE_PREFIX.inc()
+        elif store_pages > 0:
+            # the chain imports anywhere geometry-compatible, so a
+            # store hit makes every alive replica equally cheap: pick
+            # by load first (a storm spreads instead of serializing
+            # on the local holder), then prefer the replica already
+            # holding the chain (resident pages beat a copy), then
+            # the deterministic id tiebreak
+            chosen = min(
+                preferred,
+                key=lambda r: (r.queue_len(),
+                               r is not best,
+                               r.load()["used_pages"],
+                               r.replica_id),
+            )
+            decision = RouteDecision(chosen, "store", store_pages)
+            _ROUTE_STORE.inc()
         else:
             sticky_id = self._sessions.get(session) if session else None
             sticky = next((r for r in preferred

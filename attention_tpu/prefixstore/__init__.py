@@ -1,0 +1,46 @@
+"""Global prefix tier: content-addressed fleet-wide KV reuse.
+
+The page-granular prefix cache (PR 2) dies with its replica; at fleet
+scale that means N replicas each re-prefilling the same hot system
+prompts, few-shot preambles, and RAG headers.  This package lifts the
+cache one level: committed prefix pages are exported as CRC'd records
+keyed by token-chain hash into one shared in-process `PrefixStore`,
+imported on miss by any geometry-compatible replica, and guarded by
+tick-expiring single-flight leases so a storm of identical prompts
+prefills exactly once fleet-wide.
+
+    records.py   one page = one self-validating record (snapshot
+                 section format; per-shard ``pools.<s>`` head slices)
+    store.py     budgeted TTL+LRU record store, obs counters,
+                 snapshot-grade durable save/load
+    lease.py     deterministic tick-expiring single-flight leases
+    adapter.py   engine seams: export on commit, import before prefill
+
+Integrity doctrine, same as snapshots: corruption is a typed
+`PrefixStoreCorruptError` and costs a re-prefill; geometry or
+fingerprint mismatch is a miss; wrong tokens are never acceptable.
+"""
+
+from attention_tpu.prefixstore.adapter import (  # noqa: F401
+    engine_geometry,
+    export_chain,
+    fleet_fingerprint,
+    import_chain,
+)
+from attention_tpu.prefixstore.lease import LeaseTable  # noqa: F401
+from attention_tpu.prefixstore.records import (  # noqa: F401
+    PrefixRecord,
+    chain_key,
+    chain_tokens,
+    decode_record,
+    encode_record,
+    page_geometry,
+)
+from attention_tpu.prefixstore.store import (  # noqa: F401
+    STORE_FILENAME,
+    PrefixStore,
+    PrefixStoreConfig,
+    load_store,
+    save_store,
+    serialize_store,
+)

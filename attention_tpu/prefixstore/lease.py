@@ -1,0 +1,114 @@
+"""Single-flight prefill leases: a storm prefills once fleet-wide.
+
+When K identical prompts arrive across replicas in the same window,
+only the first should pay the prefill; the rest wait and import the
+exported pages.  The coordination primitive is a *lease* on the token
+chain's content key: the first request (deterministic arrival order)
+acquires it, routes, prefills, and commits — at which point the
+exported chain lands in the store and every waiter's "is it there
+yet" check flips to yes.  Waiters never block a tick loop; they sit
+in the frontend's store-wait queue and re-check each tick.
+
+Failure is handled by TICK-DRIVEN EXPIRY, not by error paths: a lease
+holder that dies mid-prefill (replica kill, shed, timeout) simply
+stops refreshing, the lease expires ``lease_ticks`` after acquisition,
+and the next waiter in arrival order takes over.  Expiry is computed
+from the frontend tick clock only, so the same seed storms the same
+way every run.  Misuse — releasing someone else's lease, acquiring
+over a live foreign lease — raises the typed `PrefixLeaseError`;
+those are caller bugs, not fleet weather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from attention_tpu.engine.errors import PrefixLeaseError
+
+
+@dataclasses.dataclass
+class _Lease:
+    key: str        # chain_key of the token prefix being prefilled
+    owner: str      # frontend request id holding the flight
+    acquired: int   # tick the current owner took (or refreshed) it
+    expires: int    # first tick at which the lease no longer holds
+
+
+class LeaseTable:
+    """Tick-expiring single-flight registry, keyed by chain hash."""
+
+    def __init__(self, lease_ticks: int):
+        if lease_ticks < 1:
+            raise ValueError(
+                f"lease_ticks must be >= 1, got {lease_ticks}"
+            )
+        self.lease_ticks = lease_ticks
+        self._leases: dict[str, _Lease] = {}
+
+    def __len__(self) -> int:
+        return len(self._leases)
+
+    def expire(self, *, now: int) -> int:
+        """Drop every lease whose window has closed; returns count."""
+        dead = sorted(k for k, l in self._leases.items()
+                      if l.expires <= now)
+        for k in dead:
+            del self._leases[k]
+        return len(dead)
+
+    def holder(self, key: str, *, now: int) -> str | None:
+        """Current live owner of ``key``, expiring lazily."""
+        lease = self._leases.get(key)
+        if lease is None:
+            return None
+        if lease.expires <= now:
+            del self._leases[key]
+            return None
+        return lease.owner
+
+    def acquire(self, key: str, owner: str, *, now: int) -> None:
+        """Take (or refresh) the flight on ``key`` for ``owner``.
+
+        Re-acquiring one's own lease refreshes the expiry — a retried
+        leader keeps leading.  Acquiring over a live foreign lease is
+        misuse (callers must consult `holder` first and coalesce)."""
+        current = self.holder(key, now=now)
+        if current is not None and current != owner:
+            raise PrefixLeaseError(
+                f"lease on {key[:12]}… is held by {current!r}; "
+                f"{owner!r} must coalesce behind it, not acquire"
+            )
+        self._leases[key] = _Lease(
+            key=key, owner=owner, acquired=now,
+            expires=now + self.lease_ticks,
+        )
+
+    def release(self, key: str, owner: str, *, now: int) -> None:
+        """Give up ``key``.  Releasing an absent/expired lease is a
+        no-op (finalize paths are idempotent); releasing a live lease
+        someone ELSE holds is misuse."""
+        current = self.holder(key, now=now)
+        if current is None:
+            return
+        if current != owner:
+            raise PrefixLeaseError(
+                f"lease on {key[:12]}… is held by {current!r}, "
+                f"not releaser {owner!r}"
+            )
+        del self._leases[key]
+
+    def release_owner(self, owner: str) -> int:
+        """Drop every lease ``owner`` holds (the frontend's terminal
+        funnel calls this, so a finished/shed leader frees its flights
+        immediately instead of waiting out the expiry window)."""
+        dead = sorted(k for k, l in self._leases.items()
+                      if l.owner == owner)
+        for k in dead:
+            del self._leases[k]
+        return len(dead)
+
+    def active(self, *, now: int) -> list[tuple[str, str]]:
+        """Live ``(key, owner)`` pairs in sorted key order — the chaos
+        lease-holder-kill injector's target list."""
+        self.expire(now=now)
+        return sorted((l.key, l.owner) for l in self._leases.values())

@@ -1,0 +1,229 @@
+"""Content-addressed prefix-page records: one page of KV, CRC'd.
+
+The wire unit of the global prefix tier.  A record holds the exported
+K/V payload of ONE committed prefix page together with everything a
+stranger replica needs to decide whether the page is usable:
+
+* the exact token chain the page's KV encodes (``tokens``) — the
+  store is keyed by the chain's sha256, but the importer re-checks the
+  full token tuple, so a hash collision degrades to a miss, never to
+  another prompt's KV (the allocator's exact-tuple-key discipline,
+  lifted fleet-wide);
+* the exporter's fleet fingerprint (`adapter.fleet_fingerprint`:
+  architecture PLUS a params digest — store records cross fleet
+  boundaries, so same-architecture different-weights models must not
+  exchange KV) and pool geometry — any mismatch is a MISS (cold-start
+  cue), never corruption;
+* per-shard head slices in the snapshot ``pools.<s>`` layout: an
+  S-shard mesh exporter writes S sections, each the shard's contiguous
+  KV-head slice of every per-layer page array, independently CRC'd.
+  The importer reassembles along the head dim and re-places on its own
+  mesh, so shard-count mismatch between exporter and importer is fine
+  by construction — only *geometry* (heads/page_size/head_dim/layers/
+  dtype) gates reuse.
+
+On disk/in store: one ASCII JSON manifest line (magic, version,
+shards, per-section byte counts and CRC32s) followed by concatenated
+section payloads — the PR 9 snapshot format in miniature.  Any
+structural damage raises the typed `PrefixStoreCorruptError`; the
+import path treats that as "drop the entry, re-prefill", because a
+corrupt record may cost compute but never a wrong token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import zlib
+
+import numpy as np
+
+from attention_tpu.engine.errors import PrefixStoreCorruptError
+from attention_tpu.engine.snapshot import _jbytes, _np_dtype
+
+RECORD_MAGIC = "atp-prefixrec"
+RECORD_VERSION = 1
+
+
+def chain_key(tokens) -> str:
+    """Content address of one token chain: sha256 over the canonical
+    JSON encoding of the token list.  Collisions are defended against
+    at import time (records carry the full chain), so the digest is an
+    index key, not a correctness boundary."""
+    return hashlib.sha256(_jbytes([int(t) for t in tokens])).hexdigest()
+
+
+def chain_tokens(tokens, page_size: int) -> tuple[int, ...] | None:
+    """The shareable page-aligned prefix of ``tokens`` — the longest
+    whole-page chain that still leaves >= 1 token for the prefill that
+    produces first-token logits (the allocator's ``(n-1)//page_size``
+    limit).  None when no full page is shareable."""
+    toks = tuple(int(t) for t in tokens)
+    limit = (len(toks) - 1) // page_size
+    if limit < 1:
+        return None
+    return toks[: limit * page_size]
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixRecord:
+    """One decoded prefix page: validated metadata + host arrays."""
+
+    tokens: tuple[int, ...]          # exact chain this page completes
+    fingerprint: dict                # exporter's model_fingerprint
+    geometry: dict                   # page geometry (see page_geometry)
+    arrays: tuple                    # 2*layers np arrays, K then V,
+    #                                  each (num_kv_heads, page_size,
+    #                                  head_dim)
+
+
+def page_geometry(*, num_kv_heads: int, page_size: int, head_dim: int,
+                  layers: int, dtype: str) -> dict:
+    """The reuse gate: two engines may exchange pages iff this dict
+    (plus the model fingerprint) matches exactly."""
+    return {
+        "num_kv_heads": int(num_kv_heads),
+        "page_size": int(page_size),
+        "head_dim": int(head_dim),
+        "layers": int(layers),
+        "dtype": str(dtype),
+    }
+
+
+def encode_record(*, tokens, arrays, fingerprint: dict, geometry: dict,
+                  shards: int = 1) -> bytes:
+    """Serialize one page as a self-validating record.
+
+    ``arrays``: the page's 2*layers host arrays (K pools then V
+    pools), each ``(num_kv_heads, page_size, head_dim)``.  ``shards``
+    writes that many ``pools.<s>`` head-slice sections — the exporting
+    mesh engine's native layout."""
+    heads = geometry["num_kv_heads"]
+    if shards < 1 or heads % shards:
+        raise ValueError(
+            f"shards {shards} does not divide num_kv_heads {heads}"
+        )
+    meta = {
+        "tokens": [int(t) for t in tokens],
+        "fingerprint": fingerprint,
+        "geometry": geometry,
+    }
+    hh = heads // shards
+    hosted = [np.asarray(a) for a in arrays]
+    sections = [("meta", _jbytes(meta))] + [
+        (f"pools.{s}",
+         b"".join(a[s * hh:(s + 1) * hh].tobytes() for a in hosted))
+        for s in range(shards)
+    ]
+    manifest = {
+        "magic": RECORD_MAGIC,
+        "version": RECORD_VERSION,
+        "shards": shards,
+        "sections": [
+            {"name": name, "nbytes": len(payload),
+             "crc32": zlib.crc32(payload)}
+            for name, payload in sections
+        ],
+    }
+    return (_jbytes(manifest) + b"\n"
+            + b"".join(payload for _, payload in sections))
+
+
+def _corrupt(why: str) -> PrefixStoreCorruptError:
+    return PrefixStoreCorruptError(f"prefix record: {why}")
+
+
+def _read_sections(blob: bytes) -> tuple[dict, dict[str, bytes]]:
+    """Manifest + checksummed sections, or the typed corrupt raise."""
+    nl = blob.find(b"\n")
+    if nl < 0:
+        raise _corrupt("no manifest line")
+    try:
+        manifest = json.loads(blob[:nl])
+    except ValueError:
+        raise _corrupt("unparseable manifest")
+    if not isinstance(manifest, dict) \
+            or manifest.get("magic") != RECORD_MAGIC:
+        raise _corrupt("bad magic (not a prefix record)")
+    if manifest.get("version") != RECORD_VERSION:
+        raise _corrupt(
+            f"unsupported record version {manifest.get('version')!r} "
+            f"(reader speaks {RECORD_VERSION})"
+        )
+    shards = manifest.get("shards", 1)
+    if not isinstance(shards, int) or isinstance(shards, bool) \
+            or shards < 1:
+        raise _corrupt(f"bad shards count {shards!r}")
+    try:
+        entries = [(s["name"], int(s["nbytes"]), int(s["crc32"]))
+                   for s in manifest["sections"]]
+    except (KeyError, TypeError, ValueError):
+        raise _corrupt("malformed section table")
+    sections: dict[str, bytes] = {}
+    offset = nl + 1
+    for name, nbytes, crc in entries:
+        payload = blob[offset:offset + nbytes]
+        if len(payload) != nbytes:
+            raise _corrupt(
+                f"section {name!r} truncated "
+                f"({len(payload)}/{nbytes} bytes)"
+            )
+        if zlib.crc32(payload) != crc:
+            raise _corrupt(f"section {name!r} checksum mismatch")
+        sections[name] = payload
+        offset += nbytes
+    if offset != len(blob):
+        raise _corrupt(f"{len(blob) - offset} trailing bytes")
+    required = ("meta", *(f"pools.{s}" for s in range(shards)))
+    for name in required:
+        if name not in sections:
+            raise _corrupt(f"missing section {name!r}")
+    return manifest, sections
+
+
+def decode_record(blob: bytes) -> PrefixRecord:
+    """Validate + reassemble one record; `PrefixStoreCorruptError` on
+    any structural damage.  Shard slices are concatenated back along
+    the head dim, so the decoded arrays are shard-count agnostic."""
+    manifest, sections = _read_sections(blob)
+    shards = manifest.get("shards", 1)
+    try:
+        meta = json.loads(sections["meta"])
+        tokens = tuple(int(t) for t in meta["tokens"])
+        fingerprint = meta["fingerprint"]
+        geometry = meta["geometry"]
+        heads = int(geometry["num_kv_heads"])
+        page_size = int(geometry["page_size"])
+        head_dim = int(geometry["head_dim"])
+        layers = int(geometry["layers"])
+        dtype = _np_dtype(geometry["dtype"])
+    except (KeyError, TypeError, ValueError):
+        raise _corrupt("undecodable meta section")
+    if heads < 1 or heads % shards:
+        raise _corrupt(
+            f"shards {shards} does not divide num_kv_heads {heads}"
+        )
+    hh = heads // shards
+    slice_bytes = hh * page_size * head_dim * dtype.itemsize
+    per_shard = []
+    for s in range(shards):
+        payload = sections[f"pools.{s}"]
+        if len(payload) != 2 * layers * slice_bytes:
+            raise _corrupt(
+                f"section 'pools.{s}' carries {len(payload)} bytes, "
+                f"geometry implies {2 * layers * slice_bytes}"
+            )
+        per_shard.append([
+            np.frombuffer(
+                payload[i * slice_bytes:(i + 1) * slice_bytes], dtype
+            ).reshape(hh, page_size, head_dim)
+            for i in range(2 * layers)
+        ])
+    arrays = tuple(
+        np.concatenate([per_shard[s][i] for s in range(shards)], axis=0)
+        if shards > 1 else per_shard[0][i]
+        for i in range(2 * layers)
+    )
+    return PrefixRecord(tokens=tokens, fingerprint=fingerprint,
+                        geometry=geometry, arrays=arrays)

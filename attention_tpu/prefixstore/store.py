@@ -1,0 +1,420 @@
+"""In-process fleet prefix store: content-addressed, budgeted, durable.
+
+The backend of the global prefix tier.  One `PrefixStore` is shared by
+every replica of a fleet (the frontend constructs it and hands it to
+each `ReplicaHandle`): engines *export* committed prompt pages into it
+as CRC'd `records` blobs keyed by token-chain hash, and *import* on a
+local prefix-cache miss before paying a cold prefill.  The store never
+holds live device memory — records are host bytes, so a store entry
+survives its exporting replica's death, which is the whole point.
+
+Budget discipline mirrors the allocator's: a byte budget with LRU
+eviction (victim = oldest ``(last_use, key)``), plus TTL expiry on the
+fleet tick clock so a hot entry must stay hot.  Both clocks are ticks,
+never wall time — same seed, same evictions, byte-identical summaries.
+
+Counters follow the two-tier obs convention: plain-int mirrors in
+``counts`` feed deterministic summaries regardless of whether
+telemetry is enabled, and the ``prefixstore.*`` instruments publish
+the same increments under the zero-overhead contract.
+
+Durability: `save_store`/`load_store` persist the whole store as one
+file in the PR 9 snapshot format — a manifest line plus CRC'd
+``meta``/``records`` sections — written with the same
+mkstemp/fsync/replace/dir-fsync discipline as engine snapshots.  A
+fleet warm restart reloads it; any validation failure raises the typed
+`PrefixStoreCorruptError` and the frontend starts a fresh store (cold
+cache, never a crash, never wrong bytes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import zlib
+
+from attention_tpu import obs
+from attention_tpu.engine.errors import PrefixStoreCorruptError
+from attention_tpu.engine.snapshot import _fsync_dir, _jbytes
+from attention_tpu.prefixstore.lease import LeaseTable
+from attention_tpu.prefixstore.records import chain_key, chain_tokens
+
+STORE_MAGIC = "atp-prefixstore"
+STORE_VERSION = 1
+#: the store's on-disk name inside a fleet snapshot directory — a
+#: sibling of the per-replica snapshot subdirs, never matched by the
+#: engine's ``snap-*`` scan
+STORE_FILENAME = "prefixstore.atpstore"
+
+_EXPORTS = obs.counter("prefixstore.exports",
+                       "prefix-page records published to the store")
+_IMPORTS = obs.counter("prefixstore.imports",
+                       "chain imports spliced into an allocator")
+_IMPORT_TOKENS = obs.counter("prefixstore.import_tokens",
+                             "prompt tokens covered by imported pages")
+_EVICTIONS = obs.counter("prefixstore.evictions",
+                         "records dropped by TTL or the byte budget")
+_CORRUPT = obs.counter("prefixstore.corrupt",
+                       "records that failed validation (typed, "
+                       "re-prefilled)")
+_COALESCED = obs.counter("prefixstore.singleflight_coalesced",
+                         "requests that waited behind a prefill lease "
+                         "instead of prefilling")
+_BYTES_GAUGE = obs.gauge("prefixstore.bytes",
+                         "bytes of record payloads currently held")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixStoreConfig:
+    """Knobs of one fleet store; validated at frontend construction."""
+
+    #: record-payload byte budget; LRU eviction keeps the store under it
+    max_bytes: int = 1 << 22
+    #: ticks an untouched record survives; None = no TTL
+    ttl_ticks: int | None = 256
+    #: single-flight lease window — a dead leader unblocks waiters
+    #: this many ticks after its last acquire/refresh
+    lease_ticks: int = 16
+
+    def validate(self) -> None:
+        if self.max_bytes < 1:
+            raise ValueError(
+                f"max_bytes must be >= 1, got {self.max_bytes}"
+            )
+        if self.ttl_ticks is not None and self.ttl_ticks < 1:
+            raise ValueError(
+                f"ttl_ticks must be >= 1 or None, got {self.ttl_ticks}"
+            )
+        if self.lease_ticks < 1:
+            raise ValueError(
+                f"lease_ticks must be >= 1, got {self.lease_ticks}"
+            )
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: str
+    blob: bytes
+    nbytes: int
+    created: int      # tick of first publication (TTL clock)
+    last_use: int     # tick of last get/touch (LRU clock)
+    seq: int          # insertion order (serialization order)
+
+
+class PrefixStore:
+    """Content-addressed record store + its single-flight lease table."""
+
+    def __init__(self, config: PrefixStoreConfig | None = None):
+        self.config = config or PrefixStoreConfig()
+        self.config.validate()
+        self._entries: dict[str, _Entry] = {}
+        self._seq = 0
+        self.total_bytes = 0
+        self.leases = LeaseTable(self.config.lease_ticks)
+        # plain-int mirrors: deterministic summary inputs whether or
+        # not telemetry is on (the obs zero-overhead contract)
+        self.counts: dict[str, int] = {
+            "exports": 0,
+            "imports": 0,
+            "import_tokens": 0,
+            "evictions": 0,
+            "corrupt": 0,
+            "singleflight_coalesced": 0,
+        }
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    # -- budget ------------------------------------------------------------
+
+    def _expired(self, entry: _Entry, now: int) -> bool:
+        ttl = self.config.ttl_ticks
+        return ttl is not None and entry.created + ttl <= now
+
+    def _drop(self, key: str, *, count: bool = True) -> None:
+        entry = self._entries.pop(key)
+        self.total_bytes -= entry.nbytes
+        if count:
+            self.counts["evictions"] += 1
+            _EVICTIONS.inc()
+        _BYTES_GAUGE.set(float(self.total_bytes))
+
+    def expire(self, *, now: int) -> int:
+        """Drop every TTL-expired record; returns how many."""
+        dead = sorted(k for k, e in self._entries.items()
+                      if self._expired(e, now))
+        for k in dead:
+            self._drop(k)
+        return len(dead)
+
+    def evict_lru(self) -> str | None:
+        """Evict the least-recently-used record (tie-break by key, the
+        allocator's ``(last_use, key)`` discipline); None when empty."""
+        if not self._entries:
+            return None
+        victim = min(self._entries.values(),
+                     key=lambda e: (e.last_use, e.key))
+        self._drop(victim.key)
+        return victim.key
+
+    def evict_all(self) -> int:
+        """Drop everything (the chaos eviction-storm injector); every
+        drop counts as an eviction."""
+        n = len(self._entries)
+        for key in sorted(self._entries):
+            self._drop(key)
+        return n
+
+    # -- records -----------------------------------------------------------
+
+    def put(self, key: str, blob: bytes, *, now: int) -> bool:
+        """Publish one record under ``key``; True when newly stored.
+
+        An existing key is only touched (the first publisher's copy
+        stays canonical — content-addressed, so they agree anyway).
+        TTL expiry runs first, then LRU eviction until the blob fits;
+        a blob larger than the whole budget is refused."""
+        entry = self._entries.get(key)
+        if entry is not None and not self._expired(entry, now):
+            entry.last_use = now
+            return False
+        self.expire(now=now)
+        if len(blob) > self.config.max_bytes:
+            return False
+        while self.total_bytes + len(blob) > self.config.max_bytes:
+            self.evict_lru()
+        self._entries[key] = _Entry(
+            key=key, blob=blob, nbytes=len(blob),
+            created=now, last_use=now, seq=self._seq,
+        )
+        self._seq += 1
+        self.total_bytes += len(blob)
+        self.counts["exports"] += 1
+        _EXPORTS.inc()
+        _BYTES_GAUGE.set(float(self.total_bytes))
+        return True
+
+    def get(self, key: str, *, now: int) -> bytes | None:
+        """The record bytes under ``key`` (LRU touch), or None."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        if self._expired(entry, now):
+            self._drop(key)
+            return None
+        entry.last_use = now
+        return entry.blob
+
+    def peek(self, key: str, *, now: int) -> bool:
+        """Is ``key`` live, WITHOUT touching its LRU clock — the
+        router-probe discipline (`BlockAllocator.peek_prefix`): losing
+        a routing race must not refresh an entry."""
+        entry = self._entries.get(key)
+        return entry is not None and not self._expired(entry, now)
+
+    def discard(self, key: str) -> None:
+        """Drop ``key`` if present (does not count as an eviction —
+        used when an importer found the record corrupt)."""
+        if key in self._entries:
+            self._drop(key, count=False)
+
+    # -- chain probes ------------------------------------------------------
+
+    def peek_chain(self, tokens, page_size: int, *, now: int) -> int:
+        """Records present for the longest contiguous page chain of
+        ``tokens``'s shareable prefix; side-effect-free (routing and
+        the single-flight gate both call this every tick)."""
+        toks = chain_tokens(tokens, page_size)
+        if toks is None:
+            return 0
+        n = 0
+        for i in range(page_size, len(toks) + 1, page_size):
+            if not self.peek(chain_key(toks[:i]), now=now):
+                break
+            n += 1
+        return n
+
+    def has_chain(self, tokens, page_size: int, *, now: int) -> bool:
+        """Does the store hold the WHOLE shareable chain of ``tokens``
+        (the single-flight waiters' release condition)?"""
+        toks = chain_tokens(tokens, page_size)
+        if toks is None:
+            return True  # nothing shareable: nothing to wait for
+        return self.peek_chain(tokens, page_size, now=now) \
+            == len(toks) // page_size
+
+    # -- counter hooks (adapter/frontend call sites) -----------------------
+
+    def note_import(self, *, pages: int, tokens: int) -> None:
+        self.counts["imports"] += 1
+        self.counts["import_tokens"] += tokens
+        _IMPORTS.inc()
+        _IMPORT_TOKENS.inc(tokens)
+
+    def note_corrupt(self, key: str | None = None) -> None:
+        """A record (or, with no ``key``, the persisted store file)
+        failed validation: count it and drop the entry so the next
+        miss re-prefills and re-publishes clean bytes."""
+        self.counts["corrupt"] += 1
+        _CORRUPT.inc()
+        if key is not None:
+            self.discard(key)
+
+    def note_coalesced(self) -> None:
+        self.counts["singleflight_coalesced"] += 1
+        _COALESCED.inc()
+
+
+# -- durability ------------------------------------------------------------
+
+
+def serialize_store(store: PrefixStore) -> bytes:
+    """Deterministic store bytes: manifest line + CRC'd ``meta`` and
+    ``records`` sections (records concatenated in insertion order)."""
+    entries = sorted(store._entries.values(), key=lambda e: e.seq)
+    meta = {
+        "seq": store._seq,
+        "counts": {k: store.counts[k] for k in sorted(store.counts)},
+        "entries": [
+            {"key": e.key, "nbytes": e.nbytes, "created": e.created,
+             "last_use": e.last_use, "seq": e.seq}
+            for e in entries
+        ],
+    }
+    sections = [("meta", _jbytes(meta)),
+                ("records", b"".join(e.blob for e in entries))]
+    manifest = {
+        "magic": STORE_MAGIC,
+        "version": STORE_VERSION,
+        "sections": [
+            {"name": name, "nbytes": len(payload),
+             "crc32": zlib.crc32(payload)}
+            for name, payload in sections
+        ],
+    }
+    return (_jbytes(manifest) + b"\n"
+            + b"".join(payload for _, payload in sections))
+
+
+def save_store(store: PrefixStore, path: str) -> dict:
+    """Write the store durably and atomically (the snapshot
+    mkstemp/fsync/replace/dir-fsync discipline); ``{path, nbytes}``."""
+    blob = serialize_store(store)
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(directory)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return {"path": path, "nbytes": len(blob)}
+
+
+def _corrupt_file(path: str, why: str) -> PrefixStoreCorruptError:
+    return PrefixStoreCorruptError(f"{path}: {why}")
+
+
+def load_store(path: str,
+               config: PrefixStoreConfig | None = None) -> PrefixStore:
+    """Reconstruct a store from ``path``; `PrefixStoreCorruptError` on
+    any validation failure (the frontend's cue to start cold).
+
+    Record blobs are NOT decoded here — each carries its own CRCs and
+    is re-validated at import time, so a single poisoned record costs
+    one re-prefill later, not the whole store now."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise _corrupt_file(path, f"unreadable: {e}")
+    nl = blob.find(b"\n")
+    if nl < 0:
+        raise _corrupt_file(path, "no manifest line")
+    try:
+        manifest = json.loads(blob[:nl])
+    except ValueError:
+        raise _corrupt_file(path, "unparseable manifest")
+    if not isinstance(manifest, dict) \
+            or manifest.get("magic") != STORE_MAGIC:
+        raise _corrupt_file(path, "bad magic (not a prefix store)")
+    if manifest.get("version") != STORE_VERSION:
+        raise _corrupt_file(
+            path,
+            f"unsupported store version {manifest.get('version')!r} "
+            f"(reader speaks {STORE_VERSION})",
+        )
+    sections: dict[str, bytes] = {}
+    offset = nl + 1
+    try:
+        table = [(s["name"], int(s["nbytes"]), int(s["crc32"]))
+                 for s in manifest["sections"]]
+    except (KeyError, TypeError, ValueError):
+        raise _corrupt_file(path, "malformed section table")
+    for name, nbytes, crc in table:
+        payload = blob[offset:offset + nbytes]
+        if len(payload) != nbytes:
+            raise _corrupt_file(
+                path,
+                f"section {name!r} truncated "
+                f"({len(payload)}/{nbytes} bytes)",
+            )
+        if zlib.crc32(payload) != crc:
+            raise _corrupt_file(path,
+                                f"section {name!r} checksum mismatch")
+        sections[name] = payload
+        offset += nbytes
+    if offset != len(blob):
+        raise _corrupt_file(path, f"{len(blob) - offset} trailing bytes")
+    for name in ("meta", "records"):
+        if name not in sections:
+            raise _corrupt_file(path, f"missing section {name!r}")
+    try:
+        meta = json.loads(sections["meta"])
+        seq = int(meta["seq"])
+        counts = {str(k): int(v) for k, v in meta["counts"].items()}
+        index = [
+            (str(e["key"]), int(e["nbytes"]), int(e["created"]),
+             int(e["last_use"]), int(e["seq"]))
+            for e in meta["entries"]
+        ]
+    except (KeyError, TypeError, ValueError):
+        raise _corrupt_file(path, "undecodable meta section")
+    records = sections["records"]
+    if sum(n for _, n, _, _, _ in index) != len(records):
+        raise _corrupt_file(
+            path, "records section does not match the entry index"
+        )
+    store = PrefixStore(config)
+    for key in store.counts:
+        store.counts[key] = counts.get(key, 0)
+    store._seq = seq
+    pos = 0
+    for key, nbytes, created, last_use, eseq in index:
+        store._entries[key] = _Entry(
+            key=key, blob=records[pos:pos + nbytes], nbytes=nbytes,
+            created=created, last_use=last_use, seq=eseq,
+        )
+        store.total_bytes += nbytes
+        pos += nbytes
+    # a reader with a smaller budget trims silently: a config change,
+    # not fleet churn, so the eviction counter stays honest
+    while store.total_bytes > store.config.max_bytes:
+        victim = min(store._entries.values(),
+                     key=lambda e: (e.last_use, e.key))
+        store._drop(victim.key, count=False)
+    _BYTES_GAUGE.set(float(store.total_bytes))
+    return store

@@ -528,6 +528,109 @@ def _bench_serial_s(seq: int, dim: int, target_seq: int):
     return est, "extrapolated"
 
 
+def _bench_prefix_fleet(model, params, args) -> dict:
+    """The ``--prefix-store`` detail block: the SAME RAG-heavy diurnal
+    trace — under the SAME deterministic rolling restart — through a
+    2-replica front end with the fleet prefix store OFF and ON.
+
+    Every second request carries its tenant's 256-token retrieval
+    header (two full shared pages).  Per-replica prefix caches plus
+    sticky routing already capture most steady-state reuse, so the
+    fleet tier's measurable win is CHURN: the rolling restart (each
+    replica killed once mid-trace and restarted cold two ticks later —
+    a deploy) wipes the local caches.  Store-off re-prefills every
+    subsequent header from scratch while arrivals pile up; store-on
+    re-imports the committed pages at admission for free.
+    `obs.capacity.cost_per_token` (alive-replica ticks per finished
+    token) must come DOWN, and every request finished by BOTH runs
+    must be token-identical — the store may never cost a token, only
+    ticks."""
+    from attention_tpu.engine import EngineConfig
+    from attention_tpu.engine.sim import diurnal_trace, sampling_of
+    from attention_tpu.frontend import FrontendConfig, ServingFrontend
+    from attention_tpu.frontend.frontend import FrontendRequestState
+    from attention_tpu.obs.forecast import ForecastPolicy
+    from attention_tpu.prefixstore import PrefixStoreConfig
+
+    trace = diurnal_trace(
+        args.engine_requests * 3, vocab=256, seed=11,
+        rag_every=2, rag_prefill_len=256, tenants=2,
+        prompt_len_min=4, prompt_len_max=24, max_tokens=8,
+        peak_rate=4.0,
+    )
+    config = EngineConfig(
+        num_pages=64, page_size=128, max_seq_len=384,
+        max_decode_batch=8, max_prefill_rows=2, prefill_chunk=64,
+        token_budget=192, watermark_pages=1,
+    )
+    restarts = ((10, "replica-0"), (16, "replica-1"))
+
+    def _run(with_store):
+        fe = ServingFrontend(model, params, config, FrontendConfig(
+            num_replicas=2, seed=0, forecast=ForecastPolicy(),
+            prefix_store=PrefixStoreConfig() if with_store else None,
+        ))
+        for e in trace:
+            fe.submit(e["prompt"], sampling_of(e),
+                      request_id=e.get("id"),
+                      arrival=int(e.get("arrival", 0)),
+                      session=e.get("session"),
+                      priority=int(e.get("priority", 1)))
+        while fe.has_work():
+            t = fe.current_tick
+            for kill_tick, rid in restarts:
+                if t == kill_tick:
+                    fe.kill_replica(rid)
+                elif t == kill_tick + 2:
+                    fe.restart_replica(rid)
+            fe.tick()
+        summary = fe.summary()
+        fleet = fe.forecast_report()["capacity"]["fleet"]
+        finished = {
+            rid: list(fr.tokens)
+            for rid, fr in fe.requests.items()
+            if fr.state is FrontendRequestState.FINISHED
+        }
+        return summary, finished, fleet
+
+    s_off, fin_off, fleet_off = _run(False)
+    s_on, fin_on, fleet_on = _run(True)
+    store_counts = s_on.get("prefixstore", {})
+    common = sorted(set(fin_off) & set(fin_on))
+    return {
+        "replicas": 2,
+        "requests": len(trace),
+        "rolling_restarts": [list(r) for r in restarts],
+        "store_off": {
+            "ticks": s_off["ticks"],
+            "cost_per_token": fleet_off["cost_per_token"],
+            "tokens_per_tick": fleet_off["tokens_per_tick"],
+            "finished": len(fin_off),
+        },
+        "store_on": {
+            "ticks": s_on["ticks"],
+            "cost_per_token": fleet_on["cost_per_token"],
+            "tokens_per_tick": fleet_on["tokens_per_tick"],
+            "finished": len(fin_on),
+            "fleet_prefix_hit_rate": store_counts.get(
+                "fleet_prefix_hit_rate", 0.0),
+            "imported_tokens": store_counts.get("imported_tokens", 0),
+            "exports": store_counts.get("exports", 0),
+            "imports": store_counts.get("imports", 0),
+            "singleflight_coalesced": store_counts.get(
+                "singleflight_coalesced", 0),
+        },
+        "cost_per_token_ratio": (
+            round(fleet_on["cost_per_token"]
+                  / fleet_off["cost_per_token"], 4)
+            if fleet_off["cost_per_token"] else None),
+        # the invariant, checked right here in the bench: fleet reuse
+        # must never change a token of any commonly-finished stream
+        "tokens_match_store_off": all(
+            fin_on[r] == fin_off[r] for r in common),
+    }
+
+
 def _bench_engine(args) -> dict:
     """The ``--arm engine`` record: continuous-batching throughput of
     `attention_tpu.engine` on a synthetic overlapping-request trace vs
@@ -647,6 +750,10 @@ def _bench_engine(args) -> dict:
             "tokens_match_single_device": mesh_outputs == outputs,
         }
 
+    fleet_detail = None
+    if args.prefix_store:
+        fleet_detail = _bench_prefix_fleet(model, params, args)
+
     return {
         "metric": "engine continuous-batching decode throughput vs "
         "sequential generate_paged (same model, same requests, CPU/TPU "
@@ -672,6 +779,7 @@ def _bench_engine(args) -> dict:
                 "mean_host_overhead_ms", 0.0),
             "summary": summary,
             "mesh": mesh_detail,
+            "prefix_fleet": fleet_detail,
             "per_step": [m.to_dict() for m in engine.metrics.steps],
         },
     }
@@ -691,6 +799,14 @@ def main(argv=None) -> int:
     p.add_argument("--engine-prompt", type=int, default=96,
                    help="max prompt body length (engine arm)")
     p.add_argument("--engine-dim", type=int, default=64)
+    p.add_argument(
+        "--prefix-store", action="store_true",
+        help="engine arm: ALSO run a RAG-heavy diurnal trace through "
+        "a 2-replica front end with the fleet prefix store off and on "
+        "(attention_tpu.prefixstore) and report the "
+        "obs.capacity.cost_per_token delta + store counters "
+        "(token streams must match exactly)",
+    )
     p.add_argument(
         "--mesh-shards", type=int, default=0,
         help="engine arm: ALSO run the trace through a KV-head-sharded "

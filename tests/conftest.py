@@ -102,6 +102,7 @@ SMOKE_TESTS = {
         "test_cache_entry_overrides_for_shape_and_decode",
         "test_shipped_table_passes_lint",
     ],
+    "test_prefixstore": ["test_engine_export_then_import_parity"],
     # test_graft_entry is NOT in the smoke tier: the driver
     # compile-checks the entry separately every round anyway
 }
@@ -166,6 +167,17 @@ def pytest_configure(config):
         "(attention_tpu/frontend/supervisor.py + migrate.py) — "
         "hysteresis state machine, drain parity, warm-standby "
         "promotion, gray-storm campaigns; CPU-only",
+    )
+    # the fleet prefix tier (tests/test_prefixstore.py): content-
+    # addressed KV record round trips, engine export/import parity,
+    # single-flight storms, lease lifecycle, store persistence;
+    # CPU-only and tier-1 fast except the storm sweep (also slow)
+    config.addinivalue_line(
+        "markers",
+        "prefixstore: global prefix-cache tier (attention_tpu/"
+        "prefixstore/) — content-addressed KV records, engine export/"
+        "import parity, single-flight de-dup leases, store "
+        "persistence; CPU-only",
     )
 
 

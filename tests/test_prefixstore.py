@@ -1,0 +1,461 @@
+"""Global prefix tier (attention_tpu/prefixstore/).
+
+Tiny CPU shapes throughout.  The flagships: K identical prompts
+stormed across 2 replicas prefill exactly once fleet-wide and stay
+token-identical to a storeless run; a mesh exporter's per-shard
+records import on a single-device engine; and a corrupted record
+raises the typed `PrefixStoreCorruptError` internally, costs one
+re-prefill, and never a wrong token.  The broad chaos sweep
+(`run_store_campaign`) also carries `slow`.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from attention_tpu.chaos import invariants as inv
+from attention_tpu.chaos.faults import (
+    build_sim_model,
+    default_engine_config,
+    run_store_campaign,
+)
+from attention_tpu.engine import (
+    PrefixLeaseError,
+    PrefixStoreCorruptError,
+    SamplingParams,
+    ServingEngine,
+)
+from attention_tpu.frontend import FrontendConfig, ServingFrontend
+from attention_tpu.prefixstore import (
+    STORE_FILENAME,
+    LeaseTable,
+    PrefixStore,
+    PrefixStoreConfig,
+    chain_key,
+    chain_tokens,
+    decode_record,
+    encode_record,
+    load_store,
+    page_geometry,
+    save_store,
+    serialize_store,
+)
+
+pytestmark = pytest.mark.prefixstore
+
+
+@pytest.fixture(scope="module")
+def sim_model():
+    return build_sim_model()
+
+
+def _cfg(**overrides):
+    # roomy enough for the 260-token shared prompts below (2 full
+    # 128-token pages + tail), small enough to stay tier-1 fast
+    return default_engine_config(max_seq_len=384, num_pages=24,
+                                 **overrides)
+
+
+def _prompt(seed=7, n=260, vocab=43):
+    rng = np.random.default_rng(seed)
+    return [int(t) for t in rng.integers(1, vocab, size=n)]
+
+
+def _greedy(n=4):
+    return SamplingParams(max_tokens=n, temperature=0.0)
+
+
+def _run_one(model, params, config, prompt, *, store=None):
+    """One request through a fresh engine; (tokens, engine, request)."""
+    eng = ServingEngine(model, params, config)
+    eng.prefix_store = store
+    req = eng.add_request(prompt, _greedy())
+    eng.run(max_steps=200)
+    return list(req.output_tokens), eng, req
+
+
+# ------------------------------------------------------- records
+
+
+def test_chain_helpers_page_alignment():
+    ps = 128
+    # the allocator's (n-1)//page_size limit: a chain must leave >= 1
+    # token for the prefill that produces first-token logits
+    assert chain_tokens(range(ps), ps) is None
+    assert chain_tokens(range(ps + 1), ps) == tuple(range(ps))
+    assert chain_tokens(range(2 * ps), ps) == tuple(range(ps))
+    assert chain_tokens(range(2 * ps + 1), ps) == tuple(range(2 * ps))
+    assert chain_key([1, 2, 3]) == chain_key((1, 2, 3))
+    assert chain_key([1, 2, 3]) != chain_key([1, 2, 4])
+
+
+def _record_parts(rng, *, heads=4, ps=128, hd=16, layers=2):
+    geo = page_geometry(num_kv_heads=heads, page_size=ps, head_dim=hd,
+                        layers=layers, dtype="float32")
+    arrays = [rng.standard_normal((heads, ps, hd)).astype(np.float32)
+              for _ in range(2 * layers)]
+    tokens = tuple(int(t) for t in rng.integers(1, 99, size=ps))
+    return tokens, arrays, geo
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+def test_record_roundtrip_is_shard_agnostic(rng, shards):
+    """An S-shard exporter's record decodes to the same arrays as a
+    single-device one — only geometry gates reuse, never shard count."""
+    tokens, arrays, geo = _record_parts(rng)
+    fp = {"model": "tiny", "rev": 1}
+    blob = encode_record(tokens=tokens, arrays=arrays, fingerprint=fp,
+                         geometry=geo, shards=shards)
+    rec = decode_record(blob)
+    assert rec.tokens == tokens
+    assert rec.fingerprint == fp and rec.geometry == geo
+    assert len(rec.arrays) == len(arrays)
+    for got, want in zip(rec.arrays, arrays):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_record_shards_must_divide_heads(rng):
+    tokens, arrays, geo = _record_parts(rng)
+    with pytest.raises(ValueError, match="does not divide"):
+        encode_record(tokens=tokens, arrays=arrays, fingerprint={},
+                      geometry=geo, shards=3)
+
+
+@pytest.mark.parametrize("damage", [
+    "truncate", "payload_flip", "manifest_flip", "bad_magic",
+    "bad_version", "trailing",
+])
+def test_record_corruption_is_typed(rng, damage):
+    """Every structural wound is the one typed error — the import
+    path's contract that corruption costs a re-prefill, not a token."""
+    tokens, arrays, geo = _record_parts(rng, layers=1)
+    blob = bytearray(encode_record(tokens=tokens, arrays=arrays,
+                                   fingerprint={}, geometry=geo))
+    nl = blob.find(b"\n")
+    if damage == "truncate":
+        blob = blob[: nl + 1 + (len(blob) - nl) // 2]
+    elif damage == "payload_flip":
+        blob[nl + 1 + (len(blob) - nl - 1) // 2] ^= 0xFF
+    elif damage == "manifest_flip":
+        blob[nl // 2] ^= 0xFF
+    elif damage == "bad_magic":
+        blob = blob.replace(b"atp-prefixrec", b"atp-prefixwat", 1)
+    elif damage == "bad_version":
+        assert b'"version":1' in blob[:nl]
+        blob = blob.replace(b'"version":1', b'"version":9', 1)
+    elif damage == "trailing":
+        blob = blob + b"x"
+    with pytest.raises(PrefixStoreCorruptError):
+        decode_record(bytes(blob))
+
+
+# --------------------------------------------------------- store
+
+
+def test_store_budget_ttl_and_lru(rng):
+    store = PrefixStore(PrefixStoreConfig(max_bytes=300, ttl_ticks=10))
+    blob = b"r" * 100
+    assert store.put("a", blob, now=0) is True
+    assert store.put("a", blob, now=0) is False  # touch, not rewrite
+    assert store.put("b", blob, now=1) and store.put("c", blob, now=2)
+    assert store.total_bytes == 300 and len(store) == 3
+    # a get() touch moves "a" off the LRU end, so "b" is the victim
+    assert store.get("a", now=3) == blob
+    assert store.put("d", blob, now=4)
+    assert "a" in store and "b" not in store
+    assert store.counts["evictions"] == 1
+    # TTL: "a" (created 0) dies at tick 10; get() expires lazily
+    assert store.get("a", now=9) == blob
+    assert store.get("a", now=10) is None and "a" not in store
+    assert store.counts["evictions"] == 2
+    # an over-budget blob is refused outright
+    assert store.put("huge", b"x" * 301, now=11) is False
+    assert store.counts["exports"] == 4
+
+
+def test_store_peek_is_side_effect_free():
+    """Routing probes every tick; losing a race must not keep an
+    entry hot (the allocator's peek_prefix discipline)."""
+    store = PrefixStore(PrefixStoreConfig(max_bytes=64, ttl_ticks=None))
+    store.put("a", b"r" * 32, now=0)
+    store.put("b", b"r" * 32, now=1)
+    for t in range(2, 20):
+        assert store.peek("a", now=t)
+    store.put("c", b"r" * 32, now=20)  # evicts LRU "a" despite peeks
+    assert "a" not in store and "b" in store
+
+
+def test_store_chain_probes():
+    ps = 128
+    toks = list(range(2 * ps + 5))
+    store = PrefixStore(PrefixStoreConfig())
+    assert store.peek_chain(toks, ps, now=0) == 0
+    assert not store.has_chain(toks, ps, now=0)
+    store.put(chain_key(toks[:ps]), b"p0", now=0)
+    assert store.peek_chain(toks, ps, now=0) == 1
+    assert not store.has_chain(toks, ps, now=0)
+    store.put(chain_key(toks[: 2 * ps]), b"p1", now=0)
+    assert store.has_chain(toks, ps, now=0)
+    # nothing shareable: nothing to wait for
+    assert store.has_chain(toks[: ps // 2], ps, now=0)
+
+
+def test_store_save_load_roundtrip_and_corrupt_file(tmp_path):
+    store = PrefixStore(PrefixStoreConfig())
+    store.put("a", b"alpha", now=0)
+    store.put("b", b"beta", now=3)
+    store.counts["imports"] = 2
+    path = str(tmp_path / STORE_FILENAME)
+    save_store(store, path)
+    back = load_store(path, PrefixStoreConfig())
+    assert serialize_store(back) == serialize_store(store)
+    assert back.get("b", now=4) == b"beta"
+    assert back.counts["imports"] == 2
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(PrefixStoreCorruptError):
+        load_store(path, PrefixStoreConfig())
+
+
+# --------------------------------------------------------- leases
+
+
+def test_lease_lifecycle_and_misuse():
+    lt = LeaseTable(4)
+    lt.acquire("k", "r0", now=0)
+    assert lt.holder("k", now=1) == "r0"
+    with pytest.raises(PrefixLeaseError, match="coalesce"):
+        lt.acquire("k", "r1", now=1)
+    with pytest.raises(PrefixLeaseError, match="not releaser"):
+        lt.release("k", "r1", now=1)
+    lt.acquire("k", "r0", now=3)  # the leader's heartbeat refresh
+    assert lt.holder("k", now=6) == "r0"  # would have expired at 4
+    assert lt.holder("k", now=7) is None  # dead-leader backstop
+    lt.acquire("k", "r1", now=7)  # expired lease is free to take
+    lt.release("k", "r1", now=8)
+    lt.release("k", "r1", now=8)  # idempotent
+    lt.acquire("a", "r2", now=8)
+    lt.acquire("b", "r2", now=8)
+    assert lt.active(now=9) == [("a", "r2"), ("b", "r2")]
+    assert lt.release_owner("r2") == 2 and len(lt) == 0
+
+
+# --------------------------------------------- engine export/import
+
+
+def test_engine_export_then_import_parity(sim_model):
+    """Engine A prefills + exports; a fresh engine B imports the chain
+    at intake and streams tokens identical to a storeless run, ending
+    at the same drained quiescence a local chain leaves."""
+    model, params = sim_model
+    prompt = _prompt()
+    baseline, _, _ = _run_one(model, params, _cfg(), prompt)
+
+    store = PrefixStore(PrefixStoreConfig())
+    out_a, _, _ = _run_one(model, params, _cfg(), prompt, store=store)
+    assert out_a == baseline
+    assert store.counts["exports"] == 2 and len(store) == 2
+
+    out_b, eng_b, req_b = _run_one(model, params, _cfg(), prompt,
+                                   store=store)
+    assert out_b == baseline
+    assert store.counts["imports"] == 1
+    assert store.counts["import_tokens"] == 256
+    assert req_b.prefix_cached_tokens == 256
+    assert inv.engine_quiescence_violations(eng_b) == []
+    assert store.counts["corrupt"] == 0
+
+
+def test_mesh_export_imports_on_single_device(sim_model):
+    """A 2-shard mesh exporter writes per-shard ``pools.<s>`` head
+    slices; a single-device engine reassembles and reuses them —
+    shard count is a layout, geometry is the gate."""
+    model, params = sim_model
+    prompt = _prompt(seed=8)
+    baseline, _, _ = _run_one(model, params, _cfg(), prompt)
+
+    store = PrefixStore(PrefixStoreConfig())
+    mesh_out, _, _ = _run_one(model, params, _cfg(mesh_shards=2),
+                              prompt, store=store)
+    assert mesh_out == baseline
+    assert store.counts["exports"] == 2
+    # the records really are sharded, not single-section
+    blob = next(iter(store._entries.values())).blob
+    assert b'"pools.1"' in blob[: blob.find(b"\n")]
+
+    out, _, _ = _run_one(model, params, _cfg(), prompt, store=store)
+    assert out == baseline
+    assert store.counts["imports"] == 1 and store.counts["corrupt"] == 0
+
+
+def test_fingerprint_mismatch_is_miss_not_corruption(sim_model):
+    """Another fleet's pages (different params, same shapes) gate on
+    the model fingerprint: a miss and a cold prefill, never an import
+    and never a corruption count."""
+    model, params = sim_model
+    other_model, other_params = build_sim_model(seed=1)
+    prompt = _prompt(seed=9)
+
+    store = PrefixStore(PrefixStoreConfig())
+    _run_one(model, params, _cfg(), prompt, store=store)
+    assert store.counts["exports"] == 2
+
+    baseline, _, _ = _run_one(other_model, other_params, _cfg(),
+                              prompt)
+    out, _, _ = _run_one(other_model, other_params, _cfg(), prompt,
+                         store=store)
+    assert out == baseline
+    assert store.counts["imports"] == 0
+    assert store.counts["corrupt"] == 0
+
+
+def test_hash_collision_degrades_to_miss(sim_model, rng):
+    """A record whose full token chain disagrees with its key (what a
+    sha256 collision would look like) is a miss: the importer trusts
+    the tuple, not the digest."""
+    from attention_tpu.prefixstore import engine_geometry, fleet_fingerprint
+
+    model, params = sim_model
+    prompt = _prompt(seed=10)
+    probe = ServingEngine(model, params, _cfg())
+    geo = engine_geometry(probe)
+    wrong = tuple(int(t) for t in rng.integers(1, 43, size=128))
+    shape = (geo["num_kv_heads"], geo["page_size"], geo["head_dim"])
+    arrays = [np.zeros(shape, np.float32)
+              for _ in range(2 * geo["layers"])]
+    # the record passes the fingerprint + geometry gates; ONLY its
+    # token tuple disagrees with the key it sits under
+    blob = encode_record(tokens=wrong, arrays=arrays,
+                         fingerprint=fleet_fingerprint(probe),
+                         geometry=geo)
+    store = PrefixStore(PrefixStoreConfig())
+    store.put(chain_key(tuple(prompt[:128])), blob, now=0)
+
+    baseline, _, _ = _run_one(model, params, _cfg(), prompt)
+    out, _, _ = _run_one(model, params, _cfg(), prompt, store=store)
+    assert out == baseline
+    assert store.counts["imports"] == 0
+    assert store.counts["corrupt"] == 0
+
+
+def test_corrupt_record_is_typed_counted_and_reprefilled(sim_model):
+    """A poisoned payload byte: the importer counts the typed failure,
+    discards the entry, cold-prefills with exact parity, and the
+    commit re-publishes clean bytes."""
+    model, params = sim_model
+    prompt = _prompt(seed=11)
+    baseline, _, _ = _run_one(model, params, _cfg(), prompt)
+
+    store = PrefixStore(PrefixStoreConfig())
+    _run_one(model, params, _cfg(), prompt, store=store)
+    first_key = chain_key(tuple(prompt[:128]))
+    entry = store._entries[first_key]
+    blob = bytearray(entry.blob)
+    nl = blob.find(b"\n")
+    blob[nl + 1 + (len(blob) - nl - 1) // 2] ^= 0xFF
+    entry.blob = bytes(blob)
+
+    out, _, _ = _run_one(model, params, _cfg(), prompt, store=store)
+    assert out == baseline
+    assert store.counts["corrupt"] == 1
+    assert store.counts["imports"] == 0
+    # the re-prefill's commit re-exported the discarded page
+    assert store.counts["exports"] == 3
+    assert store.get(first_key, now=50) is not None
+    # and the healed chain imports cleanly on the next stranger
+    out2, _, _ = _run_one(model, params, _cfg(), prompt, store=store)
+    assert out2 == baseline and store.counts["imports"] == 1
+
+
+# --------------------------------------------------- frontend tier
+
+
+def _storm_summaries(model, params, *, with_store, k=4):
+    """K identical prompts across 2 replicas; (summary, tokens-by-id)."""
+    fe = ServingFrontend(
+        model, params, _cfg(),
+        FrontendConfig(
+            num_replicas=2, seed=0,
+            prefix_store=PrefixStoreConfig() if with_store else None,
+        ),
+    )
+    prompt = _prompt(seed=12)
+    for i in range(k):
+        fe.submit(prompt, _greedy(), request_id=f"k{i}", arrival=0)
+    summary = fe.run(max_ticks=120)
+    tokens = {rid: list(fr.tokens) for rid, fr in fe.requests.items()}
+    return summary, tokens
+
+
+def test_storm_single_flights_and_matches_storeless(sim_model):
+    """The acceptance storm: 4 identical prompts, 2 replicas — the
+    chain prefills exactly once fleet-wide (2 exported pages, 3
+    coalesced waiters), every stream token-identical to the storeless
+    fleet, and the same seed is byte-identical."""
+    model, params = sim_model
+    off_summary, off_tokens = _storm_summaries(model, params,
+                                               with_store=False)
+    on_summary, on_tokens = _storm_summaries(model, params,
+                                             with_store=True)
+    assert on_tokens == off_tokens
+    assert all(on_summary["states"][s] == 0
+               for s in on_summary["states"] if s != "finished")
+    ps_block = on_summary["prefixstore"]
+    assert ps_block["exports"] == 2  # once fleet-wide, not once per req
+    assert ps_block["singleflight_coalesced"] == 3
+    assert ps_block["imports"] >= 1
+    assert ps_block["imported_tokens"] >= 256
+    assert ps_block["corrupt"] == 0
+    # determinism: the summary is a pure function of the seed
+    again, _ = _storm_summaries(model, params, with_store=True)
+    assert json.dumps(again, sort_keys=True) \
+        == json.dumps(on_summary, sort_keys=True)
+
+
+def test_store_persists_across_warm_restart(sim_model, tmp_path):
+    """The store is durable fleet state: it rides the snapshot cadence
+    as its own CRC'd-section file, reloads warm, and a corrupt file
+    means a typed cold start, never a crash."""
+    model, params = sim_model
+    cfg = FrontendConfig(num_replicas=1, seed=0,
+                         snapshot_dir=str(tmp_path), snapshot_every=2,
+                         prefix_store=PrefixStoreConfig())
+    fe = ServingFrontend(model, params, _cfg(), cfg)
+    fe.submit(_prompt(seed=13), _greedy(), request_id="p0", arrival=0)
+    fe.run(max_ticks=80)
+    assert len(fe.prefix_store) == 2
+    path = tmp_path / STORE_FILENAME
+    assert path.exists()
+
+    warm = ServingFrontend(model, params, _cfg(), cfg)
+    assert len(warm.prefix_store) == 2
+    assert warm.prefix_store.counts["exports"] == 2
+
+    blob = bytearray(path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    cold = ServingFrontend(model, params, _cfg(), cfg)
+    assert len(cold.prefix_store) == 0
+    assert cold.prefix_store.counts["corrupt"] == 1
+
+
+# ----------------------------------------------------- chaos sweep
+
+
+def test_store_smoke_campaign():
+    """One fast storm plan: injected store faults, zero violations —
+    the tier-1 pin that invariant 14 holds under fire."""
+    report = run_store_campaign(0, num_plans=1, num_requests=4)
+    assert report.ok, [r.violations for r in report.reports]
+    assert report.total_injected > 0
+
+
+@pytest.mark.slow
+def test_store_storm_sweep():
+    """The broad seeded sweep (poison, manifest flips, lease-holder
+    kills, eviction storms across plans)."""
+    report = run_store_campaign(1, num_plans=4, num_requests=5)
+    assert report.ok, [r.violations for r in report.reports]
+    assert report.total_injected >= 8
