@@ -53,6 +53,10 @@ ATP505 = register_code(
     "FROZEN_SERIES drift: a frozen telemetry series is never created, "
     "created under the wrong instrument kind, or re-typed as a string "
     "literal in a consumer module")
+ATP507 = register_code(
+    "ATP507", "blackbox-event-enum", Severity.ERROR,
+    "literal flight-recorder event kind outside the closed enum in "
+    "obs/naming.py (BLACKBOX_EVENTS)")
 ATP601 = register_code(
     "ATP601", "non-source-tracked-file", Severity.ERROR,
     "a git-tracked file under attention_tpu/ or tests/ is a build "
@@ -67,6 +71,11 @@ INSTRUMENT_CALLS = {"counter", "gauge", "histogram", "digest", "span",
 
 #: call names whose second literal argument must be a trace event type
 TRACE_RECORD_CALLS = {"record"}
+
+#: call names whose FIRST literal argument must be a flight-recorder
+#: event kind: the module-level `blackbox.note(...)` and the
+#: front end's `self._bb_note(...)` wrapper
+BLACKBOX_NOTE_CALLS = {"note", "_bb_note"}
 
 _OBS_MSG = ("telemetry name {name!r} violates layer.component.verb "
             "(2-4 lowercase dot-separated [a-z][a-z0-9_]* segments)")
@@ -127,15 +136,52 @@ def trace_event_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
     return out
 
 
-@file_pass("obs-naming", [ATP501, ATP504])
+_BB_MSG = ("blackbox event {kind!r} is not in the closed enum "
+           "obs/naming.py:BLACKBOX_EVENTS")
+
+
+def blackbox_event_violations(tree: ast.Module) -> list[tuple[int, int, str]]:
+    """(line, col, kind) for every unknown literal blackbox event kind.
+
+    Matches calls named ``note`` / ``_bb_note`` (the flight recorder
+    and the front end's coordinate-stamping wrapper) whose FIRST
+    positional argument is a string literal — the event-kind slot.
+    Dynamic kinds are runtime-validated by ``require_blackbox_event``
+    in the recorder itself."""
+    from attention_tpu.obs.naming import check_blackbox_event
+
+    out = []
+    for node in walk_list(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None)
+        if name not in BLACKBOX_NOTE_CALLS or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        if not check_blackbox_event(first.value):
+            out.append((node.lineno, node.col_offset, first.value))
+    return out
+
+
+@file_pass("obs-naming", [ATP501, ATP504, ATP507])
 def check_obs_names(path: str, tree: ast.Module, src: str):
-    """Literal instrument names and trace event types follow the scheme."""
+    """Literal instrument names, trace event types, and blackbox event
+    kinds follow their closed schemes."""
     findings = [
         Finding(ATP501, _OBS_MSG.format(name=name), path, line, col)
         for line, col, name in obs_name_violations(tree)]
     findings += [
         Finding(ATP504, _TRACE_MSG.format(event=event), path, line, col)
         for line, col, event in trace_event_violations(tree)]
+    findings += [
+        Finding(ATP507, _BB_MSG.format(kind=kind), path, line, col)
+        for line, col, kind in blackbox_event_violations(tree)]
     findings.sort(key=lambda f: (f.line, f.col))
     return findings
 
@@ -152,6 +198,8 @@ def legacy_obs_check_file(path: str) -> list[str]:
              for line, col, name in obs_name_violations(tree)]
     lines += [(line, col, _TRACE_MSG.format(event=event))
               for line, col, event in trace_event_violations(tree)]
+    lines += [(line, col, _BB_MSG.format(kind=kind))
+              for line, col, kind in blackbox_event_violations(tree)]
     return [f"{path}:{line}: {msg}" for line, _col, msg in sorted(lines)]
 
 
@@ -340,6 +388,7 @@ _INSTRUMENT_KINDS = {"counter": "counter", "gauge": "gauge",
 #: re-typing the dotted name — so a rename in naming.py is a lint
 #: failure, not a silent series fork
 FROZEN_CONSUMER_MODULES = (
+    "attention_tpu/obs/anomaly.py",
     "attention_tpu/obs/capacity.py",
     "attention_tpu/obs/forecast.py",
     "attention_tpu/obs/slo.py",

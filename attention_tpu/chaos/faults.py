@@ -26,15 +26,18 @@ its own repro.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
+import tempfile
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from attention_tpu import obs
 from attention_tpu.chaos import invariants as inv
+from attention_tpu.obs import blackbox as obs_blackbox
 from attention_tpu.engine import journal as journal_mod
 from attention_tpu.engine import snapshot as snapshot_mod
 from attention_tpu.engine.engine import EngineConfig, ServingEngine
@@ -592,12 +595,23 @@ class FrontendFaultInjector:
         self.injected = 0
         self.cancelled: list[str] = []
         self.skipped: list[str] = []
+        #: (kind, tick) of every fault ACTUALLY applied, in order —
+        #: invariant 15 matches this ledger against the incident
+        #: bundles the run dumped
+        self.fired: list[tuple[str, int]] = []
         self._orig_tick = frontend.tick
         frontend.tick = self._tick
 
     def _mark(self, kind: str) -> None:
         self.injected += 1
         _INJECTED.inc(kind=kind)
+        tick = self.frontend.current_tick
+        self.fired.append((kind, tick))
+        obs_blackbox.note("fault_injected", tick=tick, fault=kind)
+        # every applied fault files its incident at injection time
+        # (deduped per (cause, detail), so a multi-shot window at one
+        # tick still yields exactly one bundle)
+        self.frontend._incident("fault", {"kind": kind, "tick": tick})
 
     def _tick(self):
         for ev in self.plan.events:
@@ -673,7 +687,7 @@ class FrontendFaultInjector:
             if store is None or not len(store):
                 self.skipped.append("store_evict:empty")
                 return
-            store.evict_all()
+            store.evict_all(now=self.frontend.current_tick)
             self._mark("store_evict")
         elif ev.kind in GRAY_FAULT_KINDS:
             handle = self._handle(ev.target)
@@ -871,6 +885,7 @@ def run_frontend_plan(model, params, config: EngineConfig,
                       baseline: dict[str, list[int]] | None = None,
                       max_ticks: int = 1000,
                       snapshot_roundtrip: bool = False,
+                      incident_root: str | None = None,
                       ) -> FrontendPlanReport:
     """Replay ``trace`` through a fresh front end with ``plan``
     attached; check every invariant that applies — including the two
@@ -883,11 +898,37 @@ def run_frontend_plan(model, params, config: EngineConfig,
 
     The whole plan runs inside ``obs.trace.capture()`` so invariant 12
     (trace completeness) has chains to judge even with telemetry off —
-    capture clears the store on entry, isolating each plan's chains."""
+    capture clears the store on entry, isolating each plan's chains.
+    ``obs.blackbox.capture()`` wraps it too: every applied fault lands
+    in the flight-recorder ring AND dumps an incident bundle under
+    ``incident_root`` (a throwaway directory when not given and the
+    config carries none), which invariant 15 then audits for
+    completeness — no injected fault without its bundle, no
+    fault-cause bundle without its injection."""
     from attention_tpu.frontend import ServingFrontend, replay_frontend
     from attention_tpu.obs import trace as obs_trace
 
-    with obs_trace.capture():
+    with contextlib.ExitStack() as stack:
+        if getattr(frontend_config, "incident_dir", None) is None:
+            if incident_root is None:
+                incident_root = stack.enter_context(
+                    tempfile.TemporaryDirectory(
+                        prefix="atp-incidents-"))
+            frontend_config = dataclasses.replace(
+                frontend_config, incident_dir=incident_root)
+        return _run_frontend_plan_inner(
+            model, params, config, frontend_config, trace, plan,
+            baseline=baseline, max_ticks=max_ticks,
+            snapshot_roundtrip=snapshot_roundtrip)
+
+
+def _run_frontend_plan_inner(model, params, config, frontend_config,
+                             trace, plan, *, baseline, max_ticks,
+                             snapshot_roundtrip) -> FrontendPlanReport:
+    from attention_tpu.frontend import ServingFrontend, replay_frontend
+    from attention_tpu.obs import trace as obs_trace
+
+    with obs_trace.capture(), obs_blackbox.capture():
         frontend = ServingFrontend(model, params, config,
                                    frontend_config)
         injector = FrontendFaultInjector(frontend, plan)
@@ -940,6 +981,11 @@ def run_frontend_plan(model, params, config: EngineConfig,
     # submitted request; judge them (incl. gray + crash campaigns,
     # which all funnel through this runner)
     violations += inv.trace_completeness_violations(frontend)
+    # invariant 15: the incident ledger balances — every applied fault
+    # dumped exactly one bundle naming its kind and tick, and every
+    # fault/detector bundle traces back to a real cause
+    violations += inv.incident_completeness_violations(frontend,
+                                                       injector)
     # invariant 13: campaigns enable forecasting (see
     # default_frontend_config) — the observatory report must be a
     # pure function of the recorded samples, storm or no storm

@@ -92,6 +92,16 @@ The global prefix tier (ISSUE 17) adds one more:
     (count + discard + cold re-prefill), never as wrong tokens.  The
     store's own byte accounting must also balance.  A no-op on a
     storeless front end.
+
+The incident layer (ISSUE 18) adds one more:
+
+15. **Incident completeness** — the postmortem ledger balances: every
+    fault a campaign ACTUALLY injected dumped exactly one incident
+    bundle naming its kind and tick, every fault-cause bundle traces
+    back to a real injection, every detector-cause bundle to a
+    recorded anomaly firing, and no bundle carries an unknown cause.
+    The campaign runners attach a throwaway ``incident_dir`` to every
+    plan, so the audit runs storm after storm with telemetry off.
 """
 
 from __future__ import annotations
@@ -580,6 +590,64 @@ def forecast_determinism_violations(frontend) -> list[str]:
             "forecast report does not rebuild byte-identically from "
             "its own embedded samples")
     return _report("forecast_determinism", problems)
+
+
+def incident_completeness_violations(frontend, injector) -> list[str]:
+    """Invariant 15: the incident ledger balances.
+
+    Reads the bundles the run dumped under the front end's
+    ``incident_dir`` straight from disk (the postmortem contract is
+    that the bundle alone suffices) and matches the fault-cause ones
+    one-to-one against the injector's ``fired`` ledger; detector-cause
+    bundles must each trace to a recorded anomaly firing.  A no-op on
+    a front end constructed without a postmortem writer."""
+    pm = getattr(frontend, "postmortem", None)
+    if pm is None:
+        return []
+    from attention_tpu.obs import postmortem as _postmortem
+
+    problems: list[str] = []
+    fault_bundles: set[tuple[str, int]] = set()
+    detector_bundles: list[tuple[str, str, int]] = []
+    for bundle_dir in _postmortem.list_incidents(pm.out_dir):
+        b = _postmortem.load_incident(bundle_dir)
+        meta = b["meta"]
+        cause = meta.get("cause")
+        detail = meta.get("detail", {})
+        if cause not in _postmortem.INCIDENT_CAUSES:
+            problems.append(
+                f"bundle {b['name']}: unknown cause {cause!r}")
+        elif cause == "fault":
+            fault_bundles.add(
+                (str(detail.get("kind")), int(meta["tick"])))
+        elif cause == "detector":
+            detector_bundles.append(
+                (b["name"], str(detail.get("detector")),
+                 int(meta["tick"])))
+    fired = {(kind, int(tick))
+             for kind, tick in getattr(injector, "fired", [])}
+    for kind, tick in sorted(fired - fault_bundles):
+        problems.append(
+            f"injected fault {kind!r} at tick {tick} left no "
+            "incident bundle")
+    for kind, tick in sorted(fault_bundles - fired):
+        problems.append(
+            f"bundle names fault {kind!r} at tick {tick} that was "
+            "never injected")
+    tracker = getattr(frontend, "anomaly", None)
+    firings = ({(f["detector"], int(f["tick"]))
+                for f in tracker.firings} if tracker is not None
+               else set())
+    for name, detector, tick in detector_bundles:
+        if (detector, tick) not in firings:
+            problems.append(
+                f"bundle {name} names detector {detector!r} at tick "
+                f"{tick} with no recorded firing")
+    if pm.suppressed:
+        problems.append(
+            f"{pm.suppressed} incident(s) suppressed by the writer's "
+            f"bundle limit ({pm.limit})")
+    return _report("incident_completeness", problems)
 
 
 def snapshot_roundtrip_violations(engine) -> list[str]:

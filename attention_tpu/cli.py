@@ -308,6 +308,10 @@ def _cmd_serve_sim(args: argparse.Namespace) -> int:
     if gray_plan_doc is not None:
         _logger.info("gray plan ignored on the single-engine path "
                      "(gray failures need --replicas)")
+    if args.anomaly or args.incident_dir:
+        _logger.info("--anomaly/--incident-dir ignored on the "
+                     "single-engine path (the incident layer runs in "
+                     "the front-end tick loop; needs --replicas)")
 
     engine = ServingEngine(model, params, config)
     if args.snapshot_dir is not None:
@@ -394,6 +398,11 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
 
         prefix_store = PrefixStoreConfig(
             max_bytes=args.prefix_store_bytes)
+    anomaly_policy = None
+    if args.anomaly:
+        from attention_tpu.obs.anomaly import AnomalyPolicy
+
+        anomaly_policy = AnomalyPolicy()
     frontend = ServingFrontend(
         model, params, config,
         FrontendConfig(
@@ -406,6 +415,8 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
             standbys=args.standbys,
             forecast=forecast_policy,
             prefix_store=prefix_store,
+            anomaly=anomaly_policy,
+            incident_dir=args.incident_dir,
         ),
     )
     if args.chaos_plan or gray_plan is not None:
@@ -473,6 +484,23 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
             "time_to_saturation":
                 forecast_doc["capacity"]["time_to_saturation"],
         }
+    # incident layer: anomaly detector report + flight-recorder block.
+    # The blackbox block lives at the CLI level (not in the frontend's
+    # summary) so the off-path token streams stay byte-identical.
+    anomaly_doc = None
+    if frontend.anomaly is not None:
+        anomaly_doc = frontend.anomaly.report()
+        out["anomaly"] = {"firings": len(anomaly_doc["firings"]),
+                          "active": anomaly_doc["active"]}
+    if args.obs or args.obs_out or args.obs_profile or args.incident_dir:
+        from attention_tpu.obs import blackbox as blackbox_mod
+
+        out["blackbox"] = {
+            "ring_depth": blackbox_mod.depth(),
+            "events_total": blackbox_mod.total(),
+            "incidents": (len(frontend.postmortem.written)
+                          if frontend.postmortem is not None else 0),
+        }
     if args.outputs:
         out["outputs"] = outputs
     if args.obs_out:
@@ -482,6 +510,8 @@ def _serve_sim_frontend(args: argparse.Namespace, model, params,
         obs.write_slo(args.obs_out, slo_report)
         if forecast_doc is not None:
             obs.write_forecast(args.obs_out, forecast_doc)
+        if anomaly_doc is not None:
+            obs.write_anomaly(args.obs_out, anomaly_doc)
         _logger.info("wrote telemetry dump: %s", args.obs_out)
     print(json.dumps(out))
     return 0
@@ -682,6 +712,18 @@ def _add_serve_sim_args(ss) -> None:
                          "way; --kv-heads must divide by N; on CPU "
                          "set XLA_FLAGS=--xla_force_host_platform_"
                          "device_count=N)")
+    # incident layer (obs.anomaly / obs.blackbox / obs.postmortem;
+    # front-end path only)
+    ss.add_argument("--anomaly", action="store_true",
+                    help="run the deterministic anomaly detectors "
+                         "(residual band, burn slope, gray failure) "
+                         "in the tick loop; advisory-only, never "
+                         "changes scheduling (front-end path only)")
+    ss.add_argument("--incident-dir", default=None,
+                    help="dump an incident-<tick>/ postmortem bundle "
+                         "here on every typed error or detector "
+                         "firing (front-end path only); read back "
+                         "with `cli obs postmortem --run DIR`")
     # telemetry (attention_tpu.obs)
     ss.add_argument("--obs", action="store_true",
                     help="enable the unified telemetry subsystem for "
@@ -1071,6 +1113,30 @@ def _cmd_obs_report(args: argparse.Namespace) -> int:
                     if tts["tick"] is not None
                     else "beyond horizon")
             print(f"  saturation[{name}] @ {tts['watermark']:g}: {when}")
+    # anomaly observatory (obs.anomaly), when the run dumped one
+    adoc = None
+    if args.run:
+        from attention_tpu import obs as obs_mod
+
+        adoc = obs_mod.load_anomaly(args.run)
+    if adoc is not None:
+        print("== anomalies ==")
+        det = adoc["detectors"]
+        rb = det["residual_band"]
+        print(f"  residual_band: residual={rb['residual']:g} "
+              f"band_p90={rb['band_p90']:g} "
+              f"ticks={rb['observed_ticks']}")
+        for obj, slope in sorted(det["burn_slope"].items()):
+            print(f"  burn_slope[{obj}]: slope={slope:g}")
+        for rep, score in sorted(det["gray_failure"].items()):
+            print(f"  gray_failure[{rep}]: score={score:g}")
+        if adoc["firings"]:
+            for f in adoc["firings"]:
+                print(f"  fired @ tick {f['tick']}: {f['detector']}"
+                      f"[{f['key']}] value={f['value']:g} "
+                      f"bound={f['bound']:g}")
+        else:
+            print("  (no firings)")
     print("== spans ==")
     agg: dict[str, list[float]] = {}
     for e in events:
@@ -1185,6 +1251,37 @@ def _cmd_obs_forecast(args: argparse.Namespace) -> int:
     if args.horizon is not None:
         doc = capacity_mod.rebuild_report(doc, horizon=args.horizon)
     print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0
+
+
+def _cmd_obs_postmortem(args: argparse.Namespace) -> int:
+    """Reconstruct every incident bundle under ``--run`` into a
+    cross-replica causal timeline: alarm, correlated trigger events,
+    then the ring slice in coordinate order.  Byte-deterministic from
+    the bundles alone — same-seed runs print identical reports.  With
+    ``--chrome OUT`` also writes a chrome trace whose incident lane
+    (pid 4) sits beside the request lanes."""
+    import json
+
+    from attention_tpu.obs import postmortem as pm_mod
+
+    if not args.run:
+        print("obs postmortem requires --run (an incident directory "
+              "written via --incident-dir or a chaos campaign)",
+              file=sys.stderr)
+        return 1
+    bundles = pm_mod.list_incidents(args.run)
+    if not bundles:
+        print(f"no incident bundles under {args.run}", file=sys.stderr)
+        return 1
+    print("\n".join(pm_mod.report_lines(args.run)))
+    if args.chrome:
+        from attention_tpu import obs
+
+        loaded = [pm_mod.load_incident(b) for b in bundles]
+        with open(args.chrome, "w") as f:
+            json.dump(obs.chrome_trace([], incidents=loaded), f)
+        _logger.info("wrote incident chrome trace: %s", args.chrome)
     return 0
 
 
@@ -1394,12 +1491,14 @@ def main(argv: list[str] | None = None) -> int:
                      ("export", _cmd_obs_export),
                      ("trace", _cmd_obs_trace),
                      ("slo", _cmd_obs_slo),
-                     ("forecast", _cmd_obs_forecast)):
+                     ("forecast", _cmd_obs_forecast),
+                     ("postmortem", _cmd_obs_postmortem)):
         sp = obsub.add_parser(name)
         sp.add_argument("--run", default=None,
                         help="telemetry dump directory written by "
                              "`serve-sim --obs-out` (default: the live "
-                             "in-process registry)")
+                             "in-process registry); for postmortem, "
+                             "the incident directory")
         sp.add_argument("--device-trace", default=None,
                         help="jax.profiler trace dir for the device "
                              "lane (default: <run>/device if present)")
@@ -1419,6 +1518,10 @@ def main(argv: list[str] | None = None) -> int:
                             help="rebuild the report from the dump's "
                                  "embedded samples at this horizon "
                                  "(default: print the dump verbatim)")
+        if name == "postmortem":
+            sp.add_argument("--chrome", default=None,
+                            help="also write a chrome trace with the "
+                                 "incident lane (pid 4) here")
         sp.set_defaults(fn=fn)
 
     _setup_logging()

@@ -294,6 +294,12 @@ class ServingEngine:
         # cannot.  ``nonfinite_events`` counts logits rows the finite
         # guard rejected before sampling.
         self.last_step_virtual_cost = 1.0
+        # standing degradation knob: every step's virtual cost starts
+        # from this multiplier (1.0 = healthy), so a bench or chaos
+        # harness can pin a replica "slow" for a whole window instead
+        # of re-injecting per step — the supervisor and the gray-
+        # failure detector then see a persistent signal
+        self.step_cost_multiplier = 1.0
         self.nonfinite_events = 0
         # consecutive finite-guard skips per request: a TRANSIENT
         # non-finite window (the chaos nan injector poisons returned
@@ -549,7 +555,7 @@ class ServingEngine:
         sampled tokens."""
         t0 = time.perf_counter()
         self._finished_in_step = 0
-        self.last_step_virtual_cost = 1.0
+        self.last_step_virtual_cost = self.step_cost_multiplier
         self._last_fetch_s = 0.0
         pad_tokens = 0
         occupancy = 0.0

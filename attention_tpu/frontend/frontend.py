@@ -40,9 +40,12 @@ import os
 from typing import Any, Callable, Sequence
 
 from attention_tpu import obs
+from attention_tpu.obs import blackbox as _blackbox
 from attention_tpu.obs import capacity as _capacity
 from attention_tpu.obs import trace as _trace
+from attention_tpu.obs.anomaly import AnomalyPolicy, AnomalyTracker
 from attention_tpu.obs.forecast import ForecastPolicy, HoltForecaster, _r6
+from attention_tpu.obs.postmortem import PostmortemWriter
 from attention_tpu.obs.naming import (
     SERIES_TPOT_DIGEST,
     SERIES_TTFT_DIGEST,
@@ -259,6 +262,14 @@ class FrontendConfig:
     # with snapshot_dir set — store state persists across warm
     # restarts as its own CRC'd-section file
     prefix_store: PrefixStoreConfig | None = None
+    # incident layer (obs.anomaly / obs.postmortem): ``anomaly`` arms
+    # the online detectors — deterministic bookkeeping fed from the
+    # tick loop, advisory-only, None = disabled = zero tick-loop work
+    # (the forecast contract).  ``incident_dir`` arms the postmortem
+    # writer: detector firings, replica kills, and injected faults
+    # each dump one atomic `incident-<tick>/` bundle there.
+    anomaly: AnomalyPolicy | None = None
+    incident_dir: str | None = None
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -295,6 +306,8 @@ class FrontendConfig:
             self.forecast.validate()
         if self.prefix_store is not None:
             self.prefix_store.validate()
+        if self.anomaly is not None:
+            self.anomaly.validate()
 
 
 def _cumulative_series(pairs, n: int) -> list[float]:
@@ -414,6 +427,30 @@ class ServingFrontend:
         self.on_token = on_token
         self.on_finish = on_finish
 
+        # deterministic mirrors of the obs counters (telemetry is off
+        # by default; the summary must not depend on it)
+        self.counts = {
+            "shed_rejected": 0, "downclassed": 0,
+            "retries_scheduled": 0, "retries_exhausted": 0,
+            "migrations": 0, "deadline_expired": 0,
+            "replica_kills": 0, "replica_restarts": 0,
+            "warm_restarts": 0, "warm_adoptions": 0,
+            "live_migrations": 0, "migrations_stranded": 0,
+            "standby_promotions": 0, "supervisor_suspects": 0,
+            "supervisor_degraded": 0, "supervisor_dead": 0,
+            "supervisor_recoveries": 0,
+            "anomaly_firings": 0, "incidents": 0,
+        }
+        self._tick = 0
+        #: incident-bundle writer (None = no dumping) — constructed
+        #: BEFORE the store load so a corrupt persisted store already
+        #: has somewhere to file its incident
+        self.postmortem = (PostmortemWriter(config.incident_dir)
+                           if config.incident_dir is not None else None)
+        #: online anomaly detectors (None = disabled = zero tick work)
+        self.anomaly = (AnomalyTracker(config.anomaly)
+                        if config.anomaly is not None else None)
+
         # fleet prefix store: built (or warm-reloaded) BEFORE the
         # replicas so every engine incarnation attaches to the one
         # shared instance.  A corrupt persisted store is the same
@@ -429,6 +466,9 @@ class ServingFrontend:
                 except PrefixStoreCorruptError:
                     self.prefix_store = PrefixStore(config.prefix_store)
                     self.prefix_store.note_corrupt()
+                    self._incident("typed_error", {
+                        "error": "PrefixStoreCorruptError",
+                        "path": path})
             else:
                 self.prefix_store = PrefixStore(config.prefix_store)
         #: requests coalesced behind a single-flight prefill lease,
@@ -448,7 +488,6 @@ class ServingFrontend:
             self._make_handle(f"standby-{k}", spare=True)
             for k in range(config.standbys)
         ]
-        self._tick = 0
         self._seq = itertools.count()
         self.requests: dict[str, FrontendRequest] = {}
         self._pending: list[FrontendRequest] = []  # (arrival, seq) order
@@ -464,19 +503,6 @@ class ServingFrontend:
         #: load forecaster (None = disabled = zero tick-loop work)
         self.forecast = (ForecastTracker(config.forecast)
                          if config.forecast is not None else None)
-        # deterministic mirrors of the obs counters (telemetry is off
-        # by default; the summary must not depend on it)
-        self.counts = {
-            "shed_rejected": 0, "downclassed": 0,
-            "retries_scheduled": 0, "retries_exhausted": 0,
-            "migrations": 0, "deadline_expired": 0,
-            "replica_kills": 0, "replica_restarts": 0,
-            "warm_restarts": 0, "warm_adoptions": 0,
-            "live_migrations": 0, "migrations_stranded": 0,
-            "standby_promotions": 0, "supervisor_suspects": 0,
-            "supervisor_degraded": 0, "supervisor_dead": 0,
-            "supervisor_recoveries": 0,
-        }
 
     def _make_handle(self, replica_id: str, *,
                      spare: bool = False) -> ReplicaHandle:
@@ -581,6 +607,9 @@ class ServingFrontend:
         fr.waiting_since = None
         if self.forecast is not None:
             self.forecast.note_token(replica_id)
+        if self.anomaly is not None:
+            self.anomaly.observe_tokens(
+                self._tick, replica_id, req.request_id, 1)
         if self.on_token is not None:
             self.on_token(fr, int(token))
 
@@ -652,6 +681,10 @@ class ServingFrontend:
              and fr.replica_id == replica_id),
             key=lambda f: f.seq,
         )
+        # note BEFORE the kill so the record carries the dying
+        # incarnation's live coordinates
+        self._bb_note("replica_kill", replica_id=replica_id,
+                      victims=len(victims))
         handle.kill()
         self.router.forget_replica(replica_id)
         self.counts["replica_kills"] += 1
@@ -659,6 +692,9 @@ class ServingFrontend:
         cause = ReplicaDeadError(
             f"replica {replica_id} died at tick {self._tick}"
         )
+        self._incident("typed_error", {
+            "error": "ReplicaDeadError", "replica": replica_id,
+            "victims": len(victims)})
         for fr in victims:
             self._requeue(fr, self._tick, cause)
         return True
@@ -700,6 +736,8 @@ class ServingFrontend:
         self._apply_ladder_to(handle)
         self.counts["replica_restarts"] += 1
         _RESTARTED.inc()
+        self._bb_note("replica_restart", replica_id=replica_id,
+                      mode=mode)
         return True
 
     def _reconcile_restored(self, handle: ReplicaHandle) -> None:
@@ -762,6 +800,35 @@ class ServingFrontend:
             **extra,
         )
 
+    def _bb_note(self, kind: str, *, replica_id: str | None = None,
+                 tick: int | None = None, **extra: Any) -> None:
+        """Stamp one fleet flight-recorder event with the replica's
+        current deterministic coordinates (incarnation -1 step while
+        it is down), mirroring `_trace_event`'s discipline for
+        per-request traces."""
+        if not _blackbox.active():
+            return
+        handle = self._handle(replica_id)
+        _blackbox.note(
+            kind,
+            tick=self._tick if tick is None else tick,
+            replica=replica_id,
+            incarnation=handle.deaths if handle is not None else 0,
+            step=(handle.engine.current_step
+                  if handle is not None and handle.alive else -1),
+            **extra,
+        )
+
+    def _incident(self, cause: str, detail: dict[str, Any]) -> None:
+        """File one incident bundle (dedup'd by the writer) for a
+        typed error, detector firing, or chaos trigger; a no-op
+        without an ``incident_dir``."""
+        if self.postmortem is None:
+            return
+        if self.postmortem.maybe_dump(
+                tick=self._tick, cause=cause, detail=detail) is not None:
+            self.counts["incidents"] += 1
+
     def _finalize(self, fr: FrontendRequest,
                   state: FrontendRequestState, *,
                   error: BaseException | None = None) -> None:
@@ -783,6 +850,22 @@ class ServingFrontend:
             # the tick-expiry window
             self.prefix_store.leases.release_owner(fr.request_id)
         self._trace_event(fr, _TERMINAL_EVENT[state])
+        if state is FrontendRequestState.SHED:
+            # the flight recorder's watermark-shed / budget-dry event
+            # (both shed paths funnel through here)
+            self._bb_note("shed", replica_id=fr.last_replica,
+                          request=fr.request_id,
+                          cause=type(fr.error).__name__
+                          if fr.error is not None else None)
+        if self.anomaly is not None:
+            n = len(fr.tokens)
+            ttft = (fr.first_token_tick - fr.arrival
+                    if fr.first_token_tick is not None else None)
+            tpot = ((fr.finish_tick - fr.first_token_tick) / (n - 1)
+                    if fr.first_token_tick is not None and n > 1
+                    else None)
+            self.anomaly.observe_latency(self._tick, ttft, tpot)
+            self.anomaly.forget_request(fr.request_id)
         if obs.enabled() and state is FrontendRequestState.FINISHED:
             labels = {"replica": fr.replica_id or "none"}
             if fr.first_token_tick is not None:
@@ -867,10 +950,14 @@ class ServingFrontend:
         MUST stop waiting."""
         if self.prefix_store is None:
             return
-        for key, owner in self.prefix_store.leases.active(now=t):
+        leases = self.prefix_store.leases
+        if leases.expire(now=t):
+            for key in leases.last_expired:
+                self._bb_note("lease_expire", tick=t, key=key[:12])
+        for key, owner in leases.active(now=t):
             fr = self.requests.get(owner)
             if fr is not None and not fr.is_terminal:
-                self.prefix_store.leases.acquire(key, owner, now=t)
+                leases.acquire(key, owner, now=t)
 
     def _admit_store_waiters(self, t: int) -> None:
         """Re-evaluate every coalesced request (seq order): the leader
@@ -905,6 +992,9 @@ class ServingFrontend:
         key = chain_key(key_toks)
         owner = store.leases.holder(key, now=t)
         if owner is None or owner == fr.request_id:
+            if owner is None:   # fresh grant (not a leader refresh)
+                self._bb_note("lease_grant", tick=t,
+                              request=fr.request_id, key=key[:12])
             store.leases.acquire(key, fr.request_id, now=t)
             return True   # this request leads the flight
         if fr.request_id not in self._coalesced_ids:
@@ -975,6 +1065,9 @@ class ServingFrontend:
         fr.waiting_since = None
         self._trace_event(fr, "routed", reason=decision.reason)
         self._trace_event(fr, "admitted")
+        self._bb_note("route_decision", replica_id=handle.replica_id,
+                      tick=t, request=fr.request_id,
+                      reason=decision.reason)
         self.events_log.append(
             ("admit", t, fr.request_id, handle.replica_id))
 
@@ -1102,6 +1195,10 @@ class ServingFrontend:
         self.supervisor.reset(t, spare.replica_id)
         self.counts["standby_promotions"] += 1
         _PROMOTED.inc()
+        self._bb_note("standby_promote", replica_id=spare.replica_id,
+                      mode=mode,
+                      replaced=(failed.replica_id
+                                if failed is not None else None))
         if mode == "warm":
             self.counts["warm_restarts"] += 1
             self._reconcile_restored(spare)
@@ -1123,6 +1220,10 @@ class ServingFrontend:
         self._trace_event(fr, "migrated", source=fr.last_replica,
                           dest=dest.replica_id,
                           tokens_at_cut=len(fr.tokens))
+        self._bb_note("replica_migrate", replica_id=dest.replica_id,
+                      tick=t, request=fr.request_id,
+                      source=fr.last_replica,
+                      tokens_at_cut=len(fr.tokens))
         self.events_log.append(
             ("admit", t, fr.request_id, dest.replica_id))
 
@@ -1194,6 +1295,8 @@ class ServingFrontend:
                 self._apply_ladder_to(handle)
         if self.forecast is not None:
             self._observe_forecast(t, mean)
+        if self.anomaly is not None:
+            self._observe_anomaly(t, mean)
         if obs.enabled():
             _LEVEL_G.set(self.ladder.level)
             _PRESSURE_G.set(mean)
@@ -1229,6 +1332,32 @@ class ServingFrontend:
         elif pred >= down_wm and mean < down_wm:
             self.events_log.append(
                 ("forecast", t, "would_downclass", _r6(pred), _r6(mean)))
+
+    def _observe_anomaly(self, t: int, mean: float) -> None:
+        """Run the online anomaly detectors over this tick's
+        frozen-series inputs.  Advisory-only (the forecast contract):
+        a firing lands in the event log, the flight recorder, and —
+        with an ``incident_dir`` — one postmortem bundle; control
+        flow never reads it."""
+        tracker = self.anomaly
+        tracker.observe_pressure(t, mean)
+        new = tracker.step(t)
+        for f in new:
+            self.counts["anomaly_firings"] += 1
+            self.events_log.append((
+                "anomaly", t, f["detector"], f["key"],
+                f["value"], f["bound"]))
+            key = f["key"]
+            # a gray-failure key IS a replica id; stamp it so the
+            # ring record carries the suspect's coordinates
+            rid = key if self._handle(key) is not None else None
+            self._bb_note("anomaly_fire", replica_id=rid, tick=t,
+                          detector=f["detector"], key=key,
+                          value=f["value"], bound=f["bound"])
+            self._incident("detector", {
+                "detector": f["detector"], "key": key,
+                "value": f["value"], "bound": f["bound"]})
+        tracker.publish(new)
 
     @property
     def forecast_pressure(self) -> float | None:

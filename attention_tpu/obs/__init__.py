@@ -41,21 +41,28 @@ from attention_tpu.obs.export import (  # noqa: F401
     device_dir_of,
     dump,
     jsonl_lines,
+    load_anomaly,
+    load_blackbox,
     load_dump,
     load_forecast,
     load_slo,
     load_traces,
     prom_text,
+    write_anomaly,
     write_forecast,
     write_jsonl,
     write_slo,
 )
 from attention_tpu.obs.naming import (  # noqa: F401
+    ANOMALY_DETECTORS,
+    BLACKBOX_EVENTS,
     FROZEN_SERIES,
     TRACE_EVENTS,
     TRACE_TERMINAL_EVENTS,
+    check_blackbox_event,
     check_event,
     check_name,
+    require_blackbox_event,
     require_event,
     require_name,
 )
@@ -85,8 +92,11 @@ from attention_tpu.obs.spans import (  # noqa: F401
     record_event,
     span,
 )
+from attention_tpu.obs import anomaly  # noqa: F401
+from attention_tpu.obs import blackbox  # noqa: F401
 from attention_tpu.obs import capacity  # noqa: F401
 from attention_tpu.obs import forecast  # noqa: F401
+from attention_tpu.obs import postmortem  # noqa: F401
 from attention_tpu.obs import slo  # noqa: F401
 from attention_tpu.obs import spans as _spans
 from attention_tpu.obs import trace  # noqa: F401
@@ -98,11 +108,13 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Zero every metric series and drop every span event and request
-    trace (instrument registrations survive)."""
+    """Zero every metric series and drop every span event, request
+    trace, and flight-recorder record (instrument registrations
+    survive)."""
     REGISTRY.reset()
     _spans.clear()
     trace.clear()
+    blackbox.clear()
 
 
 def shape_bucket(*dims: int) -> str:

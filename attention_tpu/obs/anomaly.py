@@ -1,0 +1,353 @@
+"""Deterministic online anomaly detectors over the frozen series.
+
+Three advisory detectors run inside the frontend tick loop, every one
+a pure function of the same frozen byte-deterministic inputs the
+forecaster stack consumes (`obs/naming.py:FROZEN_SERIES` — never wall
+time, never the registry itself at decision time):
+
+* **residual_band** — the one-step forecaster residual of mean fleet
+  pressure leaves its backtested p90 band (the
+  :class:`~attention_tpu.obs.forecast.HoltForecaster` residual state,
+  re-used as the detector's own model);
+* **burn_slope** — an SLO objective's error-budget burn rate RISES
+  across two adjacent windows (absolute burn is the SLO report's job;
+  the slope is the early-warning signal);
+* **gray_failure** — one replica's recent inter-token gaps (its
+  per-replica TTFT/TPOT view) diverge from the merge of its peers
+  beyond a pinned ratio — the partially-failed-but-not-dead replica
+  the supervisor's liveness checks cannot see.
+
+Like :class:`~attention_tpu.obs.forecast.ForecastTracker`, the tracker
+is plain Python state fed by the frontend regardless of the telemetry
+flag — detection works with the registry off, and the off↔on token
+streams stay byte-identical because detectors are advisory-only: a
+firing is recorded (tracker state, blackbox ring, incident bundle),
+never acted on.  Gauges under the frozen ``frontend.anomaly.*`` names
+publish only when telemetry is enabled.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any
+
+from attention_tpu.obs import registry as _registry
+from attention_tpu.obs.forecast import ForecastPolicy, HoltForecaster
+from attention_tpu.obs.naming import (
+    SERIES_ANOMALY_BURN_SLOPE,
+    SERIES_ANOMALY_FIRINGS,
+    SERIES_ANOMALY_GRAY_SCORE,
+    SERIES_ANOMALY_RESIDUAL,
+    require_detector,
+)
+from attention_tpu.obs.registry import counter, gauge
+from attention_tpu.obs.slo import DEFAULT_OBJECTIVES
+
+ANOMALY_REPORT_VERSION = 1
+
+#: inter-token gaps are clipped here — a single pathological stall
+#: must not poison a replica's window mean forever
+GRAY_GAP_CLIP = 16.0
+
+
+
+def _r6(x: float) -> float:
+    return round(float(x), 6)
+
+
+def _p90(xs: list[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(int(0.9 * len(s)), len(s) - 1)]
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyPolicy:
+    """Pinned detector bounds (all advisory; validated at frontend
+    construction like :class:`~attention_tpu.obs.forecast.ForecastPolicy`)."""
+
+    #: residual_band: |residual| must exceed band_p90 * scale ...
+    residual_scale: float = 3.0
+    #: ... and this floor (cold bands are tiny; don't fire on noise)
+    residual_min_band: float = 0.5
+    #: residual_band: ticks of forecaster history before arming
+    residual_warmup: int = 12
+    #: burn_slope: window width in ticks (two adjacent windows compared)
+    burn_window: int = 32
+    #: burn_slope: fire when recent burn - prior burn exceeds this
+    burn_slope_bound: float = 2.0
+    #: burn_slope: min finished requests per window before arming
+    burn_min_requests: int = 4
+    #: gray_failure: samples older than this many ticks are ignored
+    gray_window: int = 64
+    #: gray_failure: replica trail mean / peer mean ratio that fires
+    gray_ratio: float = 2.0
+    #: gray_failure: min recent samples on BOTH sides before arming
+    gray_min_samples: int = 4
+    #: gray_failure: per-replica recent-sample trail length (recency
+    #: beats a tick-window mean: a degraded replica's first slow
+    #: tokens move the score immediately instead of drowning in
+    #: pre-fault samples)
+    gray_trail: int = 8
+
+    def validate(self) -> None:
+        if self.residual_scale <= 0 or self.residual_min_band < 0:
+            raise ValueError(
+                "residual_scale must be > 0 and residual_min_band >= 0")
+        if self.residual_warmup < 1:
+            raise ValueError("residual_warmup must be >= 1")
+        if self.burn_window < 2 or self.burn_min_requests < 1:
+            raise ValueError(
+                "burn_window must be >= 2 and burn_min_requests >= 1")
+        if self.burn_slope_bound <= 0:
+            raise ValueError("burn_slope_bound must be > 0")
+        if self.gray_window < 1 or self.gray_min_samples < 1:
+            raise ValueError(
+                "gray_window and gray_min_samples must be >= 1")
+        if self.gray_trail < 1:
+            raise ValueError("gray_trail must be >= 1")
+        if self.gray_ratio <= 1.0:
+            raise ValueError("gray_ratio must be > 1.0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "AnomalyPolicy":
+        return cls(**d)
+
+
+# the frozen gauges the detectors publish onto (creation is allowed
+# while disabled; recording is gated inside the registry)
+_RESIDUAL_G = gauge(SERIES_ANOMALY_RESIDUAL,
+                    "one-step forecast residual of mean fleet pressure")
+_BURN_SLOPE_G = gauge(SERIES_ANOMALY_BURN_SLOPE,
+                      "SLO burn-rate slope across adjacent windows")
+_GRAY_G = gauge(SERIES_ANOMALY_GRAY_SCORE,
+                "per-replica latency divergence vs peer merge")
+_FIRINGS_C = counter(SERIES_ANOMALY_FIRINGS,
+                     "anomaly detector firings by detector")
+
+
+class AnomalyTracker:
+    """Online detector state, fed from the frontend tick loop.
+
+    Feeds (all plain scalars, all deterministic):
+
+    * :meth:`observe_pressure` — per-tick mean fleet pressure
+      (residual_band input);
+    * :meth:`observe_latency` — per-finished-request TTFT/TPOT in
+      ticks (burn_slope input, same row math as `obs.slo`);
+    * :meth:`observe_tokens` — per-tick token emissions per request
+      (gray_failure input: inter-token gaps per replica).
+
+    :meth:`step` runs the detectors once per tick and returns the NEW
+    firings (rising-edge: a condition that stays true keeps one firing
+    active rather than firing every tick — incident bundles stay
+    bounded)."""
+
+    def __init__(self, policy: AnomalyPolicy | None = None):
+        self.policy = policy or AnomalyPolicy()
+        self.policy.validate()
+        # residual_band
+        self._fc = HoltForecaster(ForecastPolicy())
+        self._residual = 0.0
+        self._band = 0.0
+        # burn_slope: objective name -> deque[(tick, violated)]
+        self._burn: dict[str, collections.deque] = {
+            o.name: collections.deque(maxlen=4096)
+            for o in DEFAULT_OBJECTIVES
+        }
+        self._objectives = {o.name: o for o in DEFAULT_OBJECTIVES}
+        self._slopes: dict[str, float] = {}
+        # gray_failure: replica -> deque[(tick, gap_per_token)]
+        self._gaps: dict[str, collections.deque] = {}
+        self._last_emit: dict[str, tuple[str, int]] = {}
+        self._scores: dict[str, float] = {}
+        #: (detector, key) pairs whose condition currently holds
+        self.active: set[tuple[str, str]] = set()
+        #: every rising-edge firing, in firing order
+        self.firings: list[dict[str, Any]] = []
+
+    # -- feeds -------------------------------------------------------------
+
+    def observe_pressure(self, tick: int, mean_pressure: float) -> None:
+        """One fleet-pressure sample; backtests the residual BEFORE
+        the forecaster absorbs it (the `HoltForecaster.observe`
+        discipline)."""
+        del tick
+        if self._fc.count >= 1:
+            self._residual = float(mean_pressure) - self._fc.predict(1)
+        self._fc.observe(float(mean_pressure))
+        self._band = _p90([abs(r) for r in self._fc.residuals])
+
+    def observe_latency(self, tick: int, ttft_ticks: float | None,
+                        tpot_ticks: float | None) -> None:
+        """One finished request's latency row (ticks, never wall
+        time); None marks the metric unavailable (counts as a TTFT
+        violation, skipped for TPOT — the `obs.slo` row rules)."""
+        for name, obj in self._objectives.items():
+            if obj.metric == "ttft":
+                v = 1 if (ttft_ticks is None
+                          or ttft_ticks > obj.threshold_ticks) else 0
+            else:
+                if tpot_ticks is None:
+                    continue
+                v = 1 if tpot_ticks > obj.threshold_ticks else 0
+            self._burn[name].append((int(tick), v))
+
+    def observe_tokens(self, tick: int, replica: str, request_id: str,
+                       n_tokens: int) -> None:
+        """``n_tokens`` new output tokens for ``request_id`` on
+        ``replica`` at ``tick``; consecutive emissions yield
+        inter-token gap samples (the first emission only arms the
+        clock)."""
+        if n_tokens <= 0:
+            return
+        prev = self._last_emit.get(request_id)
+        if prev is not None:
+            prev_replica, prev_tick = prev
+            # a cross-replica gap measures the migration (retry,
+            # adoption), not the destination replica — re-arm only,
+            # else a sick replica's evacuees get its peers flagged
+            if prev_replica == replica:
+                gap = min((tick - prev_tick) / float(n_tokens),
+                          GRAY_GAP_CLIP)
+                q = self._gaps.get(replica)
+                if q is None:
+                    q = self._gaps[replica] = collections.deque(
+                        maxlen=512)
+                q.append((int(tick), gap))
+        self._last_emit[request_id] = (replica, int(tick))
+
+    def forget_request(self, request_id: str) -> None:
+        """Drop the per-request emission clock (terminal request)."""
+        self._last_emit.pop(request_id, None)
+
+    # -- detectors ---------------------------------------------------------
+
+    def _burn_rate(self, name: str, lo: int, hi: int) -> tuple[float, int]:
+        """(burn rate, request count) over window ticks (lo, hi]."""
+        obj = self._objectives[name]
+        n = viol = 0
+        for t, v in self._burn[name]:
+            if lo < t <= hi:
+                n += 1
+                viol += v
+        if n == 0:
+            return 0.0, 0
+        return (viol / n) / (1.0 - obj.quantile), n
+
+    def _edge(self, tick: int, detector: str, key: str, cond: bool,
+              value: float, bound: float,
+              new: list[dict[str, Any]]) -> None:
+        """Rising-edge bookkeeping shared by all three detectors."""
+        require_detector(detector)
+        state = (detector, key)
+        if cond and state not in self.active:
+            self.active.add(state)
+            firing = {"tick": int(tick), "detector": detector,
+                      "key": key, "value": _r6(value),
+                      "bound": _r6(bound)}
+            self.firings.append(firing)
+            new.append(firing)
+        elif not cond:
+            self.active.discard(state)
+
+    def step(self, tick: int) -> list[dict[str, Any]]:
+        """Run every detector once; returns the NEW firings at this
+        tick (possibly empty)."""
+        p = self.policy
+        new: list[dict[str, Any]] = []
+
+        # residual_band
+        bound = max(self._band * p.residual_scale, p.residual_min_band)
+        armed = self._fc.count >= p.residual_warmup
+        self._edge(tick, "residual_band", "fleet",
+                   armed and abs(self._residual) > bound,
+                   abs(self._residual), bound, new)
+
+        # burn_slope
+        for name in sorted(self._burn):
+            recent, n_r = self._burn_rate(
+                name, tick - p.burn_window, tick)
+            prior, n_p = self._burn_rate(
+                name, tick - 2 * p.burn_window, tick - p.burn_window)
+            slope = recent - prior
+            self._slopes[name] = slope
+            armed = (n_r >= p.burn_min_requests
+                     and n_p >= p.burn_min_requests)
+            self._edge(tick, "burn_slope", name,
+                       armed and slope > p.burn_slope_bound,
+                       slope, p.burn_slope_bound, new)
+
+        # gray_failure
+        means: dict[str, tuple[float, int]] = {}
+        for rep in sorted(self._gaps):
+            recent = [g for t, g in self._gaps[rep]
+                      if t > tick - p.gray_window]
+            trail = recent[-p.gray_trail:]
+            if trail:
+                means[rep] = (sum(trail) / len(trail), len(trail))
+        for rep in sorted(means):
+            mine, n_mine = means[rep]
+            peer_sum = peer_n = 0.0
+            for other, (m, n) in means.items():
+                if other != rep:
+                    peer_sum += m * n
+                    peer_n += n
+            if peer_n >= p.gray_min_samples and peer_sum > 0:
+                score = mine / (peer_sum / peer_n)
+            else:
+                score = 1.0
+            self._scores[rep] = score
+            armed = (n_mine >= p.gray_min_samples
+                     and peer_n >= p.gray_min_samples)
+            self._edge(tick, "gray_failure", rep,
+                       armed and score > p.gray_ratio,
+                       score, p.gray_ratio, new)
+        return new
+
+    # -- outputs -----------------------------------------------------------
+
+    def publish(self, new_firings: list[dict[str, Any]]) -> None:
+        """Mirror detector state onto the frozen gauges (no-op while
+        telemetry is disabled — the registry gates every set)."""
+        if not _registry.is_enabled():
+            return
+        _RESIDUAL_G.set(_r6(self._residual))
+        for name in sorted(self._slopes):
+            _BURN_SLOPE_G.set(_r6(self._slopes[name]), objective=name)
+        for rep in sorted(self._scores):
+            _GRAY_G.set(_r6(self._scores[rep]), replica=rep)
+        for f in new_firings:
+            _FIRINGS_C.inc(detector=f["detector"])
+
+    def report(self) -> dict[str, Any]:
+        """Canonical plain-data detector state (the ``anomaly.json``
+        dump and the ``cli obs report`` anomalies section)."""
+        return {
+            "version": ANOMALY_REPORT_VERSION,
+            "generated_at": 0,
+            "policy": self.policy.to_dict(),
+            "detectors": {
+                "residual_band": {
+                    "residual": _r6(self._residual),
+                    "band_p90": _r6(self._band),
+                    "observed_ticks": self._fc.count,
+                },
+                "burn_slope": {
+                    name: _r6(self._slopes.get(name, 0.0))
+                    for name in sorted(self._burn)
+                },
+                "gray_failure": {
+                    rep: _r6(self._scores[rep])
+                    for rep in sorted(self._scores)
+                },
+            },
+            "active": sorted(
+                [list(pair) for pair in self.active]),
+            "firings": [dict(f) for f in self.firings],
+        }

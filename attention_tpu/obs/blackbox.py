@@ -1,0 +1,139 @@
+"""Fleet flight recorder: a bounded ring of typed causal events.
+
+Where a :mod:`~attention_tpu.obs.trace` chain is the journey of ONE
+request, the **blackbox ring** is the fleet's own black box: every
+causal decision the serving stack makes — routing choices, watermark
+sheds, prefill-lease grants/expiries, prefix-store imports/evictions/
+corruptions, replica kills/restarts/migrations, chaos fault
+injections, anomaly-detector firings — lands in one append-only
+bounded ring, each record stamped with the four deterministic
+coordinates of the serving stack —
+
+    ``(front-end tick, replica id, incarnation, engine step)``
+
+— never wall time, so the same seed produces a byte-identical ring.
+Event kinds are the closed enum ``obs/naming.py:BLACKBOX_EVENTS``
+(rejected at note time, linted as ATP507 at review time).  When an
+incident fires, :mod:`~attention_tpu.obs.postmortem` slices this ring
+around the incident tick: the ring is the causal evidence the
+postmortem timeline is reconstructed from.
+
+Gating: recording is off unless telemetry is enabled (the PR 3
+zero-overhead contract — the disabled path is one global read and a
+return) or a :func:`capture` scope is active.  ``capture`` exists for
+the chaos harness: fault campaigns assert incident completeness
+without turning the whole registry on.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+from typing import Any, Iterator
+
+from attention_tpu.obs import registry as _registry
+from attention_tpu.obs.naming import require_blackbox_event
+
+#: most events kept live; the oldest record drops first
+BLACKBOX_CAPACITY = 65536
+
+_lock = threading.Lock()
+_ring: collections.deque[dict[str, Any]] = collections.deque(
+    maxlen=BLACKBOX_CAPACITY)
+_seq = 0  # total records ever noted (monotone across evictions)
+_forced = 0  # >0 inside a capture() scope: record regardless of obs flag
+
+
+def active() -> bool:
+    """True iff flight recording is currently on."""
+    return _registry._enabled or _forced > 0
+
+
+@contextlib.contextmanager
+def capture() -> Iterator[None]:
+    """Scope that records flight events even while telemetry is
+    disabled.
+
+    Clears the ring on entry — each chaos plan gets an isolated ring
+    to assert incident completeness over (synthetic fault schedules
+    repeat across plans)."""
+    global _forced, _seq
+    with _lock:
+        _forced += 1
+        _ring.clear()
+        _seq = 0
+    try:
+        yield
+    finally:
+        with _lock:
+            _forced -= 1
+
+
+def note(kind: str, *, tick: int, replica: str | None = None,
+         incarnation: int = 0, step: int = -1, **extra: Any) -> None:
+    """Append one typed event to the fleet ring.
+
+    ``extra`` carries decision details (``reason`` for routing,
+    ``key`` for store events, ``fault`` for injections) and must be
+    plain scalars — the ring is serialized verbatim into incident
+    bundles."""
+    global _seq
+    if not (_registry._enabled or _forced):
+        return
+    require_blackbox_event(kind)
+    rec: dict[str, Any] = {
+        "kind": kind,
+        "tick": int(tick),
+        "replica": replica,
+        "incarnation": int(incarnation),
+        "step": int(step),
+    }
+    for k in sorted(extra):
+        v = extra[k]
+        if v is not None and not isinstance(v, (str, int, float, bool)):
+            raise TypeError(
+                f"blackbox extra {k}={v!r} must be a plain scalar"
+            )
+        rec[k] = v
+    with _lock:
+        rec["seq"] = _seq
+        _seq += 1
+        _ring.append(rec)
+
+
+def events(*, since_tick: int | None = None,
+           until_tick: int | None = None,
+           kind: str | None = None) -> list[dict[str, Any]]:
+    """Ring records oldest first (copies), optionally filtered to a
+    tick window ``[since_tick, until_tick]`` and/or one event kind —
+    the postmortem bundle's ring-slice query."""
+    with _lock:
+        recs = [dict(r) for r in _ring]
+    if since_tick is not None:
+        recs = [r for r in recs if r["tick"] >= since_tick]
+    if until_tick is not None:
+        recs = [r for r in recs if r["tick"] <= until_tick]
+    if kind is not None:
+        recs = [r for r in recs if r["kind"] == kind]
+    return recs
+
+
+def depth() -> int:
+    """Records currently held in the ring."""
+    with _lock:
+        return len(_ring)
+
+
+def total() -> int:
+    """Records ever noted since the last clear (>= :func:`depth` once
+    the ring has evicted)."""
+    with _lock:
+        return _seq
+
+
+def clear() -> None:
+    global _seq
+    with _lock:
+        _ring.clear()
+        _seq = 0

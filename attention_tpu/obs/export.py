@@ -38,6 +38,8 @@ DUMP_EVENTS = "events.jsonl"
 DUMP_TRACES = "traces.jsonl"
 DUMP_SLO = "slo.json"
 DUMP_FORECAST = "forecast.json"
+DUMP_ANOMALY = "anomaly.json"
+DUMP_BLACKBOX = "blackbox.jsonl"
 DUMP_DEVICE = "device"
 
 #: percentile-key -> Prometheus quantile-label spelling
@@ -139,6 +141,7 @@ TICK_US = 1000.0
 def chrome_trace(span_events: list[dict] | None = None,
                  device_dir: str | None = None,
                  request_traces: dict[str, list[dict]] | None = None,
+                 incidents: list[dict[str, Any]] | None = None,
                  ) -> dict[str, Any]:
     """The merged host/device timeline as a Chrome-trace dict.
 
@@ -147,7 +150,9 @@ def chrome_trace(span_events: list[dict] | None = None,
     CI path has no device lane).  ``request_traces`` (request id ->
     event chain, default the live trace store) adds one lane per
     request under a third process: each journey is a span from submit
-    to terminal with an instant mark per trace event."""
+    to terminal with an instant mark per trace event.  ``incidents``
+    (loaded postmortem bundles) adds a fourth lane marking each
+    incident's evidence window and trigger tick."""
     evs = spans.events() if span_events is None else span_events
     trace_events: list[dict[str, Any]] = [
         {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
@@ -216,11 +221,18 @@ def chrome_trace(span_events: list[dict] | None = None,
                     "name": ev["event"], "ts": ev["tick"] * TICK_US,
                     "args": args,
                 })
+
+    if incidents:
+        from attention_tpu.obs.postmortem import incident_lane
+
+        trace_events.extend(incident_lane(incidents))
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 
 def dump(out_dir: str) -> None:
     """Persist the live telemetry state under ``out_dir``."""
+    from attention_tpu.obs import blackbox as _blackbox
+
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, DUMP_METRICS), "w") as f:
         json.dump(REGISTRY.snapshot(), f, indent=1)
@@ -232,6 +244,11 @@ def dump(out_dir: str) -> None:
             for rid in sorted(chains):
                 f.write(json.dumps(
                     {"request_id": rid, "events": chains[rid]}) + "\n")
+    ring = _blackbox.events()
+    if ring:
+        with open(os.path.join(out_dir, DUMP_BLACKBOX), "w") as f:
+            for rec in ring:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
 
 
 def load_dump(run_dir: str) -> tuple[dict[str, Any], list[dict]]:
@@ -304,6 +321,39 @@ def load_forecast(run_dir: str) -> dict[str, Any] | None:
         return None
     with open(path) as f:
         return json.load(f)
+
+
+def write_anomaly(out_dir: str, report: dict[str, Any]) -> None:
+    """Persist an `obs.anomaly.AnomalyTracker.report` next to the
+    metrics dump, in canonical form (sorted keys) so same-seed runs
+    are byte-identical."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, DUMP_ANOMALY), "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_anomaly(run_dir: str) -> dict[str, Any] | None:
+    """The dump's anomaly report, or None if the run wrote none."""
+    path = os.path.join(run_dir, DUMP_ANOMALY)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_blackbox(run_dir: str) -> list[dict[str, Any]]:
+    """Flight-recorder ring records from a :func:`dump` directory
+    ([] when the run recorded none)."""
+    path = os.path.join(run_dir, DUMP_BLACKBOX)
+    out: list[dict[str, Any]] = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
 
 
 def device_dir_of(run_dir: str) -> str | None:

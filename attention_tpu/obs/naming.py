@@ -91,6 +91,72 @@ def require_event(event: str) -> str:
     return event
 
 
+# -- flight-recorder event kinds (closed enum) ----------------------------
+#
+# The blackbox ring (obs/blackbox.py) is built ONLY from these typed
+# causal-event kinds; the recorder rejects anything else at note time
+# and the obs-naming lint (ATP507) rejects unknown literals at review
+# time.  Like TRACE_EVENTS, the set is a contract: the chaos
+# `incident_completeness` invariant and the postmortem timeline both
+# reason structurally about these names.
+
+#: the full closed enum of flight-recorder event kinds
+BLACKBOX_EVENTS = frozenset({
+    "route_decision",    # router chose (or refused) a replica
+    "shed",              # request shed on watermark/pressure/deadline
+    "lease_grant",       # prefill lease acquired by a leader
+    "lease_expire",      # prefill lease expired / torn from a dead leader
+    "store_import",      # prefix-store chain imported at admission
+    "store_evict",       # prefix-store record evicted (TTL/LRU/budget)
+    "store_corrupt",     # prefix-store record failed CRC, typed error
+    "replica_kill",      # replica killed (chaos or supervisor verdict)
+    "replica_restart",   # replica restarted (warm or cold)
+    "replica_migrate",   # in-flight request drained source -> dest
+    "standby_promote",   # warm standby promoted into the serving set
+    "fault_injected",    # chaos fault armed/fired by an injector
+    "anomaly_fire",      # an online detector crossed its pinned bound
+    "incident_dump",     # a postmortem bundle was written
+})
+
+
+def check_blackbox_event(kind: str) -> bool:
+    """True iff ``kind`` is a known flight-recorder event kind."""
+    return kind in BLACKBOX_EVENTS
+
+
+def require_blackbox_event(kind: str) -> str:
+    """``kind``, or ValueError naming the closed enum."""
+    if kind not in BLACKBOX_EVENTS:
+        raise ValueError(
+            f"unknown blackbox event {kind!r}; the flight recorder is "
+            f"built from the closed enum in obs/naming.py: "
+            f"{', '.join(sorted(BLACKBOX_EVENTS))}"
+        )
+    return kind
+
+
+# -- anomaly detector names (closed enum) ----------------------------------
+
+#: the online detectors obs/anomaly.py may run — firing records and the
+#: anomaly gauges are labeled ONLY with these names
+ANOMALY_DETECTORS = frozenset({
+    "residual_band",   # forecaster one-step residual outside its band
+    "burn_slope",      # SLO burn rate rising across adjacent windows
+    "gray_failure",    # replica latency diverged from its peers' merge
+})
+
+
+def require_detector(name: str) -> str:
+    """``name``, or ValueError naming the closed enum."""
+    if name not in ANOMALY_DETECTORS:
+        raise ValueError(
+            f"unknown anomaly detector {name!r}; detectors are the "
+            f"closed enum in obs/naming.py: "
+            f"{', '.join(sorted(ANOMALY_DETECTORS))}"
+        )
+    return name
+
+
 # -- frozen fleet series names --------------------------------------------
 #
 # The digest/SLO surface below is the INPUT CONTRACT for the planned
@@ -119,6 +185,14 @@ SERIES_FORECAST_PRESSURE = "frontend.forecast.pressure"
 SERIES_CAPACITY_HEADROOM = "frontend.capacity.headroom"
 #: cost-per-token gauge, replica-ticks spent per emitted token
 SERIES_COST_PER_TOKEN = "obs.capacity.cost_per_token"
+#: latest one-step forecaster residual vs its p90 band, labels: none
+SERIES_ANOMALY_RESIDUAL = "frontend.anomaly.residual"
+#: SLO burn-rate slope across adjacent windows, labels: objective
+SERIES_ANOMALY_BURN_SLOPE = "frontend.anomaly.burn_slope"
+#: per-replica gray-failure score (latency vs peer merge), labels: replica
+SERIES_ANOMALY_GRAY_SCORE = "frontend.anomaly.gray_score"
+#: detector firing counter, labels: detector
+SERIES_ANOMALY_FIRINGS = "frontend.anomaly.firings"
 
 #: every frozen fleet series, name -> instrument kind
 FROZEN_SERIES: dict[str, str] = {
@@ -132,4 +206,8 @@ FROZEN_SERIES: dict[str, str] = {
     SERIES_FORECAST_PRESSURE: "gauge",
     SERIES_CAPACITY_HEADROOM: "gauge",
     SERIES_COST_PER_TOKEN: "gauge",
+    SERIES_ANOMALY_RESIDUAL: "gauge",
+    SERIES_ANOMALY_BURN_SLOPE: "gauge",
+    SERIES_ANOMALY_GRAY_SCORE: "gauge",
+    SERIES_ANOMALY_FIRINGS: "counter",
 }

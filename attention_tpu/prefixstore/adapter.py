@@ -33,6 +33,7 @@ import numpy as np
 
 from attention_tpu.engine.errors import PrefixStoreCorruptError
 from attention_tpu.engine.snapshot import _dtype_name, model_fingerprint
+from attention_tpu.obs import blackbox as _blackbox
 from attention_tpu.ops.paged import OutOfPagesError
 from attention_tpu.prefixstore.records import (
     chain_key,
@@ -147,6 +148,11 @@ def import_chain(engine, tokens, *, now: int) -> int:
             rec = decode_record(blob)
         except PrefixStoreCorruptError:
             store.note_corrupt(key)
+            _blackbox.note(
+                "store_corrupt", tick=now,
+                replica=getattr(engine, "trace_replica", None),
+                incarnation=getattr(engine, "trace_incarnation", 0),
+                step=engine.current_step, key=key[:12])
             break
         if rec.fingerprint != fp or rec.geometry != geo:
             break  # another fleet's pages: a miss, never corruption
@@ -181,4 +187,10 @@ def import_chain(engine, tokens, *, now: int) -> int:
     # computed chain after its request drains
     engine.allocator.free(pages)
     store.note_import(pages=len(recs), tokens=len(recs) * ps)
+    _blackbox.note(
+        "store_import", tick=now,
+        replica=getattr(engine, "trace_replica", None),
+        incarnation=getattr(engine, "trace_incarnation", 0),
+        step=engine.current_step,
+        pages=len(recs), tokens=len(recs) * ps)
     return len(recs) * ps

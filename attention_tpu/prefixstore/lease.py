@@ -44,6 +44,10 @@ class LeaseTable:
             )
         self.lease_ticks = lease_ticks
         self._leases: dict[str, _Lease] = {}
+        #: keys dropped by the most recent :meth:`expire` sweep, in
+        #: sorted order — the frontend's flight recorder reads this to
+        #: note each dead-leader expiry as a typed causal event
+        self.last_expired: list[str] = []
 
     def __len__(self) -> int:
         return len(self._leases)
@@ -54,6 +58,7 @@ class LeaseTable:
                       if l.expires <= now)
         for k in dead:
             del self._leases[k]
+        self.last_expired = dead
         return len(dead)
 
     def holder(self, key: str, *, now: int) -> str | None:

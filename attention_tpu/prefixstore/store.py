@@ -36,6 +36,7 @@ import tempfile
 import zlib
 
 from attention_tpu import obs
+from attention_tpu.obs import blackbox as _blackbox
 from attention_tpu.engine.errors import PrefixStoreCorruptError
 from attention_tpu.engine.snapshot import _fsync_dir, _jbytes
 from attention_tpu.prefixstore.lease import LeaseTable
@@ -136,12 +137,14 @@ class PrefixStore:
         ttl = self.config.ttl_ticks
         return ttl is not None and entry.created + ttl <= now
 
-    def _drop(self, key: str, *, count: bool = True) -> None:
+    def _drop(self, key: str, *, count: bool = True,
+              now: int = -1) -> None:
         entry = self._entries.pop(key)
         self.total_bytes -= entry.nbytes
         if count:
             self.counts["evictions"] += 1
             _EVICTIONS.inc()
+            _blackbox.note("store_evict", tick=now, key=key[:12])
         _BYTES_GAUGE.set(float(self.total_bytes))
 
     def expire(self, *, now: int) -> int:
@@ -149,25 +152,25 @@ class PrefixStore:
         dead = sorted(k for k, e in self._entries.items()
                       if self._expired(e, now))
         for k in dead:
-            self._drop(k)
+            self._drop(k, now=now)
         return len(dead)
 
-    def evict_lru(self) -> str | None:
+    def evict_lru(self, *, now: int = -1) -> str | None:
         """Evict the least-recently-used record (tie-break by key, the
         allocator's ``(last_use, key)`` discipline); None when empty."""
         if not self._entries:
             return None
         victim = min(self._entries.values(),
                      key=lambda e: (e.last_use, e.key))
-        self._drop(victim.key)
+        self._drop(victim.key, now=now)
         return victim.key
 
-    def evict_all(self) -> int:
+    def evict_all(self, *, now: int = -1) -> int:
         """Drop everything (the chaos eviction-storm injector); every
         drop counts as an eviction."""
         n = len(self._entries)
         for key in sorted(self._entries):
-            self._drop(key)
+            self._drop(key, now=now)
         return n
 
     # -- records -----------------------------------------------------------
@@ -187,7 +190,7 @@ class PrefixStore:
         if len(blob) > self.config.max_bytes:
             return False
         while self.total_bytes + len(blob) > self.config.max_bytes:
-            self.evict_lru()
+            self.evict_lru(now=now)
         self._entries[key] = _Entry(
             key=key, blob=blob, nbytes=len(blob),
             created=now, last_use=now, seq=self._seq,
@@ -205,7 +208,7 @@ class PrefixStore:
         if entry is None:
             return None
         if self._expired(entry, now):
-            self._drop(key)
+            self._drop(key, now=now)
             return None
         entry.last_use = now
         return entry.blob

@@ -631,6 +631,95 @@ def _bench_prefix_fleet(model, params, args) -> dict:
     }
 
 
+def _bench_gray_fleet(model, params, args) -> dict:
+    """The ``--gray-failure`` detail block: the RAG-heavy diurnal
+    trace through a 2-replica front end with the anomaly detectors
+    on, twice — a clean arm and a degraded arm where replica-0's
+    decode token budget collapses mid-run.
+
+    The degradation is deliberately *gray*: the throttled replica
+    keeps stepping, its virtual step cost stays at the fleet median,
+    and it raises no typed errors, so every supervisor liveness
+    signal stays green — only its inter-token gaps inflate.  The
+    record reports the injection tick, the gray detector's first
+    firing tick and which replica it named, and the clean arm's
+    firing count (the false-positive check).  Both arms are fully
+    deterministic, so the latency figure is a property of the
+    detector, not of the host."""
+    from attention_tpu.engine import EngineConfig
+    from attention_tpu.engine.sim import diurnal_trace, sampling_of
+    from attention_tpu.frontend import FrontendConfig, ServingFrontend
+    from attention_tpu.obs.anomaly import AnomalyPolicy
+
+    # moderate diurnal load (peak_rate=2.0): heavy enough that the
+    # brownout's victims queue behind each other, light enough that
+    # the healthy arm's contention never crosses the gray bound
+    trace = diurnal_trace(
+        args.engine_requests * 3, vocab=256, seed=11,
+        rag_every=2, rag_prefill_len=256, tenants=2,
+        prompt_len_min=4, prompt_len_max=24, max_tokens=8,
+        peak_rate=2.0,
+    )
+    config = EngineConfig(
+        num_pages=64, page_size=128, max_seq_len=384,
+        max_decode_batch=8, max_prefill_rows=2, prefill_chunk=64,
+        token_budget=192, watermark_pages=1,
+    )
+    inject_tick = 16
+
+    def _run(degrade):
+        fe = ServingFrontend(model, params, config, FrontendConfig(
+            num_replicas=2, seed=0,
+            anomaly=AnomalyPolicy(gray_trail=4),
+        ))
+        for e in trace:
+            fe.submit(e["prompt"], sampling_of(e),
+                      request_id=e.get("id"),
+                      arrival=int(e.get("arrival", 0)),
+                      session=e.get("session"),
+                      priority=int(e.get("priority", 1)))
+        ticks = 0
+        while fe.has_work() and ticks < 600:
+            if degrade and fe.current_tick == inject_tick:
+                # budget throttle ONLY — inflating the virtual step
+                # cost would trip the supervisor and turn this into a
+                # fail-stop kill, which is a different (easier) bench
+                fe.replicas[0].engine.scheduler.token_budget = 1
+            fe.tick()
+            ticks += 1
+        return fe
+
+    clean = _run(False)
+    deg = _run(True)
+    gray = [f for f in deg.anomaly.firings
+            if f["detector"] == "gray_failure"]
+    first = gray[0] if gray else None
+    return {
+        "replicas": 2,
+        "requests": len(trace),
+        "injection_tick": inject_tick,
+        "degradation": "replica-0 token_budget -> 1 (supervisor-"
+        "invisible brownout: steps advance, cost normal, no errors)",
+        "detection_tick": first["tick"] if first else None,
+        "detection_latency_ticks": (
+            first["tick"] - inject_tick if first else None),
+        "detected_replica": first["key"] if first else None,
+        "gray_firings": [
+            {"tick": f["tick"], "key": f["key"], "value": f["value"],
+             "bound": f["bound"]} for f in gray],
+        "clean_false_positives": len(clean.anomaly.firings),
+        # the gray premise, checked right here in the bench: the
+        # liveness supervisor never saw the sick replica
+        "supervisor_blind": (
+            deg.counts["supervisor_dead"] == 0
+            and deg.counts["replica_kills"] == 0),
+        "degraded_finished_tokens": sum(
+            len(fr.tokens) for fr in deg.requests.values()),
+        "clean_finished_tokens": sum(
+            len(fr.tokens) for fr in clean.requests.values()),
+    }
+
+
 def _bench_engine(args) -> dict:
     """The ``--arm engine`` record: continuous-batching throughput of
     `attention_tpu.engine` on a synthetic overlapping-request trace vs
@@ -754,6 +843,10 @@ def _bench_engine(args) -> dict:
     if args.prefix_store:
         fleet_detail = _bench_prefix_fleet(model, params, args)
 
+    gray_detail = None
+    if args.gray_failure:
+        gray_detail = _bench_gray_fleet(model, params, args)
+
     return {
         "metric": "engine continuous-batching decode throughput vs "
         "sequential generate_paged (same model, same requests, CPU/TPU "
@@ -780,6 +873,7 @@ def _bench_engine(args) -> dict:
             "summary": summary,
             "mesh": mesh_detail,
             "prefix_fleet": fleet_detail,
+            "gray_fleet": gray_detail,
             "per_step": [m.to_dict() for m in engine.metrics.steps],
         },
     }
@@ -806,6 +900,14 @@ def main(argv=None) -> int:
         "(attention_tpu.prefixstore) and report the "
         "obs.capacity.cost_per_token delta + store counters "
         "(token streams must match exactly)",
+    )
+    p.add_argument(
+        "--gray-failure", action="store_true",
+        help="engine arm: ALSO run the diurnal trace through a "
+        "2-replica front end with the anomaly detectors on, clean and "
+        "with a mid-run supervisor-invisible brownout of replica-0 "
+        "(attention_tpu.obs.anomaly), and report gray-failure "
+        "detection tick vs injection tick + clean-arm false positives",
     )
     p.add_argument(
         "--mesh-shards", type=int, default=0,

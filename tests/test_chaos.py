@@ -430,6 +430,52 @@ def test_forecast_determinism_invariant_wired():
     assert "Forecast determinism" in (inv.__doc__ or "")
 
 
+# ------------------------------------------ incident invariant (ISSUE 18)
+
+
+def test_incident_completeness_invariant_balances_ledger(tmp_path):
+    """ISSUE 18 invariant 15: every injected fault must leave exactly
+    one incident bundle on disk, every fault bundle must trace back to
+    an injected fault, and detector bundles must each match a recorded
+    anomaly firing — checked positive and seeded-negative."""
+    from attention_tpu.chaos.invariants import (
+        incident_completeness_violations,
+    )
+    from attention_tpu.obs.postmortem import PostmortemWriter
+
+    class _Stub:
+        pass
+
+    # no postmortem writer: the checker is a no-op
+    bare = _Stub()
+    bare.postmortem = None
+    assert incident_completeness_violations(bare, _Stub()) == []
+
+    pm = PostmortemWriter(str(tmp_path / "inc"))
+    pm.maybe_dump(tick=4, cause="fault",
+                  detail={"kind": "replica_kill", "target": "replica-0"})
+    pm.maybe_dump(tick=9, cause="detector",
+                  detail={"detector": "gray_failure", "key": "replica-0"})
+
+    fe = _Stub()
+    fe.postmortem = pm
+    fe.anomaly = _Stub()
+    fe.anomaly.firings = [{"detector": "gray_failure", "tick": 9,
+                           "key": "replica-0", "value": 3.0,
+                           "bound": 2.0}]
+    injector = _Stub()
+    injector.fired = [("replica_kill", 4)]
+    assert incident_completeness_violations(fe, injector) == []
+
+    # seeded violations: a fault that left no bundle, and a detector
+    # bundle with no recorded firing
+    injector.fired = [("replica_kill", 4), ("replica_restart", 7)]
+    fe.anomaly.firings = []
+    problems = incident_completeness_violations(fe, injector)
+    assert any("left no incident bundle" in p for p in problems)
+    assert any("no recorded firing" in p for p in problems)
+
+
 # ----------------------------------------------------- long campaigns
 
 

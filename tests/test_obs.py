@@ -495,20 +495,26 @@ def test_disabled_overhead_under_5_percent():
             a @ b
         return time.perf_counter() - t0
 
-    def instrumented():
+    def instruments():
+        # exactly the calls the instrumented loop would add: n no-op
+        # span enters/exits + n disabled counter incs
         t0 = time.perf_counter()
         for _ in range(n):
             with obs.span("obs.test.hotloop"):
-                a @ b
+                pass
             c.inc()
         return time.perf_counter() - t0
 
     plain()  # warm the BLAS path
+    instruments()
     base = min(plain() for _ in range(5))
-    inst = min(instrumented() for _ in range(5))
-    assert inst <= base * 1.05, (
-        f"disabled telemetry overhead {inst / base - 1:.1%} "
-        f"(base {base * 1e3:.2f} ms, instrumented {inst * 1e3:.2f} ms)"
+    added = min(instruments() for _ in range(5))
+    # additive cost measured separately: subtracting two noisy loop
+    # timings drowns the signal on a contended 1-core CI box, the
+    # disabled instrument path itself does not
+    assert added <= base * 0.05, (
+        f"disabled telemetry overhead {added / base:.1%} "
+        f"(base {base * 1e3:.2f} ms, instruments {added * 1e3:.2f} ms)"
     )
     assert c.value() == 0
     assert obs.events() == []
@@ -783,18 +789,21 @@ def test_obs_name_lint_tree_is_clean_and_catches_violations(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(
         "from attention_tpu import obs\n"
-        "from attention_tpu.obs import trace\n"
+        "from attention_tpu.obs import blackbox, trace\n"
         'obs.counter("EngineSteps")\n'
         'obs.span("just_one_segment")\n'
         'obs.gauge(dynamic_name)\n'  # non-literal: runtime-checked
         'obs.digest("AlsoBadDigest")\n'
         'trace.record("req", "vanished", tick=0)\n'  # not in the enum
         'trace.record("req", "finished", tick=1)\n'  # legal event
+        'blackbox.note("made_up_kind", tick=0)\n'  # ATP507
+        'blackbox.note("replica_kill", tick=0)\n'  # legal kind
     )
     errors = lint.check_file(str(bad))
-    assert len(errors) == 4
+    assert len(errors) == 5
     assert sum("violates" in e for e in errors) == 3
-    assert sum("closed enum" in e for e in errors) == 1
+    assert sum("closed enum" in e for e in errors) == 2
+    assert sum("BLACKBOX_EVENTS" in e for e in errors) == 1
 
 
 # ------------------------------------------- forecast + capacity (ISSUE 14)
@@ -1033,6 +1042,437 @@ def test_cli_obs_forecast_from_dump_alone(tmp_path, capsys):
 
         # a dump without forecast.json degrades cleanly
         assert main(["obs", "forecast", "--run", str(tmp_path)]) == 1
+        capsys.readouterr()
+    finally:
+        obs.reset()
+        (obs.enable if was else obs.disable)()
+
+
+# ---------------------------------------------- incident layer (ISSUE 18)
+
+
+def test_blackbox_ring_capture_and_closed_enum():
+    """The flight recorder: disabled notes vanish, capture() records
+    with the four deterministic coordinates, event kinds are the
+    closed BLACKBOX_EVENTS enum, extras must be plain scalars."""
+    from attention_tpu.obs import blackbox
+
+    assert not obs.is_enabled()
+    blackbox.clear()
+    blackbox.note("route_decision", tick=0)  # disabled: dropped
+    assert blackbox.depth() == 0 and not blackbox.active()
+    with blackbox.capture():
+        assert blackbox.active()
+        blackbox.note("route_decision", tick=1, replica="replica-0",
+                      incarnation=0, step=4, reason="least_loaded")
+        blackbox.note("shed", tick=2, request="req-1")
+        unknown_kind = "not_an_event"  # non-literal arg: ATP507 leaves
+        with pytest.raises(ValueError,  # the runtime check to fire
+                           match="unknown blackbox event"):
+            blackbox.note(unknown_kind, tick=3)
+        with pytest.raises(TypeError, match="plain scalar"):
+            blackbox.note("shed", tick=3, victims=[1, 2])
+        evs = blackbox.events()
+        assert [e["kind"] for e in evs] == ["route_decision", "shed"]
+        assert [e["seq"] for e in evs] == [0, 1]
+        assert evs[0]["replica"] == "replica-0" and evs[0]["step"] == 4
+        assert blackbox.events(kind="shed")[0]["tick"] == 2
+        assert blackbox.events(since_tick=2) == [evs[1]]
+        assert blackbox.events(until_tick=1) == [evs[0]]
+    assert not blackbox.active()
+    blackbox.clear()
+
+
+def test_blackbox_ring_is_bounded_and_seq_monotone():
+    from attention_tpu.obs import blackbox
+
+    with blackbox.capture():
+        n = blackbox.BLACKBOX_CAPACITY + 10
+        for i in range(n):
+            blackbox.note("route_decision", tick=i)
+        assert blackbox.depth() == blackbox.BLACKBOX_CAPACITY
+        assert blackbox.total() == n
+        evs = blackbox.events()
+        assert evs[0]["seq"] == 10  # oldest evicted first
+        assert evs[-1]["seq"] == n - 1
+    blackbox.clear()
+
+
+def test_blackbox_disabled_overhead_under_5_percent():
+    """The PR 12 zero-overhead contract extended over note(): the
+    disabled path is one global read and a return."""
+    from attention_tpu.obs import blackbox
+
+    assert not obs.is_enabled()
+    blackbox.clear()
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((128, 128))
+    b = rng.standard_normal((128, 128))
+    n = 200
+
+    def plain():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            a @ b
+        return time.perf_counter() - t0
+
+    def notes():
+        # exactly the calls an instrumented loop would add: n
+        # disabled note()s — each must be one predicate test + return
+        t0 = time.perf_counter()
+        for i in range(n):
+            blackbox.note("route_decision", tick=i,
+                          replica="replica-0", reason="least_loaded")
+        return time.perf_counter() - t0
+
+    plain()  # warm the BLAS path
+    notes()
+    base = min(plain() for _ in range(5))
+    added = min(notes() for _ in range(5))
+    # the additive cost of n disabled note()s must stay under 5% of
+    # the n-matmul workload (measured separately: on a contended
+    # 1-core CI box the subtraction of two noisy loop timings would
+    # drown the signal, the added path itself does not)
+    assert added <= base * 0.05, (
+        f"disabled flight-recorder overhead {added / base:.1%} "
+        f"(base {base * 1e3:.2f} ms, notes {added * 1e3:.2f} ms)"
+    )
+    assert blackbox.depth() == 0 and blackbox.total() == 0
+
+
+def test_anomaly_policy_validation_and_roundtrip():
+    from attention_tpu.obs.anomaly import AnomalyPolicy
+
+    AnomalyPolicy().validate()
+    for bad in (dict(residual_scale=0.0), dict(residual_min_band=-1.0),
+                dict(residual_warmup=0), dict(burn_window=1),
+                dict(burn_slope_bound=0.0), dict(burn_min_requests=0),
+                dict(gray_window=0), dict(gray_min_samples=0),
+                dict(gray_ratio=1.0), dict(gray_trail=0)):
+        with pytest.raises(ValueError):
+            AnomalyPolicy(**bad).validate()
+    rt = AnomalyPolicy.from_dict(AnomalyPolicy(gray_trail=4).to_dict())
+    assert rt.gray_trail == 4
+
+
+def test_anomaly_residual_band_rising_edge():
+    """A pressure step far outside the backtested band fires
+    residual_band once; while the condition holds no second firing
+    lands (rising edge keeps incident bundles bounded)."""
+    from attention_tpu.obs.anomaly import AnomalyPolicy, AnomalyTracker
+
+    tr = AnomalyTracker(AnomalyPolicy(residual_warmup=6))
+    t = 0
+    for _ in range(12):
+        tr.observe_pressure(t, 0.3)
+        assert tr.step(t) == []
+        t += 1
+    tr.observe_pressure(t, 8.0)
+    new = tr.step(t)
+    assert [f["detector"] for f in new] == ["residual_band"]
+    assert new[0]["key"] == "fleet" and new[0]["tick"] == t
+    assert ("residual_band", "fleet") in tr.active
+    t += 1
+    tr.observe_pressure(t, 16.0)  # still way off: condition holds
+    assert tr.step(t) == []       # ... but no re-firing
+    assert len(tr.firings) == 1
+
+
+def test_anomaly_gray_failure_unit_detection_latency():
+    """Tracker-level pin of the acceptance bound: a replica whose
+    inter-token gaps inflate 4x is flagged within 8 ticks, and the
+    healthy peer never is."""
+    from attention_tpu.obs.anomaly import AnomalyPolicy, AnomalyTracker
+
+    tr = AnomalyTracker(AnomalyPolicy(gray_trail=4))
+    for t in range(10):
+        tr.observe_tokens(t, "replica-0", "a", 1)
+        tr.observe_tokens(t, "replica-1", "b", 1)
+        assert tr.step(t) == []
+    inject = 10
+    fired = []
+    for t in range(inject, inject + 30):
+        if (t - inject) % 4 == 0:
+            tr.observe_tokens(t, "replica-0", "a", 1)  # 4x slower now
+        tr.observe_tokens(t, "replica-1", "b", 1)
+        fired += tr.step(t)
+        if fired:
+            break
+    assert fired, "gray detector never fired"
+    assert fired[0]["detector"] == "gray_failure"
+    assert fired[0]["key"] == "replica-0"
+    assert fired[0]["tick"] - inject <= 8
+    assert all(f["key"] != "replica-1" for f in tr.firings)
+
+
+def _run_frontend_incident(tiny_model, *, anomaly=None,
+                           incident_dir=None):
+    """The bursty 2-replica run with the incident layer attached."""
+    from attention_tpu.engine import bursty_trace
+    from attention_tpu.frontend import (
+        FrontendConfig,
+        ServingFrontend,
+        replay_frontend,
+    )
+
+    model, params = tiny_model
+    trace = bursty_trace(5, vocab=43, seed=7, shared_prefix_len=129,
+                         tenants=2, burst_every=3, burst_size=2,
+                         prompt_len_min=4, prompt_len_max=10,
+                         max_tokens=3)
+    frontend = ServingFrontend(
+        model, params, _engine_config(),
+        FrontendConfig(num_replicas=2, seed=0, anomaly=anomaly,
+                       incident_dir=incident_dir),
+    )
+    summary, outputs = replay_frontend(frontend, trace)
+    return frontend, summary, outputs
+
+
+def test_frontend_byte_identical_with_incident_layer_on(
+        tiny_model, tmp_path):
+    """ISSUE 18 zero-overhead pin: recorder + detectors + postmortem
+    writer off vs on produce token-byte-identical streams and
+    identical summaries; with telemetry on the ring actually fills."""
+    import jax
+
+    from attention_tpu.obs import blackbox
+    from attention_tpu.obs.anomaly import AnomalyPolicy
+
+    assert not obs.is_enabled()
+    _fe, s_off, o_off = _run_frontend_incident(tiny_model)
+    assert "anomaly_firings" in s_off and "incidents" in s_off
+    fe_on, s_on, o_on = _run_frontend_incident(
+        tiny_model, anomaly=AnomalyPolicy(),
+        incident_dir=str(tmp_path / "inc"))
+    assert o_on == o_off and s_on == s_off
+    assert fe_on.anomaly is not None and fe_on.postmortem is not None
+    assert blackbox.depth() == 0  # telemetry off: ring stayed empty
+
+    obs.enable()
+    obs.reset()
+    try:
+        jax.clear_caches()
+        _fe2, s2, o2 = _run_frontend_incident(
+            tiny_model, anomaly=AnomalyPolicy(),
+            incident_dir=str(tmp_path / "inc2"))
+        assert o2 == o_off and s2 == s_off
+        assert blackbox.depth() > 0
+        assert blackbox.events(kind="route_decision")
+        snap = obs.REGISTRY.snapshot()
+        gauges = {s["name"] for s in snap["gauges"]}
+        assert "frontend.anomaly.residual" in gauges
+    finally:
+        obs.reset()
+        obs.disable()
+
+
+def _run_gray_fleet(tiny_model, *, degrade, inject_tick=8,
+                    max_ticks=400):
+    """A 2-replica fleet under sustained concurrent decode; with
+    ``degrade`` replica-0's token budget collapses mid-run, so its
+    inter-token gaps inflate while every supervisor-visible signal
+    (virtual step cost, step counter, error streak) stays clean — the
+    replica is sick but NOT dead, exactly the gray failure the
+    liveness supervisor cannot see."""
+    from attention_tpu.engine import synthetic_trace
+    from attention_tpu.engine.sim import sampling_of
+    from attention_tpu.frontend import FrontendConfig, ServingFrontend
+    from attention_tpu.obs.anomaly import AnomalyPolicy
+
+    model, params = tiny_model
+    trace = synthetic_trace(8, vocab=43, seed=5, prompt_len_min=4,
+                            prompt_len_max=8, max_tokens=16,
+                            arrival_every=2)
+    fe = ServingFrontend(
+        model, params, _engine_config(),
+        FrontendConfig(num_replicas=2, seed=0,
+                       anomaly=AnomalyPolicy(gray_trail=4)),
+    )
+    for entry in trace:
+        fe.submit(entry["prompt"], sampling_of(entry),
+                  request_id=entry.get("id"),
+                  arrival=int(entry.get("arrival", 0)))
+    orig_tick = fe.tick
+    armed = {"done": False}
+
+    def tick():
+        if degrade and not armed["done"] \
+                and fe.current_tick == inject_tick:
+            armed["done"] = True
+            # budget throttle ONLY: inflating the virtual step cost
+            # would trip the supervisor's slow-step signal and turn
+            # this into a fail-stop kill, not a gray failure
+            fe.replicas[0].engine.scheduler.token_budget = 1
+        return orig_tick()
+
+    fe.tick = tick
+    fe.run(max_ticks=max_ticks)
+    return fe
+
+
+def test_gray_failure_detected_within_8_ticks_no_false_positives(
+        tiny_model):
+    """ISSUE 18 acceptance: on the simulated CPU fleet the gray
+    detector flags the degraded replica within <= 8 ticks of
+    injection, never a healthy peer, and the clean arm fires nothing
+    at all."""
+    assert not obs.is_enabled()
+    clean = _run_gray_fleet(tiny_model, degrade=False)
+    assert clean.anomaly.firings == []  # zero false positives
+
+    inject = 8
+    fe = _run_gray_fleet(tiny_model, degrade=True, inject_tick=inject)
+    gray = [f for f in fe.anomaly.firings
+            if f["detector"] == "gray_failure"]
+    assert gray, (
+        f"gray detector never fired; all firings {fe.anomaly.firings}")
+    assert gray[0]["key"] == "replica-0"
+    assert gray[0]["tick"] - inject <= 8, gray[0]
+    assert {f["key"] for f in gray} == {"replica-0"}
+    # the liveness supervisor never saw it: that is what makes the
+    # failure gray rather than fail-stop
+    assert fe.counts["supervisor_dead"] == 0
+    assert fe.counts["replica_kills"] == 0
+    assert fe.counts["anomaly_firings"] == len(fe.anomaly.firings)
+    # the firing rode into the event log (advisory channel)
+    assert any(e[0] == "anomaly" and e[2] == "gray_failure"
+               for e in fe.events_log)
+
+
+def _bundle_bytes(root):
+    """{relative path: bytes} for every file under an incident dir."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            p = os.path.join(dirpath, name)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, root)] = f.read()
+    return out
+
+
+def test_incident_bundles_byte_identical_same_seed(tiny_model, tmp_path):
+    """ISSUE 18 acceptance: the same seeded chaos plan dumps
+    byte-identical incident bundles twice over, and the postmortem
+    report reconstructed from the bundles alone matches too."""
+    from attention_tpu.chaos.faults import (
+        FaultEvent,
+        FaultPlan,
+        default_frontend_config,
+        run_frontend_plan,
+    )
+    from attention_tpu.engine import synthetic_trace
+    from attention_tpu.obs import postmortem as pm
+
+    model, params = tiny_model
+    trace = synthetic_trace(6, vocab=43, seed=31, max_tokens=6)
+    plan = FaultPlan(seed=0, events=(
+        FaultEvent(step=5, kind="replica_kill", target="replica-0"),
+        FaultEvent(step=8, kind="replica_restart", target="replica-0"),
+    ))
+    roots = []
+    for d in ("a", "b"):
+        root = str(tmp_path / d)
+        r = run_frontend_plan(model, params, _engine_config(),
+                              default_frontend_config(2), trace, plan,
+                              incident_root=root)
+        assert r.violations == [], r.violations
+        roots.append(root)
+    bundles = pm.list_incidents(roots[0])
+    assert bundles  # the kill filed its incidents
+    causes = {pm.load_incident(b)["meta"]["cause"] for b in bundles}
+    assert "fault" in causes
+    assert _bundle_bytes(roots[0]) == _bundle_bytes(roots[1])
+    assert pm.report_lines(roots[0]) == pm.report_lines(roots[1])
+    # the fault bundle correlates back to its fault_injected trigger
+    fault_bundle = next(b for b in bundles
+                        if pm.load_incident(b)["meta"]["cause"] == "fault")
+    loaded = pm.load_incident(fault_bundle)
+    triggers = pm.correlate(loaded)
+    assert any("fault_injected" in line for line in triggers)
+
+
+def test_postmortem_writer_dedup_and_chrome_lane(tmp_path):
+    """PostmortemWriter dedups (cause, tick, detail); the chrome
+    export grows the incident lane (pid 4) from loaded bundles."""
+    from attention_tpu.obs import blackbox
+    from attention_tpu.obs import postmortem as pm
+
+    w = pm.PostmortemWriter(str(tmp_path))
+    with blackbox.capture():
+        blackbox.note("replica_kill", tick=7, replica="replica-0")
+        assert w.maybe_dump(tick=7, cause="typed_error",
+                            detail={"error": "ReplicaDeadError"})
+        # exact duplicate: no second bundle
+        assert w.maybe_dump(tick=7, cause="typed_error",
+                            detail={"error": "ReplicaDeadError"}) is None
+        # different detail at the same tick: a second bundle
+        assert w.maybe_dump(tick=7, cause="fault",
+                            detail={"kind": "oom"})
+    assert len(pm.list_incidents(str(tmp_path))) == 2
+    loaded = [pm.load_incident(b)
+              for b in pm.list_incidents(str(tmp_path))]
+    trace_doc = obs.chrome_trace([], incidents=loaded)
+    lane = [e for e in trace_doc["traceEvents"] if e.get("pid") == 4]
+    assert any(e.get("ph") == "X" for e in lane)  # bundle spans
+    blackbox.clear()
+
+
+def test_cli_serve_sim_incident_layer_and_postmortem(tmp_path, capsys):
+    """End to end through the CLI: serve-sim with the incident layer
+    on dumps anomaly.json + blackbox.jsonl + incident bundles; `obs
+    postmortem` reconstructs the timeline byte-identically across
+    same-seed runs; `obs report` grows the anomalies section."""
+    from attention_tpu.cli import main
+
+    was = obs.is_enabled()
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json.dumps({
+        "seed": 0,
+        "events": [{"step": 6, "kind": "replica_kill", "arg": 1,
+                    "target": "replica-0"}],
+    }))
+    args = ["serve-sim", "--replicas", "2", "--num-requests", "8",
+            "--max-tokens", "3", "--prompt-len-max", "8",
+            "--anomaly", "--chaos-plan", str(plan_path)]
+    try:
+        reports = []
+        for d in ("run1", "run2"):
+            inc = tmp_path / d / "inc"
+            run = tmp_path / d / "obs"
+            assert main([*args, "--incident-dir", str(inc),
+                         "--obs-out", str(run)]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["blackbox"]["ring_depth"] > 0
+            assert out["blackbox"]["incidents"] >= 1
+            assert "anomaly" in out
+            assert main(["obs", "postmortem", "--run", str(inc)]) == 0
+            reports.append(capsys.readouterr().out)
+            assert "cause: fault [kind=replica_kill" in reports[-1]
+            assert "fault_injected" in reports[-1]
+        assert reports[0] == reports[1]  # byte-identical postmortems
+
+        run1 = tmp_path / "run1" / "obs"
+        assert (run1 / "anomaly.json").exists()
+        assert (run1 / "blackbox.jsonl").exists()
+        assert main(["obs", "report", "--run", str(run1)]) == 0
+        text = capsys.readouterr().out
+        assert "== anomalies ==" in text
+        assert "residual_band:" in text
+        assert "gray_failure[replica-0]" in text
+
+        # chrome export with the incident lane
+        chrome = tmp_path / "incidents.json"
+        assert main(["obs", "postmortem", "--run",
+                     str(tmp_path / "run1" / "inc"),
+                     "--chrome", str(chrome)]) == 0
+        capsys.readouterr()
+        lane = [e for e in json.loads(chrome.read_text())["traceEvents"]
+                if e.get("pid") == 4]
+        assert lane
+
+        # a directory without bundles degrades cleanly
+        assert main(["obs", "postmortem", "--run", str(tmp_path)]) == 1
         capsys.readouterr()
     finally:
         obs.reset()
