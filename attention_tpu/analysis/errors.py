@@ -44,18 +44,18 @@ from attention_tpu.analysis.core import (
 ATP401 = register_code(
     "ATP401", "generic-runtime-raise-in-typed-path", Severity.ERROR,
     "raise RuntimeError/Exception/AssertionError under engine/, "
-    "chaos/, frontend/, obs/, or prefixstore/ — use a typed error "
-    "(OutOfPagesError lineage)")
+    "chaos/, fleet/, frontend/, obs/, or prefixstore/ — use a typed "
+    "error (OutOfPagesError lineage)")
 ATP402 = register_code(
     "ATP402", "generic-value-raise-in-typed-path", Severity.WARNING,
-    "raise ValueError under engine/, chaos/, frontend/, obs/, or "
-    "prefixstore/ — argument validation is baselined per file; new "
+    "raise ValueError under engine/, chaos/, fleet/, frontend/, obs/, "
+    "or prefixstore/ — argument validation is baselined per file; new "
     "ones need a typed error or a justified baseline entry")
 
 #: trees where the typed taxonomy is the contract
 _TYPED_PATHS = ("attention_tpu/engine/", "attention_tpu/chaos/",
-                "attention_tpu/frontend/", "attention_tpu/obs/",
-                "attention_tpu/prefixstore/")
+                "attention_tpu/fleet/", "attention_tpu/frontend/",
+                "attention_tpu/obs/", "attention_tpu/prefixstore/")
 _GENERIC = {"RuntimeError", "Exception", "AssertionError"}
 
 

@@ -401,6 +401,15 @@ STORE_FAULT_KINDS = FRONTEND_FAULT_KINDS + (
     "store_evict",    # eviction storm: drop every entry at once
 )
 
+#: the disaggregation faults (ISSUE 19) — only meaningful against a
+#: front end with ``FrontendConfig.fleet`` set; each attacks a leg of
+#: the prefill/decode contract (handoff payload integrity, autoscaler
+#: hysteresis)
+DISAGG_FAULT_KINDS = FRONTEND_FAULT_KINDS + (
+    "handoff_poison",  # corrupt the next N prefill->decode payloads
+    "demote_storm",    # force N hysteresis-bypassing scale-downs
+)
+
 
 def random_frontend_plan(seed: int, request_ids: Sequence[str],
                          num_replicas: int, *, num_events: int = 5,
@@ -561,6 +570,47 @@ def random_store_plan(seed: int, request_ids: Sequence[str],
     return FaultPlan(seed=seed, events=tuple(events))
 
 
+def random_disagg_plan(seed: int, request_ids: Sequence[str],
+                       num_replicas: int, *, num_events: int = 6,
+                       max_tick: int = 40) -> FaultPlan:
+    """Sample one seeded disaggregation storm: the ISSUE 6 kinds plus
+    the two fleet attacks, with at least one of each fleet attack
+    guaranteed per plan (a disagg storm that never poisons a handoff
+    or forces a demotion proves nothing).  ``arg`` is the window size
+    — payloads to corrupt, demotions to force."""
+    rng = np.random.default_rng(seed)
+    specialty = ("handoff_poison", "demote_storm")
+    events = []
+    for _ in range(num_events):
+        kind = DISAGG_FAULT_KINDS[
+            int(rng.integers(len(DISAGG_FAULT_KINDS)))]
+        step = int(rng.integers(1, max_tick))
+        arg, target = 1, None
+        if kind == "replica_kill":
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if rng.random() < 0.9:
+                events.append(FaultEvent(
+                    step=step + int(rng.integers(2, 7)),
+                    kind="replica_restart", target=target))
+        elif kind in ("replica_restart", "oom", "preempt"):
+            target = f"replica-{int(rng.integers(num_replicas))}"
+            if kind in ("oom", "preempt"):
+                arg = int(rng.integers(1, 3))
+        elif kind == "cancel":
+            target = request_ids[int(rng.integers(len(request_ids)))]
+        elif kind in specialty:
+            arg = int(rng.integers(1, 4))
+        events.append(FaultEvent(step=step, kind=kind, arg=arg,
+                                 target=target))
+    for kind in specialty:
+        if not any(e.kind == kind for e in events):
+            events.append(FaultEvent(
+                step=int(rng.integers(2, max_tick)), kind=kind,
+                arg=int(rng.integers(1, 4))))
+    events.sort(key=lambda e: (e.step, e.kind, e.target or ""))
+    return FaultPlan(seed=seed, events=tuple(events))
+
+
 def _flip_byte(path: str) -> None:
     """Bit-flip the middle byte of a file in place — lands inside the
     (dominant) pools section of a snapshot, so restore must fail its
@@ -689,6 +739,18 @@ class FrontendFaultInjector:
                 return
             store.evict_all(now=self.frontend.current_tick)
             self._mark("store_evict")
+        elif ev.kind == "handoff_poison":
+            if not getattr(self.frontend, "pool_of", None):
+                self.skipped.append("handoff_poison:no-fleet")
+                return
+            self.frontend._poison_handoffs += max(1, ev.arg)
+            self._mark("handoff_poison")
+        elif ev.kind == "demote_storm":
+            if getattr(self.frontend, "autoscaler", None) is None:
+                self.skipped.append("demote_storm:no-autoscaler")
+                return
+            self.frontend._force_demotions += max(1, ev.arg)
+            self._mark("demote_storm")
         elif ev.kind in GRAY_FAULT_KINDS:
             handle = self._handle(ev.target)
             if handle is None or not handle.alive:
@@ -986,6 +1048,10 @@ def _run_frontend_plan_inner(model, params, config, frontend_config,
     # fault/detector bundle traces back to a real cause
     violations += inv.incident_completeness_violations(frontend,
                                                        injector)
+    # invariant 16: a no-op on monolithic front ends; with a fleet
+    # attached, every pool resize balances against the blackbox ring
+    # and no pool flaps inside the cooldown window
+    violations += inv.actuation_ledger_violations(frontend)
     # invariant 13: campaigns enable forecasting (see
     # default_frontend_config) — the observatory report must be a
     # pure function of the recorded samples, storm or no storm
@@ -1270,6 +1336,78 @@ def run_store_campaign(seed: int, *, num_plans: int = 4,
         )
         if log is not None:
             log(f"store storm {i} (seed {plan.seed}): "
+                f"injected={r.injected} "
+                f"violations={len(r.violations)} "
+                f"states={sorted(set(r.states.values()))} "
+                f"error={r.surfaced_error or 'none'}")
+        reports.append(r)
+    return FrontendCampaignReport(seed=seed, num_replicas=num_replicas,
+                                  baseline_outputs=baseline,
+                                  reports=reports)
+
+
+def default_fleet_config(num_replicas: int = 3, *,
+                         standbys: int = 2, **overrides):
+    """Disagg-campaign front-end geometry: `default_frontend_config`
+    plus a 1:N-1 prefill:decode split, a standby bench for the
+    autoscaler to work with, and a short-hysteresis policy so storms
+    actually actuate inside campaign-length runs."""
+    from attention_tpu.fleet import AutoscalerPolicy, FleetTopology
+
+    kw: dict[str, Any] = dict(
+        standbys=standbys,
+        fleet=FleetTopology(prefill_replicas=1,
+                            decode_replicas=num_replicas - 1),
+        autoscaler=AutoscalerPolicy(
+            scale_up_after=2, scale_down_after=4, cooldown_ticks=8,
+            guard_window=6),
+    )
+    kw.update(overrides)
+    return default_frontend_config(num_replicas, **kw)
+
+
+def run_disagg_campaign(seed: int, *, num_plans: int = 4,
+                        num_requests: int = 10, num_replicas: int = 3,
+                        events_per_plan: int = 6,
+                        temperature: float = 0.0,
+                        config: EngineConfig | None = None,
+                        model=None, params=None,
+                        log: Callable[[str], None] | None = None,
+                        ) -> FrontendCampaignReport:
+    """The ISSUE 19 disagg storm: a mixed prefill/decode trace
+    (`engine.sim.disagg_trace`) through a fleet front end (prefill +
+    decode pools, standbys, autoscaler armed) under
+    `random_disagg_plan` faults — poisoned handoff payloads, forced
+    demotion storms, plus the ISSUE 6 kinds.  The fault-free baseline
+    is a SINGLE monolithic engine run, so token parity judges every
+    finished stream against tokens no handoff, resize, or fallback
+    could have touched; invariant 16 balances the actuation ledger
+    per plan."""
+    from attention_tpu.engine.sim import disagg_trace
+
+    if model is None or params is None:
+        model, params = build_sim_model()
+    # RAG headers longer than one 128-token page so handoffs actually
+    # ship KV (a payload-less handoff can't exercise the
+    # poison/fallback arc)
+    config = config or default_engine_config(max_seq_len=384,
+                                             num_pages=24)
+    trace = disagg_trace(num_requests, vocab=model.vocab, seed=seed,
+                         max_tokens=6, rag_prefill_len=160,
+                         burst_every=4, burst_size=2)
+    engine = ServingEngine(model, params, config)
+    _, baseline = replay(engine, trace)
+    ids = [t["id"] for t in trace]
+    reports = []
+    for i in range(num_plans):
+        plan = random_disagg_plan(seed * 11003 + i, ids, num_replicas,
+                                  num_events=events_per_plan)
+        r = run_frontend_plan(
+            model, params, config, default_fleet_config(num_replicas),
+            trace, plan, baseline=baseline,
+        )
+        if log is not None:
+            log(f"disagg storm {i} (seed {plan.seed}): "
                 f"injected={r.injected} "
                 f"violations={len(r.violations)} "
                 f"states={sorted(set(r.states.values()))} "

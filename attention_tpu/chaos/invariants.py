@@ -102,6 +102,18 @@ The incident layer (ISSUE 18) adds one more:
     recorded anomaly firing, and no bundle carries an unknown cause.
     The campaign runners attach a throwaway ``incident_dir`` to every
     plan, so the audit runs storm after storm with telemetry off.
+
+The disaggregation layer (ISSUE 19) adds one more:
+
+16. **Actuation ledger** — every fleet pool-size change balances
+    against the flight recorder: each `fleet.ledger.ActuationRecord`
+    the front end executed maps to exactly one ``scale_up`` /
+    ``scale_down`` ring event with the same tick, pool, replica, and
+    recorded cause (a closed alphabet), and no pool flaps — opposite
+    actuations on one pool are separated by at least the policy's
+    cooldown window (chaos ``demote_storm`` forced demotions are
+    exempt: the storm IS the flap).  A no-op on a front end that
+    never actuated.
 """
 
 from __future__ import annotations
@@ -723,3 +735,62 @@ def warm_recovery_parity_violations(
                 f"{list(baseline.get(rid, []))}"
             )
     return _report("warm_recovery_parity", problems)
+
+
+def actuation_ledger_violations(frontend) -> list[str]:
+    """Invariant 16: the actuation ledger balances.
+
+    Matches the front end's executed-resize ledger
+    (`ServingFrontend.actuations`) one-to-one, in order, against the
+    ``scale_up``/``scale_down`` records in the flight-recorder ring
+    (same tick, pool, replica, cause), requires every cause to come
+    from the closed `fleet.ledger.ACTUATION_CAUSES` alphabet, and
+    checks the anti-flap guarantee: opposite actuations on one pool
+    at least ``cooldown_ticks`` apart, chaos ``forced`` demotions
+    exempt.  A no-op on a front end that never actuated (and on runs
+    where the ring was not captured)."""
+    from attention_tpu.obs import blackbox as _blackbox
+    from attention_tpu.fleet.ledger import ACTUATION_CAUSES
+
+    ledger = list(getattr(frontend, "actuations", None) or [])
+    ring = [ev for ev in _blackbox.events()
+            if ev["kind"] in ("scale_up", "scale_down")]
+    if not ledger and not ring:
+        return []
+    problems: list[str] = []
+    if len(ledger) != len(ring):
+        problems.append(
+            f"{len(ledger)} ledger actuation(s) vs {len(ring)} ring "
+            f"scale event(s)")
+    for rec, ev in zip(ledger, ring):
+        got = (ev["kind"], ev["tick"], ev.get("pool"),
+               ev.get("replica"), ev.get("cause"))
+        want = (rec.kind, rec.tick, rec.pool, rec.replica_id,
+                rec.cause)
+        if got != want:
+            problems.append(
+                f"ledger {want} != ring {got}")
+    for rec in ledger:
+        if rec.cause not in ACTUATION_CAUSES:
+            problems.append(
+                f"actuation at tick {rec.tick} carries unknown cause "
+                f"{rec.cause!r}")
+        if rec.kind not in ("scale_up", "scale_down"):
+            problems.append(
+                f"actuation at tick {rec.tick} carries unknown kind "
+                f"{rec.kind!r}")
+    policy = getattr(frontend.config, "autoscaler", None)
+    cooldown = policy.cooldown_ticks if policy is not None else 0
+    last: dict[str, tuple[int, str]] = {}
+    for rec in ledger:
+        if rec.cause == "forced":
+            continue
+        prev = last.get(rec.pool)
+        if (prev is not None and prev[1] != rec.kind
+                and rec.tick - prev[0] < cooldown):
+            problems.append(
+                f"pool {rec.pool!r} flapped: {prev[1]} at tick "
+                f"{prev[0]} then {rec.kind} at tick {rec.tick} "
+                f"inside the {cooldown}-tick cooldown")
+        last[rec.pool] = (rec.tick, rec.kind)
+    return _report("actuation_ledger", problems)

@@ -149,3 +149,19 @@ class PrefixLeaseError(RuntimeError):
     is deliberately not an error: waiters observe the expired lease,
     the next one in deterministic arrival order takes over, and the
     storm still prefills at most once per lease generation."""
+
+
+class HandoffCorruptError(PrefixStoreCorruptError):
+    """A prefill→decode KV-handoff payload failed validation.
+
+    The disaggregated fleet ships a request's committed prefix pages
+    from the prefill pool to its decode destination in the prefix-
+    record section format (`attention_tpu.fleet.handoff`); bad magic,
+    a truncated or CRC-mismatched ``pools.<s>`` section, or metadata
+    that does not describe its payload raises this.  Subclasses
+    :class:`PrefixStoreCorruptError` so every existing typed-error
+    gate (chaos ``TYPED_ERRORS``, the import-path catch discipline)
+    covers it unchanged.  The handoff path catches it, counts a
+    ``handoff_fallback``, and re-admits the request WITHOUT the pages
+    — the destination re-prefills, token parity holds, and the
+    corruption costs compute, never a wrong token."""

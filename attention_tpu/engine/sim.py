@@ -232,6 +232,103 @@ def diurnal_trace(
     return trace
 
 
+def disagg_trace(
+    num_requests: int,
+    *,
+    vocab: int,
+    seed: int = 0,
+    rate: float = 1.5,
+    burst_every: int = 16,
+    burst_size: int = 3,
+    tenants: int = 3,
+    rag_prefill_len: int = 96,
+    prompt_len_min: int = 4,
+    prompt_len_max: int = 12,
+    max_tokens: int = 12,
+    temperature: float = 0.0,
+    deadline_ticks: int | None = None,
+) -> list[dict[str, Any]]:
+    """A seeded mixed prefill/decode workload — the disaggregated
+    fleet's trace (`attention_tpu.fleet`).
+
+    Two populations with opposite resource appetites: a steady stream
+    of decode-heavy chat sessions (short prompts, ``max_tokens``-long
+    generations — the decode pool's diet), interrupted every
+    ``burst_every`` requests by a burst of ``burst_size`` long-prefill
+    RAG requests (the tenant's shared ``rag_prefill_len``-token
+    retrieval header glued before a short body, few output tokens —
+    the prefill pool's diet).  The alternation is what gives the
+    autoscaler a prefill:decode imbalance worth rebalancing.
+
+    Arrivals use the `diurnal_trace` deterministic rate-integration
+    scheme at a flat ``rate``; bursts land at the same virtual tick.
+    Token 0 stays reserved as the engine's pad token.
+    """
+    if num_requests < 1:
+        raise ValueError(f"num_requests must be >= 1, got {num_requests}")
+    if rate <= 0.0:
+        raise ValueError(f"rate must be > 0, got {rate}")
+    if tenants < 1 or burst_every < 1 or burst_size < 1:
+        raise ValueError(
+            "tenants, burst_every, and burst_size must all be >= 1")
+    if not (1 <= prompt_len_min <= prompt_len_max):
+        raise ValueError(
+            f"bad prompt length range [{prompt_len_min}, {prompt_len_max}]"
+        )
+    rng = np.random.default_rng(seed)
+    rag_prefixes = [
+        rng.integers(1, vocab, rag_prefill_len).tolist()
+        if rag_prefill_len else []
+        for _ in range(tenants)
+    ]
+    trace = []
+    clock = 0.0
+    i = 0
+    burst_left = 0
+    burst_tenant = 0
+    while i < num_requests:
+        if burst_left == 0 and i and i % burst_every == 0:
+            # a RAG burst arrives together: same virtual tick, one
+            # tenant's retrieval header shared across the burst
+            burst_left = burst_size
+            burst_tenant = int(rng.integers(tenants))
+        if burst_left > 0:
+            burst_left -= 1
+            tenant = burst_tenant
+            body = rng.integers(
+                1, vocab,
+                int(rng.integers(prompt_len_min,
+                                 prompt_len_max + 1))).tolist()
+            prompt = rag_prefixes[tenant] + body
+            # floor of 2: one token commits the prompt, the next is
+            # what the decode pool exists to serve — a 1-token RAG
+            # request would finish before any handoff could happen
+            out = max(2, max_tokens // 4)
+        else:
+            clock += 1.0 / rate
+            tenant = int(rng.integers(tenants))
+            prompt = rng.integers(
+                1, vocab,
+                int(rng.integers(prompt_len_min,
+                                 prompt_len_max + 1))).tolist()
+            out = max_tokens
+        entry = {
+            "id": f"req-{i}",
+            "arrival": int(clock),
+            "prompt": [int(t) for t in prompt],
+            "max_tokens": int(out),
+            "temperature": float(temperature),
+            "seed": int(seed + i),
+            "session": f"tenant-{tenant}",
+            "priority": 1,
+        }
+        if deadline_ticks is not None:
+            entry["deadline_ticks"] = int(deadline_ticks)
+        trace.append(entry)
+        i += 1
+    return trace
+
+
 def save_trace(path: str, trace: list[dict[str, Any]], *,
                gray_plan: dict[str, Any] | None = None) -> None:
     """Persist a trace; ``gray_plan`` (the `chaos.FaultPlan` JSON dict)

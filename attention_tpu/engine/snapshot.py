@@ -469,7 +469,11 @@ def inspect(path: str) -> dict:
         "requests": [
             {"request_id": r["request_id"], "queue": r["queue"],
              "state": r["state"],
-             "output_tokens": len(r["output_tokens"])}
+             "output_tokens": len(r["output_tokens"]),
+             # page count, not page ids: ids are engine-local and
+             # meaningless to whoever reads the report (pre-PR-19
+             # snapshots always carry the key, so no .get needed)
+             "pages": len(r["pages"])}
             for r in requests
         ],
     })

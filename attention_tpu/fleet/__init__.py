@@ -1,0 +1,56 @@
+"""Disaggregated prefill/decode fleets + the closed-loop autoscaler.
+
+The subsystem that ACTS on the observatory.  PRs 12/14/18 built the
+byte-deterministic decision inputs — per-tick pressure series, Holt
+watermark forecasts, anomaly detectors, the blackbox actuation audit
+trail — all pinned ``advisory``.  This package closes the loop:
+
+    topology.py    role-typed replica pools (`FleetTopology`): fresh
+                   admissions route to the prefill pool, streams live
+                   in the decode pool, one shared standby bench
+    handoff.py     prefill→decode handoff that ships the request's
+                   committed KV pages (PR 9 section format, per-shard
+                   ``pools.<s>`` CRC'd slices) instead of re-prefilling
+    autoscaler.py  deterministic per-tick controller: promote on
+                   forecast watermark crossings, demote on sustained
+                   slack, rebalance the split — asymmetric hysteresis
+                   + cooldown (never flaps), anomaly firings veto
+                   scale-downs
+    ledger.py      the typed actuation ledger chaos invariant 16
+                   balances against the blackbox ring
+
+Correctness doctrine, unchanged from every layer below: placement and
+scale decisions may move WHERE tokens are computed, never WHICH — the
+disaggregated fleet is token-identical to the monolithic one on the
+same seeded trace, a corrupt handoff payload is a typed
+`HandoffCorruptError` + re-prefill fallback, and every pool resize is
+audited (blackbox event with a recorded cause; a scale-down followed
+by sheds inside the guard window dumps an ``incident-<tick>/``
+bundle).
+"""
+
+from attention_tpu.engine.errors import HandoffCorruptError  # noqa: F401
+from attention_tpu.fleet.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerPolicy,
+    ScaleAction,
+)
+from attention_tpu.fleet.handoff import (  # noqa: F401
+    HANDOFF_MAGIC,
+    HandoffRecord,
+    decode_handoff,
+    encode_handoff,
+    export_handoff,
+    import_handoff,
+    inspect_handoff,
+    is_handoff,
+)
+from attention_tpu.fleet.ledger import (  # noqa: F401
+    ACTUATION_CAUSES,
+    ActuationRecord,
+)
+from attention_tpu.fleet.topology import (  # noqa: F401
+    POOLS,
+    FleetTopology,
+    initial_pools,
+)

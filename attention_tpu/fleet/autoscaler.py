@@ -1,0 +1,203 @@
+"""Closed-loop elastic autoscaler: the controller that finally acts.
+
+PR 14 built the decision inputs (deterministic Holt forecasts over
+the frozen per-tick pressure series, watermark-crossing prediction)
+and PR 18 built the audit trail (blackbox actuation events, anomaly
+guardrails, postmortem bundles) — both explicitly advisory.  This
+module closes the loop: a pure per-tick controller that
+
+* **promotes** a warm standby into a pool when observed or FORECAST
+  pressure crosses the up watermark for ``scale_up_after``
+  consecutive ticks (the forecast horizon is what lands capacity
+  before the burst, not after it);
+* **demotes** a drained member back to standby after
+  ``scale_down_after`` consecutive slack ticks — asymmetric
+  hysteresis: scaling up is cheap and urgent, scaling down is neither;
+* **rebalances** the prefill:decode split when one pool is pressured,
+  no standby is available, and the other pool has slack (a paired
+  down+up, one cause, both pools cooldown-stamped);
+* **vetoes** its own scale-downs while an anomaly detector implicates
+  the pool (`obs/anomaly.py` firings: a gray-failure key names a
+  replica, hence its pool; a fleet-wide detector vetoes both pools).
+
+Determinism: the controller's only inputs are the tick counter, the
+per-pool mean pressures, pool sizes, the standby count, and the veto
+set — all deterministic series — and pools are visited in the fixed
+`POOLS` order.  Same seed, same trace → the same actuation sequence,
+which is what lets chaos invariant 16 balance the ledger byte-for-
+byte and the cooldown guarantee "zero up→down→up inside one cooldown
+window" hold as an invariant rather than a tendency.
+
+The controller DECIDES; `ServingFrontend` executes (promoting
+standbys, draining + demoting victims, writing the blackbox events
+and the `fleet.ledger` records, arming the mis-actuation guard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from attention_tpu.obs.forecast import ForecastPolicy, HoltForecaster
+
+from attention_tpu.fleet.topology import POOLS
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerPolicy:
+    """Controller knobs; every time-like field is in ticks."""
+
+    #: pressure at/above which a pool wants capacity (observed or
+    #: forecast inside ``horizon``)
+    up_pressure: float = 0.75
+    #: pressure at/below which a pool is slack
+    down_pressure: float = 0.25
+    #: consecutive pressured ticks before a scale-up fires
+    scale_up_after: int = 2
+    #: consecutive slack ticks before a scale-down fires (asymmetric:
+    #: give back capacity far more reluctantly than it was taken)
+    scale_down_after: int = 6
+    #: after any actuation on a pool, no further actuation on it for
+    #: this many ticks — the anti-flap guarantee
+    cooldown_ticks: int = 12
+    #: forecast steps ahead that count as "crossing is coming"
+    horizon: int = 4
+    #: ticks after a scale-down during which a shed is a mis-actuation
+    #: (dumps an ``incident-<tick>/`` bundle, cause ``actuation``)
+    guard_window: int = 8
+    #: neither pool may shrink below this
+    min_pool: int = 1
+    #: per-pool pressure forecaster (the PR 14 Holt machinery)
+    forecast: ForecastPolicy = dataclasses.field(
+        default_factory=ForecastPolicy)
+
+    def validate(self) -> None:
+        if not (0.0 < self.down_pressure < self.up_pressure <= 1.0):
+            raise ValueError(
+                f"need 0 < down_pressure < up_pressure <= 1, got "
+                f"down {self.down_pressure} up {self.up_pressure}"
+            )
+        for name in ("scale_up_after", "scale_down_after",
+                     "cooldown_ticks", "horizon", "guard_window",
+                     "min_pool"):
+            v = getattr(self, name)
+            if v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        self.forecast.validate()
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleAction:
+    """One controller decision for the front end to execute."""
+
+    kind: str    # "scale_up" | "scale_down" | "veto"
+    pool: str
+    cause: str   # fleet.ledger.ACTUATION_CAUSES member
+
+
+class Autoscaler:
+    """Pure per-tick controller (module doc).  Holds only its own
+    forecasters, streaks, and cooldown stamps — never a reference to
+    the front end."""
+
+    def __init__(self, policy: AutoscalerPolicy):
+        policy.validate()
+        self.policy = policy
+        self._fc = {pool: HoltForecaster(policy.forecast)
+                    for pool in POOLS}
+        self._up = {pool: 0 for pool in POOLS}
+        self._down = {pool: 0 for pool in POOLS}
+        self._last_action = {pool: None for pool in POOLS}
+
+    def _cooling(self, pool: str, tick: int) -> bool:
+        last = self._last_action[pool]
+        return (last is not None
+                and tick - last < self.policy.cooldown_ticks)
+
+    def decide(self, tick: int, *, pressures: dict[str, float],
+               pool_sizes: dict[str, int], standbys: int,
+               vetoed: tuple[str, ...] | frozenset[str] = (),
+               forced: int = 0) -> list[ScaleAction]:
+        """One controller tick.  ``pressures``/``pool_sizes`` are
+        keyed by pool; ``vetoed`` names pools an anomaly detector
+        currently implicates; ``forced`` demotions (chaos
+        ``demote_storm``) bypass hysteresis and vetoes but still
+        respect ``min_pool``.  Returns actions in execution order —
+        a rebalance emits its scale-down before its scale-up so the
+        freed handle is in the standby pool when the promotion pops
+        it."""
+        pol = self.policy
+        actions: list[ScaleAction] = []
+        avail = standbys
+        sizes = dict(pool_sizes)
+        for pool in POOLS:
+            p = float(pressures[pool])
+            fc = self._fc[pool]
+            fc.observe(p)
+            preds = [fc.predict(h) for h in range(1, pol.horizon + 1)]
+            crossed = (p >= pol.up_pressure
+                       or any(x >= pol.up_pressure for x in preds))
+            slack = (p <= pol.down_pressure
+                     and all(x <= pol.down_pressure for x in preds))
+            if crossed:
+                self._up[pool] += 1
+                self._down[pool] = 0
+            elif slack:
+                self._down[pool] += 1
+                self._up[pool] = 0
+            else:
+                self._up[pool] = 0
+                self._down[pool] = 0
+            if self._cooling(pool, tick):
+                continue
+            if self._up[pool] >= pol.scale_up_after:
+                if avail > 0:
+                    avail -= 1
+                    sizes[pool] += 1
+                    actions.append(
+                        ScaleAction("scale_up", pool, "forecast"))
+                    self._last_action[pool] = tick
+                    self._up[pool] = 0
+                    continue
+                other = POOLS[1] if pool == POOLS[0] else POOLS[0]
+                if (float(pressures[other]) <= pol.down_pressure
+                        and sizes[other] > pol.min_pool
+                        and not self._cooling(other, tick)):
+                    if other in vetoed:
+                        actions.append(
+                            ScaleAction("veto", other, "rebalance"))
+                        self._up[pool] = 0
+                        continue
+                    sizes[other] -= 1
+                    sizes[pool] += 1
+                    actions.append(
+                        ScaleAction("scale_down", other, "rebalance"))
+                    actions.append(
+                        ScaleAction("scale_up", pool, "rebalance"))
+                    self._last_action[pool] = tick
+                    self._last_action[other] = tick
+                    self._up[pool] = 0
+                continue
+            if (self._down[pool] >= pol.scale_down_after
+                    and sizes[pool] > pol.min_pool):
+                if pool in vetoed:
+                    # bounded emission: one veto per armed streak —
+                    # the streak re-arms from zero, so a persistent
+                    # anomaly produces a veto every scale_down_after
+                    # ticks, not every tick
+                    actions.append(ScaleAction("veto", pool, "slack"))
+                    self._down[pool] = 0
+                    continue
+                sizes[pool] -= 1
+                actions.append(ScaleAction("scale_down", pool, "slack"))
+                self._last_action[pool] = tick
+                self._down[pool] = 0
+        for _ in range(max(0, int(forced))):
+            cands = [pl for pl in POOLS if sizes[pl] > pol.min_pool]
+            if not cands:
+                break
+            pool = sorted(cands, key=lambda pl: (-sizes[pl], pl))[0]
+            sizes[pool] -= 1
+            actions.append(ScaleAction("scale_down", pool, "forced"))
+            self._last_action[pool] = tick
+            self._down[pool] = 0
+        return actions

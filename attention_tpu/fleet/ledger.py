@@ -1,0 +1,36 @@
+"""The actuation ledger: every pool-size change, typed and audited.
+
+The autoscaler graduated the observatory from ``advisory=False`` into
+actuation, and actuation must be auditable: chaos invariant 16
+(`chaos.invariants.actuation_ledger_violations`) balances this ledger
+against the blackbox flight-recorder ring — every entry here maps to
+exactly one ``scale_up``/``scale_down`` ring event carrying the same
+recorded cause, and a pool may never flap (up→down→up) inside one
+cooldown window.
+
+Causes are a closed alphabet, like event kinds and incident causes:
+
+    forecast   scale-up on a (predicted or observed) watermark crossing
+    slack      scale-down after sustained sub-watermark pressure
+    rebalance  paired down+up shifting the prefill:decode split
+    forced     chaos ``demote_storm`` bypassing hysteresis (exempt
+               from the flap check — the storm IS the flap)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: the closed actuation-cause alphabet (invariant 16 rejects others)
+ACTUATION_CAUSES = ("forecast", "slack", "rebalance", "forced")
+
+
+@dataclasses.dataclass(frozen=True)
+class ActuationRecord:
+    """One executed fleet resize, in actuation order."""
+
+    tick: int
+    kind: str           # "scale_up" | "scale_down"
+    pool: str           # fleet.topology.POOLS member
+    replica_id: str     # the handle promoted or demoted
+    cause: str          # ACTUATION_CAUSES member

@@ -1,0 +1,77 @@
+"""Role-typed replica pools: the disaggregated fleet's shape.
+
+The monolithic front end serves prefill and decode on the same
+replicas, so one 100k-token RAG prefill stalls every co-located
+tenant's TPOT.  `FleetTopology` splits `FrontendConfig.num_replicas`
+into two role-typed pools:
+
+* the **prefill pool** absorbs fresh admissions (long, bursty,
+  compute-bound prompt processing);
+* the **decode pool** streams tokens (short, steady, latency-bound
+  appends) and receives each request at prompt-commit through the
+  KV-shipping handoff (`fleet.handoff`).
+
+Roles are assigned by replica index at construction — the first
+``prefill_replicas`` handles form the prefill pool, the rest the
+decode pool — and tracked per replica id in
+``ServingFrontend.pool_of`` thereafter, because the elastic
+autoscaler (`fleet.autoscaler`) moves warm standbys in and drained
+members out at runtime.  The shared standby pool is role-less: a
+spare joins whichever pool the scale-up decision names.
+
+Placement is a PREFERENCE, never a correctness boundary: routing
+restricts eligibility to the role pool when that pool has a healthy
+member and falls back to the whole healthy fleet otherwise, and
+token values are independent of placement by construction (seeded
+sampling + arithmetic RNG reconstruction), so a degraded topology
+serves exactly the same tokens as a perfect one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: the closed pool-role alphabet, in deterministic iteration order
+POOLS = ("prefill", "decode")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetTopology:
+    """Static split of the replica fleet into role-typed pools.
+
+    ``prefill_replicas + decode_replicas`` must equal the front end's
+    ``num_replicas``; the shared ``FrontendConfig.standbys`` spares
+    back both pools."""
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+
+    def validate(self, *, num_replicas: int) -> None:
+        if self.prefill_replicas < 1:
+            raise ValueError(
+                f"prefill_replicas must be >= 1, got "
+                f"{self.prefill_replicas}"
+            )
+        if self.decode_replicas < 1:
+            raise ValueError(
+                f"decode_replicas must be >= 1, got "
+                f"{self.decode_replicas}"
+            )
+        total = self.prefill_replicas + self.decode_replicas
+        if total != num_replicas:
+            raise ValueError(
+                f"fleet topology covers {total} replicas "
+                f"(prefill {self.prefill_replicas} + decode "
+                f"{self.decode_replicas}) but num_replicas is "
+                f"{num_replicas}"
+            )
+
+
+def initial_pools(replica_ids, topology: FleetTopology) -> dict[str, str]:
+    """Index-based role assignment at fleet construction: the first
+    ``prefill_replicas`` ids go to the prefill pool, the rest decode."""
+    ids = list(replica_ids)
+    return {
+        rid: (POOLS[0] if i < topology.prefill_replicas else POOLS[1])
+        for i, rid in enumerate(ids)
+    }

@@ -56,6 +56,7 @@ from attention_tpu.engine.engine import (
 )
 from attention_tpu.engine.errors import (
     DeadlineExceededError,
+    HandoffCorruptError,
     PrefixStoreCorruptError,
     ReplicaDeadError,
     RequestShedError,
@@ -63,6 +64,15 @@ from attention_tpu.engine.errors import (
 )
 from attention_tpu.engine.request import Request, SamplingParams
 from attention_tpu.engine.sim import sampling_of
+from attention_tpu.engine.snapshot import _request_to_dict
+from attention_tpu.fleet.autoscaler import Autoscaler, AutoscalerPolicy
+from attention_tpu.fleet.handoff import export_handoff, import_handoff
+from attention_tpu.fleet.ledger import ActuationRecord
+from attention_tpu.fleet.topology import (
+    POOLS,
+    FleetTopology,
+    initial_pools,
+)
 from attention_tpu.frontend.backoff import RetryPolicy
 from attention_tpu.frontend.degrade import (
     NUM_PRIORITY_CLASSES,
@@ -270,6 +280,15 @@ class FrontendConfig:
     # each dump one atomic `incident-<tick>/` bundle there.
     anomaly: AnomalyPolicy | None = None
     incident_dir: str | None = None
+    # disaggregated serving (attention_tpu.fleet): ``fleet`` splits
+    # the replicas into role-typed prefill/decode pools — fresh
+    # admissions route to the prefill pool and at prompt-commit hand
+    # off (shipping committed KV pages) to the decode pool.  None =
+    # monolithic = byte-identical to the pre-fleet front end.
+    fleet: FleetTopology | None = None
+    # the closed-loop elastic autoscaler (requires ``fleet``; the
+    # standby bench is what it promotes from / demotes to)
+    autoscaler: AutoscalerPolicy | None = None
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -308,6 +327,14 @@ class FrontendConfig:
             self.prefix_store.validate()
         if self.anomaly is not None:
             self.anomaly.validate()
+        if self.fleet is not None:
+            self.fleet.validate(num_replicas=self.num_replicas)
+        if self.autoscaler is not None:
+            if self.fleet is None:
+                raise ValueError(
+                    "autoscaler requires a fleet topology "
+                    "(FrontendConfig.fleet)")
+            self.autoscaler.validate()
 
 
 def _cumulative_series(pairs, n: int) -> list[float]:
@@ -440,6 +467,9 @@ class ServingFrontend:
             "supervisor_degraded": 0, "supervisor_dead": 0,
             "supervisor_recoveries": 0,
             "anomaly_firings": 0, "incidents": 0,
+            "handoffs": 0, "handoff_fallbacks": 0,
+            "reprefill_avoided_tokens": 0,
+            "scale_ups": 0, "scale_downs": 0, "actuation_vetoes": 0,
         }
         self._tick = 0
         #: incident-bundle writer (None = no dumping) — constructed
@@ -503,6 +533,26 @@ class ServingFrontend:
         #: load forecaster (None = disabled = zero tick-loop work)
         self.forecast = (ForecastTracker(config.forecast)
                          if config.forecast is not None else None)
+        #: fleet role map, replica id -> pool (empty = monolithic:
+        #: every fleet hook is a single truthiness check, the
+        #: zero-overhead contract telemetry/forecasting honor)
+        self.pool_of: dict[str, str] = (
+            initial_pools([h.replica_id for h in self.replicas],
+                          config.fleet)
+            if config.fleet is not None else {})
+        #: closed-loop controller (None = static fleet)
+        self.autoscaler = (Autoscaler(config.autoscaler)
+                           if config.autoscaler is not None else None)
+        #: executed resizes, in order — chaos invariant 16 balances
+        #: this ledger against the blackbox ring
+        self.actuations: list[ActuationRecord] = []
+        # chaos knobs (chaos.faults): corrupt the next N handoff
+        # payloads / force N hysteresis-bypassing demotions
+        self._poison_handoffs = 0
+        self._force_demotions = 0
+        #: armed mis-actuation guards: (scale_down tick, pool,
+        #: shed_rejected count at actuation time)
+        self._guards: list[tuple[int, str, int]] = []
 
     def _make_handle(self, replica_id: str, *,
                      spare: bool = False) -> ReplicaHandle:
@@ -642,9 +692,11 @@ class ServingFrontend:
             self._admit_arrivals(t)
             self._admit_retries(t)
             self._step_replicas(t)
+            self._handoff_committed(t)
             self._supervise(t)
             self._migrate_stalled(t)
             self._update_ladder_and_gauges(t)
+            self._autoscale(t)
             self._persist_prefix_store(t)
         self._tick += 1
         return t
@@ -1023,10 +1075,21 @@ class ServingFrontend:
                 exclude: str | None = None) -> None:
         if not self._store_gate(fr, t):
             return
+        eligible = self.supervisor.eligible_ids(self.replicas)
+        if self.pool_of:
+            # role-typed placement is a PREFERENCE, never a
+            # correctness boundary: fresh admissions prefer the
+            # prefill pool, resumed streams the decode pool, and an
+            # empty intersection falls back to the whole healthy set
+            pool = "decode" if fr.tokens else "prefill"
+            pooled = {rid for rid in sorted(eligible)
+                      if self.pool_of.get(rid) == pool}
+            if pooled:
+                eligible = pooled
         decision = self.router.route(
             fr.prompt, self.replicas, session=fr.session,
             exclude=exclude,
-            eligible=self.supervisor.eligible_ids(self.replicas),
+            eligible=eligible,
             store=self.prefix_store, now=t,
         )
         if decision is None:
@@ -1192,6 +1255,13 @@ class ServingFrontend:
         warm_from = failed.snapshot_dir if failed is not None else None
         mode = spare.restart(tick=t, warm_from=warm_from)
         self.replicas.append(spare)
+        if self.pool_of and failed is not None:
+            # fleet continuity: the replacement serves the dead
+            # replica's pool (the dead handle keeps its entry so a
+            # chaos restart rejoins its old role)
+            pool = self.pool_of.get(failed.replica_id)
+            if pool is not None:
+                self.pool_of[spare.replica_id] = pool
         self.supervisor.reset(t, spare.replica_id)
         self.counts["standby_promotions"] += 1
         _PROMOTED.inc()
@@ -1239,6 +1309,265 @@ class ServingFrontend:
         self.counts["deadline_expired"] += 1
         _DEADLINE_EXPIRED.inc()
         self._finalize(fr, FrontendRequestState.TIMED_OUT, error=e)
+
+    # -- disaggregation: prompt-commit handoff + elastic autoscaler -------
+
+    def note_handoff(self, fr: FrontendRequest, dest: ReplicaHandle,
+                     t: int, *, avoided: int) -> None:
+        """Bookkeeping for one completed prefill->decode cut
+        (`note_migrated`'s discipline with the fleet counters):
+        ``avoided`` is the re-prefill tokens the shipped KV pages
+        saved the destination."""
+        fr.last_replica = fr.replica_id
+        fr.replica_id = dest.replica_id
+        fr.routed_by = "handoff"
+        fr.assigned_tick = t
+        fr.waiting_since = None
+        self.counts["handoffs"] += 1
+        self.counts["reprefill_avoided_tokens"] += avoided
+        self._trace_event(fr, "migrated", source=fr.last_replica,
+                          dest=dest.replica_id,
+                          tokens_at_cut=len(fr.tokens))
+        self._bb_note("handoff", replica_id=dest.replica_id, tick=t,
+                      request=fr.request_id, source=fr.last_replica,
+                      avoided_tokens=avoided)
+        self.events_log.append(
+            ("admit", t, fr.request_id, dest.replica_id))
+
+    def _handoff_committed(self, t: int) -> None:
+        """Move every prompt-committed stream (first output token
+        sampled, so prefill is done) off the prefill pool and onto a
+        decode replica, shipping its committed KV pages so the
+        destination resumes without re-prefilling.  No decode
+        destination = the stream decodes where it prefilled —
+        placement is a preference, never a correctness boundary."""
+        if not self.pool_of:
+            return
+        healthy = self.supervisor.eligible_ids(self.replicas)
+        decode_ids = {rid for rid in sorted(healthy)
+                      if self.pool_of.get(rid) == "decode"}
+        for handle in list(self.replicas):
+            if (not handle.alive
+                    or self.pool_of.get(handle.replica_id)
+                    != "prefill"):
+                continue
+            dest_ids = decode_ids - {handle.replica_id}
+            if not dest_ids:
+                continue
+            eng = handle.engine
+            live = sorted(
+                [("waiting", r) for r in eng.scheduler.waiting]
+                + [("running", r) for r in eng.scheduler.running],
+                key=lambda item: item[1].seq,
+            )
+            for queue, req in live:
+                fr = self.requests.get(req.request_id)
+                if (fr is None
+                        or fr.state is not FrontendRequestState.ASSIGNED
+                        or fr.replica_id != handle.replica_id
+                        or not req.output_tokens):
+                    continue
+                self._handoff_one(handle, queue, req, fr, t, dest_ids)
+
+    def _handoff_one(self, source: ReplicaHandle, queue: str, req,
+                     fr: FrontendRequest, t: int,
+                     dest_ids: set[str]) -> None:
+        """One prefill->decode cut: serialize (PR 9 section format),
+        export the committed KV pages, cancel on the source, import +
+        resume on the destination.  A corrupt payload is a typed
+        `HandoffCorruptError` + re-prefill fallback — the destination
+        rebuilds the prefix from the prompt; tokens are never wrong,
+        only slower."""
+        rec = _request_to_dict(req, queue)
+        decision = self.router.route(
+            fr.prompt, self.replicas, session=fr.session,
+            exclude=source.replica_id, eligible=dest_ids,
+        )
+        if decision is None:
+            return
+        dest = decision.replica
+        blob = export_handoff(source.engine, req, rec)
+        if blob is not None and self._poison_handoffs > 0:
+            # chaos `handoff_poison`: flip one payload byte past the
+            # manifest so the section CRC — not the JSON parse — is
+            # what catches it
+            self._poison_handoffs -= 1
+            mid = len(blob) // 2
+            blob = (blob[:mid] + bytes([blob[mid] ^ 0xFF])
+                    + blob[mid + 1:])
+        # THE CUT (`frontend.migrate` discipline): source first,
+        # destination second — exactly one engine ever holds it
+        source.engine.cancel(req.request_id)
+        avoided = 0
+        if blob is not None:
+            try:
+                avoided = import_handoff(dest.engine, blob, now=t)
+            except HandoffCorruptError:
+                self.counts["handoff_fallbacks"] += 1
+                self._bb_note("handoff_fallback",
+                              replica_id=dest.replica_id, tick=t,
+                              request=fr.request_id,
+                              source=source.replica_id)
+                self._incident("typed_error", {
+                    "error": "HandoffCorruptError",
+                    "request": fr.request_id,
+                    "source": source.replica_id,
+                    "dest": dest.replica_id})
+        outs = [int(tok) for tok in rec["output_tokens"]]
+        sampling = SamplingParams(**rec["sampling"])
+        try:
+            dest.engine.resume_request(
+                rec["prompt"], sampling,
+                request_id=fr.request_id, output_tokens=outs,
+                deadline_step=dest.local_deadline(fr.deadline),
+            )
+        except DeadlineExceededError as e:
+            self.note_migration_timeout(fr, e)
+            self.migrations.append(MigrationRecord(
+                tick=t, request_id=fr.request_id,
+                source=source.replica_id, dest=None,
+                tokens_at_cut=len(fr.tokens), record=rec))
+            return
+        _trace.adopt(fr.request_id, rec.get("trace", []))
+        self.note_handoff(fr, dest, t, avoided=avoided)
+        self.migrations.append(MigrationRecord(
+            tick=t, request_id=fr.request_id,
+            source=source.replica_id, dest=dest.replica_id,
+            tokens_at_cut=len(fr.tokens), record=rec))
+
+    def _vetoed_pools(self) -> tuple[str, ...]:
+        """Pools the anomaly detectors currently implicate: a
+        gray-failure key names a replica, hence its pool; any other
+        active firing is fleet-wide and vetoes both."""
+        if self.anomaly is None or not self.anomaly.active:
+            return ()
+        vetoed: set[str] = set()
+        for _detector, key in sorted(self.anomaly.active):
+            pool = self.pool_of.get(key)
+            if pool is not None:
+                vetoed.add(pool)
+            else:
+                vetoed.update(POOLS)
+        return tuple(sorted(vetoed))
+
+    def _autoscale(self, t: int) -> None:
+        """One controller tick: settle armed mis-actuation guards,
+        feed the per-pool pressures, execute the decided actions.
+        Runs after `_update_ladder_and_gauges` so the anomaly active
+        set feeding the veto is this tick's, not last tick's."""
+        if self.autoscaler is None:
+            return
+        self._check_guards(t)
+        pressures: dict[str, float] = {}
+        sizes: dict[str, int] = {}
+        for pool in POOLS:
+            members = [h for h in self.replicas
+                       if self.pool_of.get(h.replica_id) == pool]
+            sizes[pool] = sum(1 for h in members if h.alive)
+            if any(h.alive for h in members):
+                _, mean = pool_pressure(
+                    members, queue_cap=self.config.shed.queue_cap)
+            else:
+                mean = 1.0   # an empty/dead pool is saturated
+            pressures[pool] = mean
+        forced, self._force_demotions = self._force_demotions, 0
+        actions = self.autoscaler.decide(
+            t, pressures=pressures, pool_sizes=sizes,
+            standbys=len(self.standby_pool),
+            vetoed=self._vetoed_pools(), forced=forced)
+        for act in actions:
+            if act.kind == "veto":
+                self.counts["actuation_vetoes"] += 1
+                self._bb_note("actuation_veto", tick=t,
+                              pool=act.pool, cause=act.cause)
+            elif act.kind == "scale_up":
+                self._scale_up(t, act.pool, act.cause)
+            else:
+                self._scale_down(t, act.pool, act.cause)
+
+    def _scale_up(self, t: int, pool: str, cause: str) -> None:
+        """Promote the next standby (cold boot) into ``pool`` — the
+        `_promote_standby` mechanics minus the failed-replica warm
+        source, plus the actuation ledger entry."""
+        if not self.standby_pool:
+            return
+        spare = self.standby_pool.pop(0)
+        spare.restart(tick=t)
+        self.replicas.append(spare)
+        self.pool_of[spare.replica_id] = pool
+        self.supervisor.reset(t, spare.replica_id)
+        self._apply_ladder_to(spare)
+        self.counts["scale_ups"] += 1
+        self.actuations.append(ActuationRecord(
+            tick=t, kind="scale_up", pool=pool,
+            replica_id=spare.replica_id, cause=cause))
+        self._bb_note("scale_up", replica_id=spare.replica_id,
+                      tick=t, pool=pool, cause=cause)
+
+    def _scale_down(self, t: int, pool: str, cause: str) -> None:
+        """Drain + demote the youngest alive member of ``pool`` back
+        to the standby bench, then arm the mis-actuation guard: a
+        shed inside ``guard_window`` ticks indicts this decision
+        (incident cause ``actuation``)."""
+        members = [h for h in self.replicas
+                   if self.pool_of.get(h.replica_id) == pool
+                   and h.alive]
+        if not members:
+            return
+        victim = members[-1]
+        healthy = self.supervisor.eligible_ids(self.replicas)
+        dest_ids = {rid for rid in sorted(healthy)
+                    if self.pool_of.get(rid) == pool
+                    and rid != victim.replica_id}
+        if not dest_ids:
+            dest_ids = {rid for rid in sorted(healthy)
+                        if rid != victim.replica_id}
+        drained = drain_replica(self, victim, tick=t,
+                                eligible=dest_ids)
+        self.migrations.extend(drained)
+        leftovers = sorted(
+            (fr for fr in self.requests.values()
+             if fr.state is FrontendRequestState.ASSIGNED
+             and fr.replica_id == victim.replica_id),
+            key=lambda f: f.seq)
+        # note BEFORE the kill so the record carries the demoted
+        # incarnation's live coordinates (`kill_replica` discipline)
+        self._bb_note("scale_down", replica_id=victim.replica_id,
+                      tick=t, pool=pool, cause=cause,
+                      drained=len(drained))
+        victim.kill()
+        self.router.forget_replica(victim.replica_id)
+        self.replicas.remove(victim)
+        del self.pool_of[victim.replica_id]
+        self.standby_pool.append(victim)
+        err = ReplicaDeadError(
+            f"replica {victim.replica_id} demoted to standby at "
+            f"tick {t}")
+        for fr in leftovers:
+            self._requeue(fr, t, err)
+        self.counts["scale_downs"] += 1
+        self.actuations.append(ActuationRecord(
+            tick=t, kind="scale_down", pool=pool,
+            replica_id=victim.replica_id, cause=cause))
+        self._guards.append((t, pool, self.counts["shed_rejected"]))
+
+    def _check_guards(self, t: int) -> None:
+        """Settle armed mis-actuation guards: a scale-down followed
+        by ANY shed inside its guard window was capacity the fleet
+        still needed — dump one ``actuation`` incident and disarm;
+        a guard that ages out clean just expires."""
+        if not self._guards:
+            return
+        gw = self.config.autoscaler.guard_window
+        keep: list[tuple[int, str, int]] = []
+        for (t0, pool, sheds0) in self._guards:
+            if self.counts["shed_rejected"] > sheds0:
+                self._incident("actuation", {
+                    "pool": pool, "scale_down_tick": t0,
+                    "sheds": self.counts["shed_rejected"] - sheds0})
+            elif t - t0 < gw:
+                keep.append((t0, pool, sheds0))
+        self._guards = keep
 
     def _migrate_stalled(self, t: int) -> None:
         """Admission-stall detection: a request that has sat in a
@@ -1436,6 +1765,14 @@ class ServingFrontend:
                     fin_cached / fin_prompt, 4) if fin_prompt else 0.0,
                 "imported_tokens": st.counts["import_tokens"],
             }
+        fleet_block: dict[str, Any] = {}
+        if self.pool_of:
+            fleet_block["fleet"] = {
+                "pools": {pool: sum(
+                    1 for rid in sorted(self.pool_of)
+                    if self.pool_of[rid] == pool) for pool in POOLS},
+                "actuations": len(self.actuations),
+            }
         return {
             "ticks": self._tick,
             "num_requests": len(frs),
@@ -1458,6 +1795,7 @@ class ServingFrontend:
             "degrade_step_downs": self.ladder.step_downs,
             "degrade_recoveries": self.ladder.recoveries,
             **store_block,
+            **fleet_block,
             **self.counts,
         }
 

@@ -116,6 +116,11 @@ BLACKBOX_EVENTS = frozenset({
     "fault_injected",    # chaos fault armed/fired by an injector
     "anomaly_fire",      # an online detector crossed its pinned bound
     "incident_dump",     # a postmortem bundle was written
+    "scale_up",          # autoscaler promoted a standby into a pool
+    "scale_down",        # autoscaler drained + demoted a pool member
+    "handoff",           # prefill->decode cut shipped committed KV pages
+    "handoff_fallback",  # handoff payload refused, typed + re-prefill
+    "actuation_veto",    # anomaly firing blocked a pending scale-down
 })
 
 

@@ -51,6 +51,8 @@ INCIDENT_CAUSES = frozenset({
     "typed_error",  # a fault-class typed error surfaced in the frontend
     "detector",     # an obs/anomaly.py detector crossed its bound
     "invariant",    # a chaos invariant checker reported violations
+    "actuation",    # a fleet scale-down was followed by sheds inside
+                    # its guard window (mis-actuation)
 })
 
 
@@ -289,6 +291,7 @@ _TRIGGER_KINDS = {
     "typed_error": ("shed", "replica_kill", "store_corrupt",
                     "lease_expire"),
     "invariant": ("fault_injected", "anomaly_fire"),
+    "actuation": ("scale_down", "shed"),
 }
 
 

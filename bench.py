@@ -631,6 +631,114 @@ def _bench_prefix_fleet(model, params, args) -> dict:
     }
 
 
+def _bench_disagg_fleet(model, params, args) -> dict:
+    """The ``--disagg`` detail block: the SAME seeded mixed workload
+    (steady decode-heavy sessions + tenant RAG prefill bursts, 160-token
+    retrieval headers — long enough to commit full pages) through a
+    3-replica front end twice — a monolithic arm where every replica
+    serves both phases, and a disaggregated arm where admissions land
+    in a 1-replica prefill pool and hand off to a 2-replica decode pool
+    at prompt commit, shipping the committed KV pages, with the
+    closed-loop autoscaler free to rebalance the split from the shared
+    standby bench.
+
+    The comparison the record exists for: per-phase latency digests
+    (TTFT is the prefill pool's problem, TPOT the decode pool's — the
+    monolithic arm pays for bursts in everyone's TPOT) plus the SLO
+    burn rates over the same `obs.slo` objectives, and the handoff
+    economics (pages shipped == re-prefill tokens avoided on the decode
+    side).  Both arms are fully deterministic and must finish every
+    request with IDENTICAL tokens — disaggregation moves WHERE tokens
+    are computed, never WHICH."""
+    from attention_tpu.engine import EngineConfig
+    from attention_tpu.engine.sim import disagg_trace, sampling_of
+    from attention_tpu.fleet import AutoscalerPolicy, FleetTopology
+    from attention_tpu.frontend import FrontendConfig, ServingFrontend
+    from attention_tpu.frontend.frontend import FrontendRequestState
+    from attention_tpu.obs import slo as slo_mod
+
+    trace = disagg_trace(
+        args.engine_requests * 2, vocab=256, seed=11,
+        rate=1.5, tenants=2, burst_every=4, burst_size=2,
+        rag_prefill_len=160, prompt_len_min=4, prompt_len_max=12,
+        max_tokens=8,
+    )
+    config = EngineConfig(
+        num_pages=64, page_size=128, max_seq_len=384,
+        max_decode_batch=8, max_prefill_rows=2, prefill_chunk=64,
+        token_budget=192, watermark_pages=1,
+    )
+
+    def _run(disagg):
+        fleet = autoscaler = None
+        if disagg:
+            fleet = FleetTopology(prefill_replicas=1, decode_replicas=2)
+            autoscaler = AutoscalerPolicy(
+                scale_up_after=2, scale_down_after=4,
+                cooldown_ticks=8, guard_window=6)
+        fe = ServingFrontend(model, params, config, FrontendConfig(
+            num_replicas=3, seed=0, standbys=2,
+            fleet=fleet, autoscaler=autoscaler,
+        ))
+        for e in trace:
+            fe.submit(e["prompt"], sampling_of(e),
+                      request_id=e.get("id"),
+                      arrival=int(e.get("arrival", 0)),
+                      session=e.get("session"),
+                      priority=int(e.get("priority", 1)))
+        while fe.has_work():
+            fe.tick()
+        summary = fe.summary()
+        report = slo_mod.slo_report(fe.latency_rows(),
+                                    horizon_tick=summary["ticks"])
+        finished = {
+            rid: list(fr.tokens)
+            for rid, fr in fe.requests.items()
+            if fr.state is FrontendRequestState.FINISHED
+        }
+        return summary, report, finished
+
+    s_mono, rep_mono, fin_mono = _run(False)
+    s_dis, rep_dis, fin_dis = _run(True)
+    common = sorted(set(fin_mono) & set(fin_dis))
+
+    def _arm(summary, report):
+        fb = report["fleet"]
+        return {
+            "ticks": summary["ticks"],
+            "finished": summary["states"]["finished"],
+            "ttft": fb["ttft"],
+            "tpot": fb["tpot"],
+            "slo": {ob["objective"]: {
+                "burn_rate": ob["burn_rate"],
+                "budget_remaining": ob["budget_remaining"],
+                "violations": ob["violations"],
+            } for ob in fb["slo"]},
+        }
+
+    return {
+        "replicas": 3,
+        "standbys": 2,
+        "requests": len(trace),
+        "monolithic": _arm(s_mono, rep_mono),
+        "disaggregated": {
+            **_arm(s_dis, rep_dis),
+            "pools": s_dis["fleet"]["pools"],
+            "actuations": s_dis["fleet"]["actuations"],
+            "handoffs": s_dis["handoffs"],
+            "handoff_fallbacks": s_dis["handoff_fallbacks"],
+            "reprefill_avoided_tokens":
+                s_dis["reprefill_avoided_tokens"],
+            "scale_ups": s_dis["scale_ups"],
+            "scale_downs": s_dis["scale_downs"],
+        },
+        # the tentpole contract, checked right here in the bench:
+        # disaggregation moves WHERE tokens are computed, never WHICH
+        "tokens_match_monolithic": all(
+            fin_dis[r] == fin_mono[r] for r in common),
+    }
+
+
 def _bench_gray_fleet(model, params, args) -> dict:
     """The ``--gray-failure`` detail block: the RAG-heavy diurnal
     trace through a 2-replica front end with the anomaly detectors
@@ -847,6 +955,10 @@ def _bench_engine(args) -> dict:
     if args.gray_failure:
         gray_detail = _bench_gray_fleet(model, params, args)
 
+    disagg_detail = None
+    if args.disagg:
+        disagg_detail = _bench_disagg_fleet(model, params, args)
+
     return {
         "metric": "engine continuous-batching decode throughput vs "
         "sequential generate_paged (same model, same requests, CPU/TPU "
@@ -874,6 +986,7 @@ def _bench_engine(args) -> dict:
             "mesh": mesh_detail,
             "prefix_fleet": fleet_detail,
             "gray_fleet": gray_detail,
+            "disagg_fleet": disagg_detail,
             "per_step": [m.to_dict() for m in engine.metrics.steps],
         },
     }
@@ -908,6 +1021,16 @@ def main(argv=None) -> int:
         "with a mid-run supervisor-invisible brownout of replica-0 "
         "(attention_tpu.obs.anomaly), and report gray-failure "
         "detection tick vs injection tick + clean-arm false positives",
+    )
+    p.add_argument(
+        "--disagg", action="store_true",
+        help="engine arm: ALSO run the seeded mixed workload (steady "
+        "decode sessions + RAG prefill bursts) through a monolithic "
+        "3-replica front end and through the disaggregated prefill/"
+        "decode fleet with the closed-loop autoscaler "
+        "(attention_tpu.fleet) and report TTFT/TPOT digests, SLO burn "
+        "rates, and re-prefill-avoided tokens (token streams must "
+        "match exactly)",
     )
     p.add_argument(
         "--mesh-shards", type=int, default=0,

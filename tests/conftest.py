@@ -139,6 +139,16 @@ def pytest_configure(config):
         "(attention_tpu/frontend/) — routing, deadlines, retry-with-"
         "backoff, load shedding, degradation ladder; CPU-only",
     )
+    # the disaggregation tier (tests/test_fleet.py): role-typed
+    # pools, KV-page handoffs, the closed-loop autoscaler, and the
+    # disagg chaos storm; CPU-only, tier-1 fast except the broad
+    # sweep (also carries slow)
+    config.addinivalue_line(
+        "markers",
+        "fleet: disaggregated prefill/decode serving "
+        "(attention_tpu/fleet/) — role pools, KV handoff records, "
+        "elastic autoscaler, actuation-ledger invariant; CPU-only",
+    )
     # the static-analysis tier (tests/test_analysis.py): AST passes,
     # baseline round-trips, and the tree-wide-clean gate; jax-free
     # and CPU-fast, tier-1

@@ -1,0 +1,368 @@
+"""Disaggregated prefill/decode fleets + the closed-loop autoscaler
+(attention_tpu/fleet/, ISSUE 19).
+
+Tiny CPU shapes throughout.  The acceptance pins:
+
+* **token parity** — the disaggregated fleet (role pools, KV-page
+  handoffs at prompt commit, elastic resizes) finishes every request
+  token-identical to a fault-free single-engine run of the same seeded
+  trace, and the same seed yields a byte-identical summary;
+* **handoff economics** — clean handoffs ship committed pages, so the
+  decode side's re-prefill work is counter-pinned > 0 avoided tokens
+  with zero fallbacks; a corrupted payload is a typed
+  `HandoffCorruptError` + re-prefill fallback, never a wrong token;
+* **controller discipline** — the forecast lands a scale-up before the
+  observed watermark crossing, cooldown makes up→down→up inside one
+  window impossible, anomaly vetoes suppress scale-downs, and chaos
+  invariant 16 balances the actuation ledger against the blackbox ring
+  under the disagg storm (poisoned handoffs + demotion storms).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from attention_tpu.chaos import invariants as inv
+from attention_tpu.chaos.faults import run_disagg_campaign
+from attention_tpu.engine import (
+    EngineConfig,
+    SamplingParams,
+    ServingEngine,
+    replay,
+)
+from attention_tpu.engine.sim import disagg_trace
+from attention_tpu.engine.snapshot import _request_to_dict
+from attention_tpu.fleet import (
+    Autoscaler,
+    AutoscalerPolicy,
+    FleetTopology,
+    HandoffCorruptError,
+    decode_handoff,
+    export_handoff,
+    import_handoff,
+    initial_pools,
+    inspect_handoff,
+    is_handoff,
+)
+from attention_tpu.frontend import (
+    FrontendConfig,
+    ServingFrontend,
+    replay_frontend,
+)
+from attention_tpu.models import TinyDecoder
+from attention_tpu.obs import blackbox
+from attention_tpu.obs import slo as slo_mod
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = TinyDecoder(vocab=43, dim=32, depth=1, num_q_heads=4,
+                        num_kv_heads=2, impl="flash", dtype=jnp.float32)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), probe)["params"]
+    return model, params
+
+
+def _cfg(**overrides):
+    kw = dict(num_pages=24, page_size=128, max_seq_len=384,
+              max_decode_batch=4, max_prefill_rows=2,
+              prefill_chunk=64, token_budget=192, watermark_pages=1)
+    kw.update(overrides)
+    return EngineConfig(**kw)
+
+
+def _trace(seed=3, n=10):
+    """Mixed workload whose RAG headers exceed one 128-token page, so
+    handoffs actually carry KV."""
+    return disagg_trace(n, vocab=43, seed=seed, max_tokens=6,
+                        rag_prefill_len=160, burst_every=4,
+                        burst_size=2)
+
+
+def _fleet_config(**overrides):
+    kw = dict(
+        num_replicas=3, seed=0, standbys=2,
+        fleet=FleetTopology(prefill_replicas=1, decode_replicas=2),
+        autoscaler=AutoscalerPolicy(scale_up_after=2,
+                                    scale_down_after=4,
+                                    cooldown_ticks=8, guard_window=6),
+    )
+    kw.update(overrides)
+    return FrontendConfig(**kw)
+
+
+def _run_fleet(model, params, trace, config=None, *, poison=0):
+    fe = ServingFrontend(model, params, _cfg(),
+                         config or _fleet_config())
+    if poison:
+        fe._poison_handoffs = poison
+    with blackbox.capture():
+        summary, outputs = replay_frontend(fe, trace)
+    return fe, summary, outputs
+
+
+# -------------------------------------------------- topology + config
+
+
+def test_topology_validation_and_initial_pools():
+    topo = FleetTopology(prefill_replicas=1, decode_replicas=2)
+    topo.validate(num_replicas=3)
+    with pytest.raises(ValueError, match="covers 3 replicas"):
+        topo.validate(num_replicas=4)
+    with pytest.raises(ValueError, match="prefill_replicas"):
+        FleetTopology(prefill_replicas=0,
+                      decode_replicas=3).validate(num_replicas=3)
+    pools = initial_pools(["r-0", "r-1", "r-2"], topo)
+    assert pools == {"r-0": "prefill", "r-1": "decode", "r-2": "decode"}
+
+
+def test_frontend_config_fleet_validation():
+    with pytest.raises(ValueError, match="covers"):
+        FrontendConfig(num_replicas=3,
+                       fleet=FleetTopology(prefill_replicas=1,
+                                           decode_replicas=1)).validate()
+    with pytest.raises(ValueError, match="requires a fleet topology"):
+        FrontendConfig(num_replicas=2,
+                       autoscaler=AutoscalerPolicy()).validate()
+    with pytest.raises(ValueError, match="down_pressure"):
+        FrontendConfig(
+            num_replicas=2,
+            fleet=FleetTopology(prefill_replicas=1, decode_replicas=1),
+            autoscaler=AutoscalerPolicy(up_pressure=0.2,
+                                        down_pressure=0.5)).validate()
+
+
+# ------------------------------------------------- controller (unit)
+
+
+def test_autoscaler_forecast_lands_capacity_before_crossing():
+    """A steady pressure ramp: the Holt forecast crosses the up
+    watermark inside the horizon BEFORE the observed series does, so
+    the standby is promoted ahead of the burst, not after it."""
+    a = Autoscaler(AutoscalerPolicy(scale_up_after=2,
+                                    scale_down_after=3,
+                                    cooldown_ticks=6, horizon=4))
+    sizes = {"prefill": 1, "decode": 2}
+    first_up = t_cross = None
+    for t in range(12):
+        p = 0.08 * t  # observed crossing of 0.75 at t=10
+        if p >= 0.75 and t_cross is None:
+            t_cross = t
+        for act in a.decide(t, pressures={"prefill": p, "decode": 0.5},
+                            pool_sizes=sizes, standbys=2):
+            if act.kind == "scale_up" and first_up is None:
+                first_up = t
+                sizes[act.pool] += 1
+    assert t_cross == 10
+    assert first_up is not None and first_up < t_cross
+    assert first_up == 7  # deterministic: same ramp, same tick
+
+
+def test_autoscaler_cooldown_never_flaps():
+    """After an actuation the pool is frozen for cooldown_ticks: a
+    burst then sustained slack yields up, then downs spaced >= one
+    full cooldown apart — up→down→up inside one window is impossible
+    by construction."""
+    pol = AutoscalerPolicy(scale_up_after=2, scale_down_after=3,
+                           cooldown_ticks=6)
+    a = Autoscaler(pol)
+    sizes = {"prefill": 2, "decode": 2}
+    log = []
+    for t in range(20):
+        p = 0.9 if t < 3 else 0.05
+        for act in a.decide(t, pressures={"prefill": p, "decode": 0.5},
+                            pool_sizes=sizes, standbys=1):
+            log.append((t, act.kind))
+            sizes["prefill"] += 1 if act.kind == "scale_up" else -1
+    assert log == [(1, "scale_up"), (7, "scale_down"),
+                   (13, "scale_down")]
+    ticks = [t for t, _ in log]
+    assert all(b - a_ >= pol.cooldown_ticks
+               for a_, b in zip(ticks, ticks[1:]))
+
+
+def test_autoscaler_veto_and_forced_demotions():
+    a = Autoscaler(AutoscalerPolicy(scale_up_after=2,
+                                    scale_down_after=3,
+                                    cooldown_ticks=6))
+    vetoes = []
+    for t in range(10):
+        for act in a.decide(t,
+                            pressures={"prefill": 0.5, "decode": 0.1},
+                            pool_sizes={"prefill": 1, "decode": 2},
+                            standbys=0, vetoed=("decode",)):
+            vetoes.append((t, act.kind, act.pool))
+    # one veto per armed slack streak, never a scale_down
+    assert vetoes == [(2, "veto", "decode"), (5, "veto", "decode"),
+                      (8, "veto", "decode")]
+
+    b = Autoscaler(AutoscalerPolicy())
+    acts = b.decide(0, pressures={"prefill": 0.5, "decode": 0.5},
+                    pool_sizes={"prefill": 2, "decode": 3},
+                    standbys=0, forced=5)
+    # forced demotions bypass hysteresis but respect min_pool=1:
+    # only 3 of the 5 requested fire
+    assert [(x.kind, x.cause) for x in acts] == \
+        [("scale_down", "forced")] * 3
+
+
+# -------------------------------------------------- handoff (unit)
+
+
+def _committed_engine(tiny_model):
+    """An engine holding one live request with a committed 128-token
+    page (prompt > one page, at least one output token)."""
+    model, params = tiny_model
+    eng = ServingEngine(model, params, _cfg())
+    prompt = [1 + (i % 40) for i in range(160)]
+    eng.add_request(prompt, SamplingParams(max_tokens=8, seed=5),
+                    request_id="h-0")
+    for _ in range(12):
+        eng.step()
+        live = list(eng.scheduler.running) + list(eng.scheduler.waiting)
+        cand = [r for r in live if r.request_id == "h-0"]
+        if cand and cand[0].output_tokens:
+            return eng, cand[0]
+    raise AssertionError("request never reached prompt commit")
+
+
+def test_handoff_blob_roundtrip_and_import(tiny_model):
+    model, params = tiny_model
+    eng, req = _committed_engine(tiny_model)
+    blob = export_handoff(eng, req, _request_to_dict(req, "running"))
+    assert blob is not None and is_handoff(blob)
+    rec = decode_handoff(blob)
+    assert rec.request["request_id"] == "h-0"
+    assert len(rec.tokens) == 128  # exactly the full committed page
+    info = inspect_handoff(blob)
+    assert info["valid"] and info["problems"] == []
+    assert {s["name"] for s in info["sections"]} == {"meta", "pools.0"}
+    assert all(s["crc_ok"] for s in info["sections"])
+
+    dest = ServingEngine(model, params, _cfg())
+    avoided = import_handoff(dest, blob, now=0)
+    assert avoided == 128
+
+
+def test_handoff_corruption_is_typed_and_inspectable(tiny_model):
+    eng, req = _committed_engine(tiny_model)
+    blob = export_handoff(eng, req, _request_to_dict(req, "running"))
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0xFF
+    bad = bytes(bad)
+    assert is_handoff(bad)  # manifest line intact: still sniffs
+    with pytest.raises(HandoffCorruptError, match="checksum"):
+        decode_handoff(bad)
+    info = inspect_handoff(bad)  # tolerant path for the CLI
+    assert not info["valid"] and info["problems"]
+    assert not all(s["crc_ok"] for s in info["sections"])
+
+
+# ------------------------------------------ fleet end-to-end parity
+
+
+def test_disagg_token_parity_and_pinned_handoff_economics(tiny_model):
+    """The tentpole contract: the disaggregated fleet is token-
+    identical to a fault-free single engine on the same seeded trace,
+    every stream hands off at prompt commit, pages ship (re-prefill
+    avoided > 0, counter-pinned), and nothing falls back."""
+    model, params = tiny_model
+    trace = _trace()
+    _, baseline = replay(ServingEngine(model, params, _cfg()), trace)
+
+    fe, summary, outputs = _run_fleet(model, params, trace)
+    assert outputs == baseline
+    assert summary["states"]["finished"] == len(trace)
+    assert summary["handoffs"] == len(trace)
+    assert summary["handoff_fallbacks"] == 0
+    assert summary["reprefill_avoided_tokens"] > 0
+    # end-of-run pool sizes reflect any drain-phase demotions; both
+    # roles must still be staffed (min_pool=1 is a controller law)
+    pools = summary["fleet"]["pools"]
+    assert set(pools) == {"prefill", "decode"}
+    assert all(n >= 1 for n in pools.values())
+    # the ledger balances against the ring on the clean run too
+    assert inv.actuation_ledger_violations(fe) == []
+
+
+def test_disagg_same_seed_byte_identical_summary(tiny_model):
+    model, params = tiny_model
+    trace = _trace(seed=5)
+    _, s1, _ = _run_fleet(model, params, trace)
+    _, s2, _ = _run_fleet(model, params, trace)
+    assert json.dumps(s1, sort_keys=True) == \
+        json.dumps(s2, sort_keys=True)
+
+
+def test_corrupt_handoff_falls_back_typed_with_parity(tiny_model):
+    """Poisoned handoff payloads: the decode side sees the CRC
+    mismatch as `HandoffCorruptError`, re-prefills from the record,
+    and the stream still finishes token-identical — corruption costs
+    ticks, never tokens."""
+    model, params = tiny_model
+    trace = _trace()
+    _, baseline = replay(ServingEngine(model, params, _cfg()), trace)
+
+    fe, summary, outputs = _run_fleet(model, params, trace, poison=3)
+    assert outputs == baseline
+    assert summary["handoff_fallbacks"] == 3
+    assert summary["states"]["finished"] == len(trace)
+    fallbacks = blackbox.events(kind="handoff_fallback")
+    assert len(fallbacks) == 3
+    assert inv.actuation_ledger_violations(fe) == []
+
+
+def test_disagg_ttft_tpot_separation_via_slo(tiny_model):
+    """The latency split the role pools exist for is observable: the
+    SLO observatory digests TTFT and TPOT independently over the
+    fleet run's rows."""
+    model, params = tiny_model
+    trace = _trace()
+    fe, summary, _ = _run_fleet(model, params, trace)
+    report = slo_mod.slo_report(fe.latency_rows(),
+                                horizon_tick=summary["ticks"])
+    fb = report["fleet"]
+    assert fb["ttft"]["count"] == len(trace)
+    assert fb["tpot"]["count"] > 0
+    names = {ob["objective"] for ob in fb["slo"]}
+    assert {"ttft_p99", "tpot_p99"} <= names
+
+
+def test_elastic_actuations_are_audited(tiny_model):
+    """A run long enough for the controller to actuate: every resize
+    appears in both the typed ledger and the blackbox ring (invariant
+    16's raw material), and consecutive opposite unforced actuations
+    per pool are >= one cooldown apart."""
+    model, params = tiny_model
+    trace = _trace(seed=9, n=16)
+    fe, summary, _ = _run_fleet(model, params, trace)
+    assert summary["fleet"]["actuations"] == len(fe.actuations)
+    assert summary["scale_ups"] + summary["scale_downs"] >= 1
+    ring = [e for e in blackbox.events()
+            if e["kind"] in ("scale_up", "scale_down")]
+    assert len(ring) == len(fe.actuations)
+    assert inv.actuation_ledger_violations(fe) == []
+
+
+# ---------------------------------------------------- chaos sweep
+
+
+def test_disagg_smoke_campaign():
+    """One fast storm plan (kills, poisoned handoffs, demotion
+    storms): zero invariant violations — the tier-1 pin that
+    invariants 14 and 16 hold under fire."""
+    report = run_disagg_campaign(0, num_plans=1, num_requests=8)
+    assert report.ok, [r.violations for r in report.reports]
+    assert report.total_injected > 0
+
+
+@pytest.mark.slow
+def test_disagg_storm_sweep():
+    """The broad seeded sweep across plans."""
+    report = run_disagg_campaign(1, num_plans=4, num_requests=10)
+    assert report.ok, [r.violations for r in report.reports]
+    assert report.total_injected >= 8

@@ -1477,3 +1477,21 @@ def test_cli_serve_sim_incident_layer_and_postmortem(tmp_path, capsys):
     finally:
         obs.reset()
         (obs.enable if was else obs.disable)()
+
+
+def test_blackbox_fleet_actuation_kinds_registered():
+    """ISSUE 19: the five disaggregation kinds are first-class members
+    of the closed BLACKBOX_EVENTS enum (ATP507 lints the literal call
+    sites; this pins the runtime registry)."""
+    from attention_tpu.obs import blackbox
+    from attention_tpu.obs.naming import BLACKBOX_EVENTS
+
+    kinds = ("scale_up", "scale_down", "handoff", "handoff_fallback",
+             "actuation_veto")
+    assert set(kinds) <= set(BLACKBOX_EVENTS)
+    with blackbox.capture():
+        for i, kind in enumerate(kinds):
+            blackbox.note(kind, tick=i, pool="decode", cause="slack")
+        assert [e["kind"] for e in blackbox.events()] == list(kinds)
+        assert all(e["pool"] == "decode" for e in blackbox.events())
+    blackbox.clear()

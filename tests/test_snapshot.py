@@ -651,3 +651,34 @@ def test_cli_snapshot_flags_must_pair(tmp_path, capsys):
     ])
     assert rc == 2
     capsys.readouterr()
+
+
+def test_pre_fleet_snapshot_format_unchanged_and_pages_reported(
+        tiny_model, tmp_path):
+    """ISSUE 19 regression pin: the disaggregation layer ships KV in
+    its own handoff blobs, so the engine snapshot format is untouched
+    — a snapshot written today carries exactly the pre-fleet section
+    set (no `pages` payload section), restores to an identical state
+    fingerprint, and `inspect` reports the per-request committed-page
+    count the CLI now surfaces."""
+    model, params = tiny_model
+    eng = ServingEngine(model, params, _cfg())
+    _admit_all(eng, synthetic_trace(3, vocab=model.vocab, seed=7,
+                                    max_tokens=6))
+    for _ in range(4):
+        eng.step()
+    path = str(tmp_path / "pre_fleet.atpsnap")
+    save(eng, path)
+    info = inspect(path)
+    assert info["valid"]
+    assert {s["name"] for s in info["sections"]} == \
+        {"meta", "state", "requests", "pools"}
+    assert all(isinstance(r["pages"], int) for r in info["requests"])
+    assert any(r["pages"] > 0 for r in info["requests"])
+    eng2 = restore(path, model, params)
+    assert state_fingerprint(eng2) == state_fingerprint(eng)
+    # and the CLI's inspect dispatch keeps reading it as a snapshot,
+    # never as a fleet handoff blob
+    from attention_tpu.fleet.handoff import is_handoff
+
+    assert not is_handoff(open(path, "rb").read())
