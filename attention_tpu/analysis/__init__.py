@@ -17,9 +17,16 @@ passes that flag, before anything traces or compiles,
 - determinism hazards across call edges — wall-clock into artifacts,
   unseeded randomness, unordered iteration/accumulation (ATP8xx,
   `determinism`, on the `callgraph` + `dataflow` interprocedural
-  core).
+  core),
+- provable inconsistencies in the symbolic shape/sharding domain —
+  dot/concat/where operand shapes, Pallas grids and block shapes
+  bound to variables, PartitionSpec geometry, shard divisibility,
+  cross-shard reductions without a collective (ATP9xx, `shapes` +
+  `sharding` + the `pallas` upgrade, on the same interprocedural
+  core; divisibility facts certify, nothing is guessed).
 
-Entry points: ``cli analyze`` (text/JSON/SARIF, ``--changed``),
+Entry points: ``cli analyze`` (text/JSON/SARIF/GitHub annotations,
+``--changed``),
 ``scripts/check_all.py`` (the tier-1 gate), and `core.analyze` as a
 library.  Inline suppression: ``# atp: disable=ATP###``.  Accepted
 legacy findings: ``analysis/baseline.json`` (every entry justified).
@@ -47,11 +54,14 @@ from attention_tpu.analysis import (  # noqa: F401  (pass registration)
     pallas,
     precision,
     purity,
+    shapes,
+    sharding,
 )
 from attention_tpu.analysis.report import (  # noqa: F401
     apply_baseline,
     default_baseline_path,
     load_baseline,
+    render_github,
     render_json,
     render_sarif,
     render_text,

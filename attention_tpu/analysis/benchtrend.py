@@ -15,6 +15,12 @@ serial time — see r02 -> r03, a 22.5% value drop with the kernel
 getting *faster*).  Kernel ms is the only monotone-comparable number
 in the trajectory.
 
+Rounds from :data:`PROVENANCE_FROM_ROUND` on must also record *how*
+the headline was measured — ``parsed.detail.max_mode`` (the rescaling
+math the kernel ran with) and ``parsed.detail.mesh_shards`` (the mesh
+layout) — so a future number is only ever compared against one with
+the same provenance.  A round missing them is refused outright.
+
 ``scripts/bench_trend.py`` is the human-facing shell over the same
 functions: prints the per-round trend (ms + MXU), exits nonzero on the
 same problems.  `cli analyze` / ``scripts/check_all.py`` run the pass
@@ -37,10 +43,20 @@ from attention_tpu.analysis.core import (
 ATP506 = register_code(
     "ATP506", "bench-trend-regression", Severity.ERROR,
     "committed BENCH_r*.json headline kernel time regressed >10% "
-    "between consecutive rounds (or a round is unparsable)")
+    "between consecutive rounds (or a round is unparsable / missing "
+    "its provenance fields)")
 
 #: allowed headline regression between consecutive rounds, percent
 REGRESSION_PCT = 10.0
+
+#: provenance fields every round from :data:`PROVENANCE_FROM_ROUND`
+#: on must carry in ``parsed.detail`` — a headline number whose
+#: measurement mode and mesh layout aren't recorded can't be compared
+#: to the next round's.  Earlier rounds are grandfathered (r01/r02
+#: predate ``max_mode``; no committed round predates r11 with
+#: ``mesh_shards``).
+PROVENANCE_FIELDS = ("max_mode", "mesh_shards")
+PROVENANCE_FROM_ROUND = 11
 
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
@@ -74,6 +90,11 @@ def trend_rows(root: str) -> list[dict]:
             row["kernel_ms"] = float(detail["tpu_kernel_ms"])
             row["mxu"] = float(detail.get("mxu_utilization_of_peak", 0.0))
             row["value"] = float(parsed.get("value", 0.0))
+            if rnd >= PROVENANCE_FROM_ROUND:
+                missing = [k for k in PROVENANCE_FIELDS
+                           if k not in detail]
+                if missing:
+                    row["provenance_missing"] = missing
         except (OSError, ValueError, KeyError, TypeError) as e:
             row["error"] = f"{type(e).__name__}: {e}"
         rows.append(row)
@@ -90,6 +111,12 @@ def trend_problems(root: str) -> list[str]:
             problems.append(f"{row['file']}: unparsable headline "
                             f"({row['error']})")
             continue
+        if row.get("provenance_missing"):
+            problems.append(
+                f"{row['file']}: missing provenance field(s) "
+                f"{', '.join(row['provenance_missing'])} — rounds "
+                f">= r{PROVENANCE_FROM_ROUND} must record the "
+                "measurement mode and mesh layout in parsed.detail")
         if prev is not None and prev["kernel_ms"] > 0:
             pct = 100.0 * (row["kernel_ms"] - prev["kernel_ms"]) \
                 / prev["kernel_ms"]

@@ -405,11 +405,10 @@ class ProjectIndex:
         for qual, info in list(self.functions.items()):
             if info.path != mod.path:
                 continue
-            aliases, shadowed = _local_env(info.node)
+            assigns, calls = _scan_fn(info.node)
+            aliases, shadowed = _local_env_from(info.node, assigns)
             sites = []
-            for node in ast.walk(info.node):
-                if not isinstance(node, ast.Call):
-                    continue
+            for node in calls:
                 callee, name = self.resolve_call(
                     mod.path, info.cls, node, aliases, shadowed)
                 site = CallSite(qual, callee, name, node.lineno,
@@ -462,19 +461,55 @@ class ProjectIndex:
         return out
 
 
+def _scan_fn(fn: ast.AST) -> tuple[list, list]:
+    """(Assign nodes, Call nodes) under ``fn`` in ONE ``ast.walk``-order
+    traversal — the index build used to walk every function subtree
+    twice (local aliases, then call sites); merged here it is the
+    single biggest term in the tree-wide index time."""
+    AST = ast.AST
+    assigns: list[ast.Assign] = []
+    calls: list[ast.Call] = []
+    todo: list[ast.AST] = [fn]
+    i = 0
+    while i < len(todo):
+        node = todo[i]
+        i += 1
+        d = node.__dict__
+        for field in node._fields:
+            value = d.get(field)
+            if isinstance(value, AST):
+                todo.append(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, AST):
+                        todo.append(v)
+        if isinstance(node, ast.Call):
+            calls.append(node)
+        elif isinstance(node, ast.Assign):
+            assigns.append(node)
+    return assigns, calls
+
+
 def _local_env(fn: ast.FunctionDef | ast.AsyncFunctionDef,
                ) -> tuple[dict[str, str], set[str]]:
     """(local aliases ``g -> f.dotted``, names shadowed by params or
     non-alias assignment — those must NOT fall through to the module
     table)."""
+    return _local_env_from(fn, _scan_fn(fn)[0])
+
+
+def _local_env_from(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                    assigns: list,
+                    ) -> tuple[dict[str, str], set[str]]:
+    """`_local_env` over pre-collected Assign nodes (in walk order)."""
     a = fn.args
     shadowed = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
     for p in (a.vararg, a.kwarg):
         if p:
             shadowed.add(p.arg)
     aliases: dict[str, str] = {}
-    for node in ast.walk(fn):
-        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+    for node in assigns:
+        if not (len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)):
             continue
         tgt = node.targets[0].id

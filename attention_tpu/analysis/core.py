@@ -177,7 +177,7 @@ def dotted_name(node: ast.expr) -> str | None:
 #: its id cannot be recycled under us; capped so a long-lived process
 #: feeding synthetic trees (tests) cannot grow it without bound.
 _WALK_CACHE: dict[int, tuple[ast.AST, list[ast.AST]]] = {}
-_WALK_CACHE_MAX = 1024
+_WALK_CACHE_MAX = 4096
 
 
 def walk_list(tree: ast.AST) -> list[ast.AST]:
@@ -192,7 +192,23 @@ def walk_list(tree: ast.AST) -> list[ast.AST]:
     hit = _WALK_CACHE.get(id(tree))
     if hit is not None and hit[0] is tree:
         return hit[1]
-    nodes = list(ast.walk(tree))
+    # inlined ast.walk (same BFS order): the generator-over-generator
+    # cost of iter_child_nodes dominated the tree-wide flatten
+    AST = ast.AST
+    nodes: list[ast.AST] = [tree]
+    i = 0
+    while i < len(nodes):
+        node = nodes[i]
+        i += 1
+        d = node.__dict__
+        for field in node._fields:
+            value = d.get(field)
+            if isinstance(value, AST):
+                nodes.append(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, AST):
+                        nodes.append(v)
     if len(_WALK_CACHE) >= _WALK_CACHE_MAX:
         _WALK_CACHE.clear()
     _WALK_CACHE[id(tree)] = (tree, nodes)
@@ -209,6 +225,54 @@ def iter_scope(node: ast.AST) -> Iterator[ast.AST]:
         if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
                                   ast.Lambda, ast.ClassDef)):
             stack.extend(ast.iter_child_nodes(child))
+
+
+#: id(scope node) -> (node, flattened iter_scope) — same contract as
+#: ``_WALK_CACHE`` above, but its own (larger) cap: the tree has a few
+#: thousand distinct scopes and precision + the shape interpreter
+#: flatten every one, so a shared 1024 cap thrashed
+_SCOPE_CACHE: dict[int, tuple[ast.AST, list[ast.AST]]] = {}
+_SCOPE_CACHE_MAX = 8192
+
+
+def scope_list(node: ast.AST) -> list[ast.AST]:
+    """``list(iter_scope(node))`` memoized by node identity.  Callers
+    must not mutate the returned list."""
+    hit = _SCOPE_CACHE.get(id(node))
+    if hit is not None and hit[0] is node:
+        return hit[1]
+    # inlined iter_scope (identical stack-pop order), generator-free
+    AST = ast.AST
+    scope_kinds = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+    stack: list[ast.AST] = []
+    d = node.__dict__
+    for field in node._fields:
+        value = d.get(field)
+        if isinstance(value, AST):
+            stack.append(value)
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, AST):
+                    stack.append(v)
+    nodes: list[ast.AST] = []
+    while stack:
+        child = stack.pop()
+        nodes.append(child)
+        if not isinstance(child, scope_kinds):
+            d = child.__dict__
+            for field in child._fields:
+                value = d.get(field)
+                if isinstance(value, AST):
+                    stack.append(value)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, AST):
+                            stack.append(v)
+    if len(_SCOPE_CACHE) >= _SCOPE_CACHE_MAX:
+        _SCOPE_CACHE.clear()
+    _SCOPE_CACHE[id(node)] = (node, nodes)
+    return nodes
 
 
 # -- suppression ----------------------------------------------------------

@@ -130,6 +130,7 @@ class TaintAnalysis:
         self._module_sites: dict[str, dict[int, CallSite]] = {}
         self._class_attr_memo: dict[str, dict[str, str]] = {}
         self._returns_memo: dict[tuple[str, int], str | None] = {}
+        self._fn_env_memo: dict[tuple, dict[str, str]] = {}
         self._param_sink_memo: dict[tuple[str, int, int], str | None] = {}
         self._param_ret_memo: dict[tuple[str, int, int], bool] = {}
         self._in_progress: set[tuple] = set()
@@ -201,6 +202,11 @@ class TaintAnalysis:
                      seed: dict[str, str] | None = None,
                      depth: int | None = None) -> dict[str, str]:
         depth = self.max_depth if depth is None else depth
+        key = (id(info.node), depth,
+               tuple(sorted(seed.items())) if seed else None)
+        hit = self._fn_env_memo.get(key)
+        if hit is not None:
+            return dict(hit)  # callers may mutate their copy
         env = dict(self.module_env(info.path))
         if info.cls:
             env.update(self.class_attrs(info.cls))
@@ -208,6 +214,7 @@ class TaintAnalysis:
             env.update(seed)
         for _ in range(2):
             self._env_pass(info.node, env, info.path, info.cls, depth)
+        self._fn_env_memo[key] = dict(env)
         return env
 
     def _env_pass(self, scope: ast.AST, env: dict[str, str], path: str,

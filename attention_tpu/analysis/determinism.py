@@ -45,6 +45,7 @@ from attention_tpu.analysis.core import (
     Severity,
     project_pass,
     register_code,
+    walk_list,
 )
 from attention_tpu.analysis.dataflow import (
     TaintAnalysis,
@@ -141,11 +142,26 @@ def _candidates(index: ProjectIndex, max_depth: int, source_fn,
                 base.add(qual)
                 break
     if setcomps:
-        for info in index.functions.values():
-            if info.qual not in base and any(
-                    isinstance(n, ast.SetComp)
-                    for n in ast.walk(info.node)):
-                base.add(info.qual)
+        # one cached module flatten instead of an ast.walk per function;
+        # line-span containment attributes each comprehension (function
+        # source regions are disjoint, so spans are exact)
+        comp_lines: dict[str, list[int]] = {}
+        for path, mod in index.modules.items():
+            lines = [n.lineno for n in walk_list(mod.tree)
+                     if isinstance(n, ast.SetComp)]
+            if lines:
+                comp_lines[path] = lines
+        if comp_lines:
+            for info in index.functions.values():
+                lines = comp_lines.get(info.path)
+                if lines is None or info.qual in base:
+                    continue
+                start = info.node.lineno
+                for dec in info.node.decorator_list:
+                    start = min(start, dec.lineno)
+                end = info.node.end_lineno or start
+                if any(start <= ln <= end for ln in lines):
+                    base.add(info.qual)
     mod_paths: set[str] = set()
     for path, mod in index.modules.items():
         for node in ordered_stmts(index, mod.tree):

@@ -4,10 +4,19 @@
 each ``BlockSpec``'s block shape, its ``index_map`` arity and return
 arity, and the ``out_shape`` dtype all have to agree — but Pallas
 reports violations at trace/lowering time with errors that point
-nowhere near the offending spec.  The decidable subset is checked here
-lexically, with literal-only matching: any component that is a
-variable (computed grids, shared block-size names) is skipped rather
-than guessed at.
+nowhere near the offending spec.  Two evidence tiers are checked here:
+
+- **Literal** (ATP201-204): every component is spelled as a literal at
+  the call site.  Anything else is skipped rather than guessed at.
+- **Symbolic** (ATP902): components bound to *variables* are resolved
+  through the ``shapes.ShapeInterp`` scope environment — constant
+  propagation through assignments, tuples, and NamedTuple fields
+  (``BlockSizes().block_q``).  A finding still requires a provable
+  violation: a dim that resolves to a concrete int breaking the rule.
+  Dims that stay symbolic are checked against the harvested
+  divisibility facts (``assert block_q % 128 == 0`` certifies) and
+  stay silent either way — facts certify, absence of a fact is not
+  evidence.
 
 Checked (all on one ``pallas_call`` call site):
 
@@ -20,7 +29,12 @@ Checked (all on one ``pallas_call`` call site):
 - ATP204 — literal block shapes that break TPU tiling: last dim not a
   multiple of 128 (lane), or second-minor not a multiple of 8
   (sublane) — the assumption every kernel in this tree states in its
-  docstring, now enforced where it is spelled out as numbers.
+  docstring, now enforced where it is spelled out as numbers;
+- ATP902 — the same grid-rank / block-rank / tiling contracts, proved
+  through the symbolic domain when the call site uses variables.
+
+A block shape that breaks both tiling rules on one spec reports once,
+as the strictest (lane, %128) finding — one spec, one tile diagnosis.
 """
 
 from __future__ import annotations
@@ -34,6 +48,11 @@ from attention_tpu.analysis.core import (
     file_pass,
     register_code,
     walk_list,
+)
+from attention_tpu.analysis.shapes import (
+    _scope_nodes,
+    con,
+    interp_for,
 )
 
 ATP201 = register_code(
@@ -52,6 +71,11 @@ ATP204 = register_code(
     "ATP204", "tile-misalignment", Severity.WARNING,
     "literal block shape breaks TPU tiling (last dim % 128, "
     "second-minor % 8)")
+ATP902 = register_code(
+    "ATP902", "symbolic-block-grid-mismatch", Severity.WARNING,
+    "pallas_call grid/BlockSpec geometry resolved through the symbolic "
+    "shape domain provably breaks a contract (grid rank, block rank, "
+    "or TPU tiling)")
 
 _PALLAS_CALL = ("pallas_call", "pl.pallas_call", "pallas.pallas_call")
 _DTYPE_NAMES = {
@@ -67,15 +91,40 @@ def _literal_tuple(node: ast.expr) -> list[ast.expr] | None:
     return None
 
 
-def _grid_rank(call: ast.Call) -> int | None:
+def _grid_node(call: ast.Call) -> ast.expr | None:
     for kw in call.keywords:
         if kw.arg == "grid":
-            elts = _literal_tuple(kw.value)
-            if elts is not None:
-                return len(elts)
-            if isinstance(kw.value, ast.Constant) and isinstance(
-                    kw.value.value, int):
-                return 1
+            return kw.value
+    return None
+
+
+def _grid_rank(call: ast.Call) -> int | None:
+    node = _grid_node(call)
+    if node is None:
+        return None
+    elts = _literal_tuple(node)
+    if elts is not None:
+        return len(elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return 1
+    return None
+
+
+def _sym_grid_rank(call: ast.Call, interp, env) -> int | None:
+    """Grid rank through the scope env, for non-literal grids only."""
+    node = _grid_node(call)
+    if node is None or _literal_tuple(node) is not None \
+            or isinstance(node, ast.Constant):
+        return None
+    line = call.lineno
+    tup = interp._tuple_of(node, env, line)
+    if tup is not None:
+        return len(tup)
+    # a bare name of unknown kind could be a tuple — only a provably
+    # concrete scalar (e.g. ``g = 4``) counts as a rank-1 grid
+    d = interp._dim_of(node, env, line, 0)
+    if d is not None and d.concrete:
+        return 1
     return None
 
 
@@ -116,6 +165,15 @@ def _spec_parts(spec: ast.Call):
         if kw.arg == "block_shape":
             shape = _literal_tuple(kw.value)
     return shape, index_map
+
+
+def _spec_shape_node(spec: ast.Call) -> ast.expr | None:
+    """The block-shape expression itself (literal or not)."""
+    node = spec.args[0] if spec.args else None
+    for kw in spec.keywords:
+        if kw.arg == "block_shape":
+            node = kw.value
+    return node
 
 
 def _lambda_return_arity(lam: ast.Lambda) -> int | None:
@@ -210,52 +268,136 @@ def _check_store_dtypes(call: ast.Call, tree: ast.Module, path: str,
                         path, node.lineno, node.col_offset))
 
 
-@file_pass("pallas", [ATP201, ATP202, ATP203, ATP204])
-def check_pallas(path: str, tree: ast.Module, src: str):
+def _pallas_call_scopes(interp) -> dict[int, ast.AST]:
+    """id(pallas_call node) -> the lexical scope it executes in."""
+    out: dict[int, ast.AST] = {}
+    for scope in interp.scopes():
+        for n in _scope_nodes(scope):
+            if isinstance(n, ast.Call) \
+                    and dotted_name(n.func) in _PALLAS_CALL:
+                out[id(n)] = scope
+    return out
+
+
+def _spec_dims(spec: ast.Call, shape, interp, env, line):
+    """Per-position ``(Dim | None, is_literal)`` for a block shape,
+    with non-literal entries resolved through the scope env.  Returns
+    None when even the rank is undecidable."""
+    if shape is not None:
+        dims = []
+        for e in shape:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                dims.append((con(e.value), True))
+            elif env is not None:
+                dims.append((interp._dim_of(e, env, line, 0), False))
+            else:
+                dims.append((None, False))
+        return dims
+    if env is None:
+        return None
+    node = _spec_shape_node(spec)
+    if node is None:
+        return None
+    tup = interp._tuple_of(node, env, line)
+    if tup is None:
+        return None
+    return [(d, False) for d in tup]
+
+
+def _check_tiles(dims, which, spec, env, path,
+                 findings: list[Finding]) -> None:
+    """TPU tiling on resolved block dims, deduped to the strictest.
+
+    Literal dims report ATP204, env-resolved concrete dims ATP902; a
+    dim that stays symbolic is checked against the divisibility facts
+    (a ``% 128 == 0`` fact certifies it) and never fires either way.
+    When both the lane and the sublane rule break on one spec, only
+    the lane (%128) finding — the stricter contract — is reported.
+    """
+    lane: Finding | None = None
+    sub: Finding | None = None
+    d, lit = dims[-1]
+    if d is not None and d.concrete and d.coeff > 0 \
+            and d.coeff % 128 != 0:
+        lane = Finding(
+            ATP204 if lit else ATP902,
+            f"{which} block shape last dim "
+            f"{'is' if lit else 'resolves to'} {d.coeff}, not a "
+            "multiple of 128 (TPU lane tiling)",
+            path, spec.lineno, spec.col_offset)
+    if len(dims) > 1:
+        d, lit = dims[-2]
+        if d is not None and d.concrete and d.coeff > 0 \
+                and d.coeff % 8 != 0 and d.coeff != 1:
+            sub = Finding(
+                ATP204 if lit else ATP902,
+                f"{which} block shape second-minor dim "
+                f"{'is' if lit else 'resolves to'} {d.coeff}, not a "
+                "multiple of 8 (TPU sublane tiling)",
+                path, spec.lineno, spec.col_offset)
+    if lane is not None:
+        findings.append(lane)
+    elif sub is not None:
+        findings.append(sub)
+
+
+def _check_spec(spec: ast.Call, which: str, call: ast.Call,
+                grid_rank, sym_grid, interp, env, path: str,
+                findings: list[Finding]) -> None:
+    line = call.lineno
+    shape, index_map = _spec_parts(spec)
+    if index_map is not None:
+        arity = len(index_map.args.args)
+        if grid_rank is not None:
+            if arity != grid_rank:
+                findings.append(Finding(
+                    ATP201,
+                    f"{which} index_map takes {arity} argument(s) "
+                    f"but the grid has {grid_rank} dimension(s)",
+                    path, spec.lineno, spec.col_offset))
+        elif sym_grid is not None and arity != sym_grid:
+            findings.append(Finding(
+                ATP902,
+                f"{which} index_map takes {arity} argument(s) but "
+                f"the grid resolves to {sym_grid} dimension(s)",
+                path, spec.lineno, spec.col_offset))
+    dims = _spec_dims(spec, shape, interp, env, line)
+    if index_map is not None and dims is not None:
+        ret = _lambda_return_arity(index_map)
+        if ret is not None and ret != len(dims):
+            findings.append(Finding(
+                ATP202 if shape is not None else ATP902,
+                f"{which} block shape "
+                f"{'has' if shape is not None else 'resolves to'} "
+                f"{len(dims)} dimension(s) but index_map returns "
+                f"{ret} coordinate(s)",
+                path, spec.lineno, spec.col_offset))
+    if dims:
+        _check_tiles(dims, which, spec, env, path, findings)
+
+
+@file_pass("pallas", [ATP201, ATP202, ATP203, ATP204, ATP902],
+           needs_index=True)
+def check_pallas(path: str, tree: ast.Module, src: str, index=None):
     """BlockSpec/grid/out_shape self-consistency at pallas_call sites."""
     findings: list[Finding] = []
+    interp = None
+    call_scopes: dict[int, ast.AST] = {}
     for call in walk_list(tree):
         if not isinstance(call, ast.Call):
             continue
         if dotted_name(call.func) not in _PALLAS_CALL:
             continue
+        if interp is None:
+            interp = interp_for(path, tree, index)
+            call_scopes = _pallas_call_scopes(interp)
+        scope = call_scopes.get(id(call))
+        env = interp.env(scope) if scope is not None else None
         grid_rank = _grid_rank(call)
+        sym_grid = (_sym_grid_rank(call, interp, env)
+                    if env is not None and grid_rank is None else None)
         for spec, which in _block_specs(call):
-            shape, index_map = _spec_parts(spec)
-            if index_map is not None and grid_rank is not None:
-                arity = len(index_map.args.args)
-                if arity != grid_rank:
-                    findings.append(Finding(
-                        ATP201,
-                        f"{which} index_map takes {arity} argument(s) "
-                        f"but the grid has {grid_rank} dimension(s)",
-                        path, spec.lineno, spec.col_offset))
-            if index_map is not None and shape is not None:
-                ret = _lambda_return_arity(index_map)
-                if ret is not None and ret != len(shape):
-                    findings.append(Finding(
-                        ATP202,
-                        f"{which} block shape has {len(shape)} "
-                        f"dimension(s) but index_map returns {ret} "
-                        "coordinate(s)",
-                        path, spec.lineno, spec.col_offset))
-            if shape is not None and len(shape) >= 1:
-                dims = [e.value if isinstance(e, ast.Constant)
-                        and isinstance(e.value, int) else None
-                        for e in shape]
-                last, sub = dims[-1], (dims[-2] if len(dims) > 1 else None)
-                if last is not None and last % 128 != 0:
-                    findings.append(Finding(
-                        ATP204,
-                        f"{which} block shape last dim {last} is not a "
-                        "multiple of 128 (TPU lane tiling)",
-                        path, spec.lineno, spec.col_offset))
-                if sub is not None and len(dims) > 1 and sub % 8 != 0 \
-                        and sub != 1:
-                    findings.append(Finding(
-                        ATP204,
-                        f"{which} block shape second-minor dim {sub} "
-                        "is not a multiple of 8 (TPU sublane tiling)",
-                        path, spec.lineno, spec.col_offset))
+            _check_spec(spec, which, call, grid_rank, sym_grid,
+                        interp, env, path, findings)
         _check_store_dtypes(call, tree, path, findings)
     return findings

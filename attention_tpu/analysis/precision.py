@@ -36,8 +36,8 @@ from attention_tpu.analysis.core import (
     Severity,
     dotted_name,
     file_pass,
-    iter_scope,
     register_code,
+    scope_list,
 )
 
 ATP301 = register_code(
@@ -98,11 +98,18 @@ def _is_lowprec(node: ast.expr, env: dict[str, bool]) -> bool:
     return False
 
 
+def _scope_nodes(fn) -> list:
+    """The scope's flattened node list, cached (one flatten feeds the
+    env build, the check walk, and the nested-scope recursion)."""
+    if isinstance(fn, ast.Module):
+        return _module_scope_list(fn)
+    return scope_list(fn)
+
+
 def _scope_env(fn, inherited: dict[str, bool]) -> dict[str, bool]:
     """Name -> is-low-precision, from assignments in ``fn``'s scope."""
     env = dict(inherited)
-    nodes = (iter_scope(fn) if not isinstance(fn, ast.Module)
-             else _module_scope(fn))
+    nodes = _scope_nodes(fn)
     for node in nodes:
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             tgt = node.targets[0]
@@ -124,8 +131,7 @@ def _has_kw(call: ast.Call, name: str) -> bool:
 def _check_scope(fn, inherited: dict[str, bool], path: str,
                  findings: list[Finding]) -> None:
     env = _scope_env(fn, inherited)
-    walk = (iter_scope(fn) if not isinstance(fn, ast.Module)
-            else _module_scope(fn))
+    walk = _scope_nodes(fn)
     for node in walk:
         if isinstance(node, ast.Call):
             d = dotted_name(node.func) or ""
@@ -157,11 +163,25 @@ def _check_scope(fn, inherited: dict[str, bool], path: str,
                     "@ (matmul) on low-precision operand(s) — use "
                     "dot_general with preferred_element_type=float32",
                     path, node.lineno, node.col_offset))
-    children = (iter_scope(fn) if not isinstance(fn, ast.Module)
-                else _module_scope(fn))
-    for node in children:
+    for node in walk:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             _check_scope(node, env, path, findings)
+
+
+#: id(module tree) -> (tree, flattened module scope) — the module-level
+#: statement list is re-read once per function during the check pass
+_MODULE_SCOPE_CACHE: dict[int, tuple] = {}
+
+
+def _module_scope_list(tree: ast.Module) -> list:
+    hit = _MODULE_SCOPE_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    nodes = list(_module_scope(tree))
+    if len(_MODULE_SCOPE_CACHE) >= 1024:
+        _MODULE_SCOPE_CACHE.clear()
+    _MODULE_SCOPE_CACHE[id(tree)] = (tree, nodes)
+    return nodes
 
 
 def _module_scope(tree: ast.Module):
@@ -199,7 +219,7 @@ def _helper_dot_hit(index, qual: str, lp_pos: tuple[int, ...],
         return None
     env = _scope_env(helper.node, seed)
     hit = None
-    for node in iter_scope(helper.node):
+    for node in scope_list(helper.node):
         if isinstance(node, ast.Call):
             d = dotted_name(node.func) or ""
             leaf = d.split(".")[-1]
@@ -229,7 +249,8 @@ def _check_traced_helpers(fn, env: dict[str, bool], path: str, index,
     """One call-graph level out of a traced body: low-precision args
     flowing into an in-tree helper that dots them."""
     env = _scope_env(fn, env)
-    for node in iter_scope(fn):
+    nodes = _scope_nodes(fn)
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
         d = dotted_name(node.func) or ""
@@ -256,7 +277,7 @@ def _check_traced_helpers(fn, env: dict[str, bool], path: str, index,
             f"low-precision operand flows into helper "
             f"{helper.name!r} ({helper.path}:{hline}) which {what}",
             path, node.lineno, node.col_offset))
-    for node in iter_scope(fn):
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             _check_traced_helpers(node, env, path, index, memo, findings)
 

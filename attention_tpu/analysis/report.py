@@ -149,6 +149,42 @@ def render_json(findings: list[Finding],
     }, sort_keys=True) + "\n"
 
 
+def _gh_escape_data(s: str) -> str:
+    """Escape workflow-command message data (GitHub's own rules)."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _gh_escape_prop(s: str) -> str:
+    """Escape a workflow-command property value (adds , and :)."""
+    return _gh_escape_data(s).replace(",", "%2C").replace(":", "%3A")
+
+
+def render_github(findings: list[Finding],
+                  baseline_problems: list[str] = ()) -> str:
+    """GitHub Actions workflow commands, one per finding.
+
+    ``::error file=...,line=...,col=...,title=ATP###::message`` lines
+    annotate the diff inline when the gate runs inside a workflow —
+    same findings as the text renderer, no separate CI glue needed.
+    Column is 1-based (the UI convention); whole-file findings
+    (``line == 0``) carry only ``file=``.
+    """
+    lines = []
+    for f in findings:
+        kind = "error" if f.severity is Severity.ERROR else "warning"
+        props = [f"file={_gh_escape_prop(f.path)}"]
+        if f.line:
+            props.append(f"line={f.line}")
+            props.append(f"col={f.col + 1}")
+        props.append(f"title={_gh_escape_prop(f.code)}")
+        lines.append(f"::{kind} " + ",".join(props)
+                     + f"::{_gh_escape_data(f.message)}")
+    for p in baseline_problems:
+        lines.append(f"::error file={BASELINE_REL},title=baseline"
+                     f"::{_gh_escape_data(p)}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 def render_sarif(findings: list[Finding],
                  baseline_problems: list[str] = ()) -> str:
     """Minimal SARIF 2.1.0 — one run, one rule per registered code."""

@@ -1,0 +1,1401 @@
+"""Symbolic shape lattice + abstract interpreter (ATP901).
+
+The Pallas passes (ATP201-204) and the runtime ``MeshConfigError``
+guards bracket the shape story from two ends: literals are linted,
+everything else is caught when a kernel traces on real hardware.  This
+module fills the middle — a small abstract domain over array shapes
+that is *sound for firing*: a finding is emitted only when a violation
+is provable from the source (concrete values disagree after constant
+propagation), and anything uncertain stays silent.  The lattice:
+
+- **Dim** — ``coeff * prod(sym_i ** p_i)``: a concrete int when it has
+  no symbols, else an opaque-but-fixed product.  Symbols are minted
+  deterministically from parameter names and ``x.shape[i]`` reads, so
+  the same quantity read twice unifies, and two *different* quantities
+  can never be forced equal (collisions only ever silence, never fire).
+- **Shape** — a tuple of Dims, or ``None`` (unknown rank).
+- **Facts** — divisibility pairs ``a % b == 0`` harvested from
+  ``assert x % y == 0`` statements, ``if x % y: raise`` guards (incl.
+  ``or``-chained clauses, the ``ops/flash.py`` idiom), and NamedTuple
+  field defaults (``BlockSizes().block_q`` is 256 by constant
+  propagation through the constructor).  Facts only ever *certify* —
+  they silence a divisibility demand, they never fire one.
+
+Interpretation is per lexical scope (module, function, nested
+function), in source order, with bindings recorded per line so a use
+site sees exactly the bindings that dominate it: re-bindings inside
+conditionals or loops poison the name (become unknown) instead of
+guessing which branch ran.  Scope environments are memoized per scope
+node and shared with the Pallas (ATP902) and sharding (ATP903-906)
+passes; in-tree calls are summarized per ``(callee, arg shapes)`` with
+a depth cap, mirroring ``dataflow.py``.
+
+ATP901 fires on dot/concat/where operand shapes that are provably
+inconsistent under the fact base — both sides concrete and unequal
+(and, for broadcasts, neither side 1).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    register_code,
+    scope_list,
+    walk_list,
+)
+
+ATP901 = register_code(
+    "ATP901", "provable-shape-mismatch", Severity.ERROR,
+    "dot/concat/where operand shapes are provably inconsistent under "
+    "the symbolic fact base (concrete dims disagree)")
+
+#: interprocedural summary depth cap (call edges followed per query)
+_SUMMARY_DEPTH = 2
+
+#: import roots treated as array-library modules when the name has no
+#: local value binding (``jnp.reshape(x, s)`` vs ``x.reshape(s)``)
+_MODULE_ROOTS = {"jnp", "np", "numpy", "lax", "jax", "math"}
+
+
+# -- the Dim lattice -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Dim:
+    """``coeff * prod(sym**pow)``; concrete iff ``syms`` is empty."""
+
+    coeff: int = 1
+    syms: tuple[tuple[str, int], ...] = ()
+
+    @property
+    def concrete(self) -> bool:
+        return not self.syms
+
+    def __repr__(self) -> str:
+        if self.concrete:
+            return str(self.coeff)
+        body = "*".join(s.rsplit(":", 1)[-1] if p == 1
+                        else f"{s.rsplit(':', 1)[-1]}^{p}"
+                        for s, p in self.syms)
+        return body if self.coeff == 1 else f"{self.coeff}*{body}"
+
+
+def con(n: int) -> Dim:
+    return Dim(n, ())
+
+
+def sym(name: str) -> Dim:
+    return Dim(1, ((name, 1),))
+
+
+def dim_mul(a: Dim, b: Dim) -> Dim:
+    pows: dict[str, int] = {}
+    for s, p in a.syms + b.syms:
+        pows[s] = pows.get(s, 0) + p
+    return Dim(a.coeff * b.coeff,
+               tuple(sorted((s, p) for s, p in pows.items() if p)))
+
+
+def dim_div(a: Dim, b: Dim) -> Dim | None:
+    """Exact quotient ``a / b`` when structurally provable, else None."""
+    if b.coeff == 0:
+        return None
+    pows = dict(a.syms)
+    for s, p in b.syms:
+        if pows.get(s, 0) < p:
+            return None
+        pows[s] -= p
+    if a.coeff % b.coeff:
+        return None
+    return Dim(a.coeff // b.coeff,
+               tuple(sorted((s, p) for s, p in pows.items() if p)))
+
+
+class Facts:
+    """A bag of proven divisibility pairs ``a % b == 0``.
+
+    Facts only certify: :meth:`divisible` answers "provably divisible"
+    — its False means *unknown*, never "provably not divisible".
+    """
+
+    def __init__(self, parent: "Facts | None" = None):
+        self.parent = parent
+        self.pairs: set[tuple[Dim, Dim]] = set()
+
+    def add(self, a: Dim, b: Dim) -> None:
+        self.pairs.add((a, b))
+
+    def _iter_pairs(self):
+        f: Facts | None = self
+        while f is not None:
+            yield from f.pairs
+            f = f.parent
+
+    def divisor_facts(self, a: Dim) -> list[Dim]:
+        """Every divisor some fact proves for ``a``."""
+        return [b for (x, b) in self._iter_pairs() if x == a]
+
+    def divisible(self, a: Dim, b: Dim) -> bool:
+        if b.concrete and b.coeff in (1, -1):
+            return True
+        if a == b:
+            return True
+        if a.concrete and b.concrete:
+            return b.coeff != 0 and a.coeff % b.coeff == 0
+        # structural containment: b*h % h == 0, 4h % 2h == 0
+        if dim_div(a, b) is not None:
+            return True
+        # coefficient multiples: (8*n) % 4 == 0
+        if b.concrete and b.coeff != 0 and a.coeff % b.coeff == 0:
+            return True
+        for (x, m) in self._iter_pairs():
+            if x != a:
+                continue
+            if m == b:
+                return True
+            # a % 256 == 0 certifies a % 128 == 0
+            if m.concrete and b.concrete and b.coeff != 0 \
+                    and m.coeff % b.coeff == 0:
+                return True
+        return False
+
+
+# -- scope environments ----------------------------------------------------
+
+#: binding kinds: the value slot holds a Shape / Dim / tuple[Dim|None]
+#: / dict[field -> Dim] respectively; a ``None`` value is poison
+_ARRAY, _DIM, _TUPLE, _RECORD = "arr", "dim", "tup", "rec"
+
+
+class ScopeEnv:
+    """Per-line bindings for one lexical scope.
+
+    ``bindings[name]`` is a source-ordered list of ``(lineno, kind,
+    value)``; a lookup at line L returns the last entry strictly before
+    L, so a use site only ever sees bindings that dominate it.  Entries
+    recorded from conditional/loop bodies carry ``value=None`` (poison)
+    unless the name was previously unbound or re-bound to the same
+    value.
+    """
+
+    def __init__(self, scope: ast.AST, key: str,
+                 parent: "ScopeEnv | None"):
+        self.scope = scope
+        self.key = key
+        self.parent = parent
+        self.bindings: dict[str, list[tuple[int, str, object]]] = {}
+        self.params: set[str] = set()
+        self.facts = Facts(parent.facts if parent else None)
+
+    # -- recording ---------------------------------------------------------
+
+    def bind(self, name: str, lineno: int, kind: str, value,
+             conditional: bool) -> None:
+        lst = self.bindings.setdefault(name, [])
+        if conditional and lst:
+            _, pk, pv = lst[-1]
+            if pk == kind and pv == value:
+                return  # re-binding to the same value: keep it
+            value = None
+        lst.append((lineno, kind, value))
+
+    def poison(self, name: str, lineno: int) -> None:
+        self.bindings.setdefault(name, []).append((lineno, _ARRAY, None))
+
+    # -- lookup ------------------------------------------------------------
+
+    def _visible(self, name: str, line: int):
+        lst = self.bindings.get(name)
+        if lst is None:
+            return None  # not a local — caller falls through to parent
+        got = None
+        for (ln, kind, value) in lst:
+            if ln < line:
+                got = (kind, value)
+            else:
+                break
+        return got or ("unbound", None)
+
+    def lookup(self, name: str, line: int):
+        """(kind, value) | None; poisoned / not-yet-bound / unknown
+        names are None."""
+        got = self._visible(name, line)
+        if got is not None:
+            kind, value = got
+            if kind == "unbound" or value is None:
+                # a local that is not yet bound at this line (or is
+                # poisoned) never falls through to an outer scope
+                return None
+            return got
+        if self.parent is not None:
+            return self.parent.lookup_closure(name)
+        return None
+
+    def lookup_closure(self, name: str):
+        """A read from a nested scope: only trustworthy when the name
+        has exactly one (non-poison) binding here — the closure may run
+        between any two re-bindings."""
+        lst = self.bindings.get(name)
+        if lst is None:
+            if self.parent is not None:
+                return self.parent.lookup_closure(name)
+            return None
+        if len(lst) == 1 and lst[0][2] is not None:
+            return (lst[0][1], lst[0][2])
+        return None
+
+    def name_state(self, name: str, line: int) -> str:
+        """'value' (a local/param/outer binding holds a usable value),
+        'opaque' (bound to something undecidable), or 'absent'."""
+        if name in self.params:
+            return "value"
+        lst = self.bindings.get(name)
+        if lst is not None:
+            got = self._visible(name, line)
+            if got and got[0] != "unbound" and got[1] is not None:
+                return "value"
+            return "opaque"
+        if self.parent is not None:
+            # ancestor scopes: closure rules
+            e = self.parent
+            while e is not None:
+                if name in e.params:
+                    return "value"
+                lst = e.bindings.get(name)
+                if lst is not None:
+                    if len(lst) == 1 and lst[0][2] is not None:
+                        return "value"
+                    return "opaque"
+                e = e.parent
+        return "absent"
+
+
+# -- record (NamedTuple) classes ------------------------------------------
+
+def _namedtuple_fields(cls: ast.ClassDef) -> "dict[str, Dim | None] | None":
+    """field -> default Dim (int defaults only) for a NamedTuple class,
+    None when ``cls`` is not a NamedTuple."""
+    if not any((dotted_name(b) or "").endswith("NamedTuple")
+               for b in cls.bases):
+        return None
+    fields: dict[str, Dim | None] = {}
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                          ast.Name):
+            default = None
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int) \
+                    and not isinstance(node.value.value, bool):
+                default = con(node.value.value)
+            fields[node.target.id] = default
+    return fields or None
+
+
+# -- the interpreter -------------------------------------------------------
+
+_ELEMENTWISE = {
+    "exp", "exp2", "log", "log2", "sqrt", "rsqrt", "tanh", "abs",
+    "negative", "sign", "relu", "sigmoid", "softmax", "astype",
+    "asarray", "stop_gradient", "logistic", "copy",
+}
+_SHAPE_LIKE = {"zeros_like", "ones_like", "full_like", "empty_like"}
+_SHAPE_CTOR = {"zeros", "ones", "empty"}
+_REDUCERS = {"sum", "mean", "prod", "max", "min", "amax", "amin",
+             "all", "any", "argmax", "argmin"}
+#: call leaves whose presence makes a scope worth checking
+TRIGGER_LEAVES = {"dot", "dot_general", "matmul", "einsum",
+                  "concatenate", "stack", "where"}
+
+
+class ShapeInterp:
+    """Shape/dim abstract interpretation over one parsed module."""
+
+    def __init__(self, path: str, tree: ast.Module, index=None):
+        self.path = path
+        self.tree = tree
+        self.index = index
+        self._envs: dict[int, ScopeEnv] = {}
+        self._parents: dict[int, ast.AST] = {}
+        self._records: dict[str, dict | None] = {}
+        self._summaries: dict = {}
+        self._local_records: dict[str, ast.ClassDef] = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._local_records[node.name] = node
+        # walrus targets are rare; one module-wide check lets every
+        # env build skip the nested-def walk in _poison_walruses
+        self._has_walrus = any(isinstance(n, ast.NamedExpr)
+                               for n in walk_list(tree))
+        # DFS parent map: each def's nearest enclosing *function* scope
+        # (class bodies are not closure scopes); defs are statements, so
+        # only statement bodies need walking
+        todo: list[tuple[list, ast.AST]] = [(tree.body, tree)]
+        while todo:
+            stmts, owner = todo.pop()
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    self._parents[id(s)] = owner
+                    todo.append((s.body, s))
+                elif isinstance(s, ast.ClassDef):
+                    todo.append((s.body, owner))
+                else:
+                    d = s.__dict__
+                    for fld in ("body", "orelse", "finalbody"):
+                        sub = d.get(fld)
+                        if sub:
+                            todo.append((sub, owner))
+                    for h in d.get("handlers") or ():
+                        todo.append((h.body, owner))
+                    for c in d.get("cases") or ():
+                        todo.append((c.body, owner))
+
+    def scopes(self) -> list[ast.AST]:
+        out: list[ast.AST] = [self.tree]
+        out.extend(n for n in walk_list(self.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)))
+        return out
+
+    # -- env construction --------------------------------------------------
+
+    def env(self, scope: ast.AST) -> ScopeEnv:
+        got = self._envs.get(id(scope))
+        if got is not None:
+            return got
+        if isinstance(scope, ast.Module):
+            parent = None
+            key = f"{self.path}::<module>"
+        else:
+            parent = self.env(self._parents[id(scope)])
+            key = f"{self.path}::{scope.name}@{scope.lineno}"
+        env = ScopeEnv(scope, key, parent)
+        self._envs[id(scope)] = env
+        if not isinstance(scope, ast.Module):
+            a = scope.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                env.params.add(p.arg)
+            for p in (a.vararg, a.kwarg):
+                if p:
+                    env.params.add(p.arg)
+        self._exec_block(scope.body, env, conditional=False)
+        self._poison_walruses(scope, env)
+        for lst in env.bindings.values():
+            lst.sort(key=_by_line)
+        return env
+
+    def _exec_block(self, stmts, env: ScopeEnv, conditional: bool,
+                    in_loop: bool = False) -> None:
+        for stmt in stmts:
+            self._exec_stmt(stmt, env, conditional, in_loop)
+
+    def _exec_stmt(self, stmt: ast.stmt, env: ScopeEnv,
+                   conditional: bool, in_loop: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            env.poison(stmt.name, stmt.lineno)
+            return
+        if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            for n in stmt.names:
+                env.poison(n, stmt.lineno)
+            return
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            return  # import aliases stay 'absent' — resolved lexically
+        if isinstance(stmt, ast.Assign):
+            self._exec_assign(stmt.targets, stmt.value, stmt.lineno, env,
+                              conditional or in_loop)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._exec_assign([stmt.target], stmt.value, stmt.lineno, env,
+                              conditional or in_loop)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                env.poison(stmt.target.id, stmt.lineno)
+        elif isinstance(stmt, ast.Assert):
+            self._harvest_assert(stmt, env)
+        elif isinstance(stmt, ast.If):
+            self._harvest_guard(stmt, env)
+            self._exec_block(stmt.body, env, True, in_loop)
+            self._exec_block(stmt.orelse, env, True, in_loop)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._poison_target(stmt.target, stmt.lineno, env)
+            self._exec_block(stmt.body, env, True, True)
+            self._exec_block(stmt.orelse, env, True, True)
+        elif isinstance(stmt, ast.While):
+            self._exec_block(stmt.body, env, True, True)
+            self._exec_block(stmt.orelse, env, True, True)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._poison_target(item.optional_vars, stmt.lineno,
+                                        env)
+            self._exec_block(stmt.body, env, conditional, in_loop)
+        elif isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, env, True, in_loop)
+            for h in stmt.handlers:
+                if h.name:
+                    env.poison(h.name, h.lineno)
+                self._exec_block(h.body, env, True, in_loop)
+            self._exec_block(stmt.orelse, env, True, in_loop)
+            self._exec_block(stmt.finalbody, env, conditional, in_loop)
+        elif isinstance(stmt, ast.Match):
+            for case in stmt.cases:
+                for n in ast.walk(case.pattern):
+                    if isinstance(n, ast.MatchAs) and n.name:
+                        env.poison(n.name, stmt.lineno)
+                self._exec_block(case.body, env, True, in_loop)
+
+    def _poison_walruses(self, scope: ast.AST, env: ScopeEnv) -> None:
+        """Walrus targets become poison; binding lists are re-sorted by
+        line afterwards, so out-of-order appends are fine.  Nested defs
+        and lambdas are walked whole — walruses in their default args
+        (and lambda bodies) bind in THIS scope, and over-poisoning from
+        their inner walruses only ever silences."""
+        if not self._has_walrus:
+            return
+        for n in _scope_nodes(scope):
+            if isinstance(n, ast.NamedExpr):
+                if isinstance(n.target, ast.Name):
+                    env.poison(n.target.id, n.lineno)
+            elif isinstance(n, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                for m in ast.walk(n):
+                    if isinstance(m, ast.NamedExpr) \
+                            and isinstance(m.target, ast.Name):
+                        env.poison(m.target.id, m.lineno)
+
+    def _poison_target(self, tgt: ast.expr, lineno: int,
+                       env: ScopeEnv) -> None:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name):
+                env.poison(n.id, lineno)
+
+    def _exec_assign(self, targets, value: ast.expr, lineno: int,
+                     env: ScopeEnv, conditional: bool) -> None:
+        line = lineno + 1  # RHS sees bindings up to (and on) this line
+        if len(targets) == 1 and isinstance(targets[0],
+                                            (ast.Tuple, ast.List)):
+            self._exec_unpack(targets[0], value, lineno, env, conditional)
+            return
+        for tgt in targets:
+            if not isinstance(tgt, ast.Name):
+                continue  # attribute/subscript stores: out of scope
+            dim = self._dim_of(value, env, line, _SUMMARY_DEPTH)
+            if dim is not None:
+                env.bind(tgt.id, lineno, _DIM, dim, conditional)
+                continue
+            shape = self._shape_of(value, env, line, _SUMMARY_DEPTH)
+            if shape is not None:
+                env.bind(tgt.id, lineno, _ARRAY, shape, conditional)
+                continue
+            tup = self._tuple_of(value, env, line)
+            if tup is not None:
+                env.bind(tgt.id, lineno, _TUPLE, tup, conditional)
+                continue
+            rec = self._record_of(value, env, line)
+            if rec is not None:
+                env.bind(tgt.id, lineno, _RECORD, rec, conditional)
+                continue
+            env.poison(tgt.id, lineno)
+
+    def _exec_unpack(self, tgt, value: ast.expr, lineno: int,
+                     env: ScopeEnv, conditional: bool) -> None:
+        """``b, h, d = x.shape`` binds the dims AND back-fills x's
+        rank; other unpacks poison their names."""
+        if any(isinstance(e, ast.Starred) for e in tgt.elts):
+            for e in tgt.elts:
+                self._poison_target(e, lineno, env)
+            return
+        names = [e.id if isinstance(e, ast.Name) else None
+                 for e in tgt.elts]
+        line = lineno + 1
+        dims = self._shape_value_of(value, env, line, len(names))
+        if dims is not None:
+            for name, d in zip(names, dims):
+                if name is not None:
+                    env.bind(name, lineno, _DIM, d, conditional)
+            root = self._shape_root(value)
+            if root is not None and env.lookup(root, line) is None:
+                env.bind(root, lineno, _ARRAY, tuple(dims), conditional)
+            return
+        tup = self._tuple_of(value, env, line)
+        if tup is not None and len(tup) == len(names):
+            for name, d in zip(names, tup):
+                if name is None:
+                    continue
+                if d is not None:
+                    env.bind(name, lineno, _DIM, d, conditional)
+                else:
+                    env.poison(name, lineno)
+            return
+        for name in names:
+            if name is not None:
+                env.poison(name, lineno)
+
+    @staticmethod
+    def _shape_root(value: ast.expr) -> str | None:
+        if isinstance(value, ast.Attribute) and value.attr == "shape" \
+                and isinstance(value.value, ast.Name):
+            return value.value.id
+        return None
+
+    def _shape_value_of(self, value: ast.expr, env: ScopeEnv, line: int,
+                        arity: int) -> "list[Dim] | None":
+        """Dims of an ``x.shape`` expression: the known shape, or fresh
+        symbols at the arity the unpack announces."""
+        if not (isinstance(value, ast.Attribute)
+                and value.attr == "shape"):
+            return None
+        base = dotted_name(value.value)
+        if base is None:
+            return None
+        shape = self._shape_of(value.value, env, line, 0)
+        if shape is not None:
+            return list(shape) if len(shape) == arity else None
+        return [sym(f"{env.key}:{base}.s{i}") for i in range(arity)]
+
+    # -- fact harvesting ---------------------------------------------------
+
+    def _harvest_assert(self, stmt: ast.Assert, env: ScopeEnv) -> None:
+        line = stmt.lineno + 1
+        test = stmt.test
+        # assert x % y == 0   /  assert not x % y
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.ops[0], ast.Eq) \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value == 0:
+            self._add_mod_fact(test.left, env, line)
+        elif isinstance(test, ast.UnaryOp) \
+                and isinstance(test.op, ast.Not):
+            self._add_mod_fact(test.operand, env, line)
+
+    def _harvest_guard(self, stmt: ast.If, env: ScopeEnv) -> None:
+        """``if x % y [!= 0] [or ...]: raise`` proves x % y == 0 on the
+        fall-through path; harvested whenever the body raises."""
+        if not any(isinstance(s, ast.Raise) for s in stmt.body):
+            return
+        line = stmt.lineno + 1
+        clauses = (stmt.test.values
+                   if isinstance(stmt.test, ast.BoolOp)
+                   and isinstance(stmt.test.op, ast.Or)
+                   else [stmt.test])
+        for clause in clauses:
+            if isinstance(clause, ast.Compare) and len(clause.ops) == 1 \
+                    and isinstance(clause.ops[0], ast.NotEq) \
+                    and isinstance(clause.comparators[0], ast.Constant) \
+                    and clause.comparators[0].value == 0:
+                clause = clause.left
+            self._add_mod_fact(clause, env, line)
+
+    def _add_mod_fact(self, expr: ast.expr, env: ScopeEnv,
+                      line: int) -> None:
+        if not (isinstance(expr, ast.BinOp)
+                and isinstance(expr.op, ast.Mod)):
+            return
+        a = self._dim_of(expr.left, env, line, 0)
+        b = self._dim_of(expr.right, env, line, 0)
+        if a is not None and b is not None:
+            env.facts.add(a, b)
+
+    # -- expression evaluation: dims --------------------------------------
+
+    def _dim_of(self, node: ast.expr, env: ScopeEnv, line: int,
+                depth: int) -> Dim | None:
+        """The expression as an int-valued Dim, or None."""
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) \
+                    or not isinstance(node.value, int):
+                return None
+            return con(node.value)
+        if isinstance(node, ast.Name):
+            got = env.lookup(node.id, line)
+            if got is not None:
+                kind, value = got
+                return value if kind == _DIM else None
+            if node.id in env.params:
+                return sym(f"{env.key}:{node.id}")
+            return None
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                        ast.USub):
+            d = self._dim_of(node.operand, env, line, depth)
+            return Dim(-d.coeff, d.syms) if d is not None else None
+        if isinstance(node, ast.BinOp):
+            a = self._dim_of(node.left, env, line, depth)
+            b = self._dim_of(node.right, env, line, depth)
+            if a is None or b is None:
+                return None
+            if isinstance(node.op, ast.Mult):
+                return dim_mul(a, b)
+            if isinstance(node.op, ast.FloorDiv):
+                return dim_div(a, b)
+            if a.concrete and b.concrete:
+                if isinstance(node.op, ast.Add):
+                    return con(a.coeff + b.coeff)
+                if isinstance(node.op, ast.Sub):
+                    return con(a.coeff - b.coeff)
+                if isinstance(node.op, ast.Mod) and b.coeff:
+                    return con(a.coeff % b.coeff)
+                if isinstance(node.op, ast.Pow) and b.coeff >= 0:
+                    return con(a.coeff ** b.coeff)
+            return None
+        if isinstance(node, ast.Subscript):
+            return self._shape_elem(node, env, line)
+        if isinstance(node, ast.Attribute):
+            # record projection: bs.block_q
+            if isinstance(node.value, ast.Name):
+                got = env.lookup(node.value.id, line)
+                if got is not None and got[0] == _RECORD:
+                    return got[1].get(node.attr)
+            return None
+        return None
+
+    def _shape_elem(self, node: ast.Subscript, env: ScopeEnv,
+                    line: int) -> Dim | None:
+        """``x.shape[i]`` / ``shp[i]`` with a literal index."""
+        idx = node.slice
+        if isinstance(idx, ast.UnaryOp) and isinstance(idx.op, ast.USub) \
+                and isinstance(idx.operand, ast.Constant) \
+                and isinstance(idx.operand.value, int):
+            i = -idx.operand.value
+        elif isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+            i = idx.value
+        else:
+            return None
+        base = node.value
+        if isinstance(base, ast.Attribute) and base.attr == "shape":
+            root = dotted_name(base.value)
+            shape = self._shape_of(base.value, env, line, 0)
+            if shape is not None:
+                return shape[i] if -len(shape) <= i < len(shape) else None
+            if root is not None and i >= 0:
+                return sym(f"{env.key}:{root}.s{i}")
+            return None
+        if isinstance(base, ast.Name):
+            got = env.lookup(base.id, line)
+            if got is not None and got[0] == _TUPLE:
+                tup = got[1]
+                if -len(tup) <= i < len(tup):
+                    return tup[i]
+        return None
+
+    def _tuple_of(self, node: ast.expr, env: ScopeEnv,
+                  line: int) -> "tuple[Dim | None, ...] | None":
+        """A tuple-of-ints value (block shapes, grids): per-element
+        Dims, with None holes for undecidable entries."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self._dim_of(e, env, line, 0) for e in node.elts)
+        if isinstance(node, ast.Name):
+            got = env.lookup(node.id, line)
+            if got is not None and got[0] == _TUPLE:
+                return got[1]
+            return None
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            shape = self._shape_of(node.value, env, line, 0)
+            if shape is not None:
+                return shape
+        return None
+
+    def _record_of(self, node: ast.expr, env: ScopeEnv,
+                   line: int) -> "dict[str, Dim | None] | None":
+        """``BlockSizes(block_q=bq)`` -> field map with defaults."""
+        if not isinstance(node, ast.Call):
+            return None
+        fields = self._record_fields(dotted_name(node.func))
+        if fields is None:
+            return None
+        rec = dict(fields)
+        names = list(fields)
+        for i, arg in enumerate(node.args):
+            if i < len(names):
+                rec[names[i]] = self._dim_of(arg, env, line, 0)
+        for kw in node.keywords:
+            if kw.arg in rec:
+                rec[kw.arg] = self._dim_of(kw.value, env, line, 0)
+        return rec
+
+    def _record_fields(self, name: str | None):
+        """NamedTuple field defaults for a constructor name, resolved
+        locally or (with the index) across modules."""
+        if not name:
+            return None
+        got = self._records.get(name, "miss")
+        if got != "miss":
+            return got
+        fields = None
+        cls = self._local_records.get(name) if "." not in name else None
+        if cls is None and self.index is not None:
+            mod = self.index.modules.get(self.path)
+            if mod is not None:
+                t = self.index._resolve_dotted_in(mod, name, 8)
+                if t is not None and t[0] == "class":
+                    cinfo = self.index.classes.get(t[1])
+                    if cinfo is not None:
+                        for node in self.index.modules[
+                                cinfo.path].tree.body:
+                            if isinstance(node, ast.ClassDef) \
+                                    and node.name == cinfo.name:
+                                cls = node
+                                break
+        if cls is not None:
+            fields = _namedtuple_fields(cls)
+        self._records[name] = fields
+        return fields
+
+    # -- call-form resolution ---------------------------------------------
+
+    def _recv(self, call: ast.Call, env: ScopeEnv, line: int):
+        """('method', base, rest_args) for ``x.f(...)`` on an in-scope
+        value, ('module', base, rest_args) for ``jnp.f(x, ...)``, or
+        (None, None, None) when the form is undecidable."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if call.args:
+                return ("module", call.args[0], call.args[1:])
+            return (None, None, None)
+        if not isinstance(f, ast.Attribute):
+            return (None, None, None)
+        base = f.value
+        d = dotted_name(base)
+        if d is None:
+            # f(x).reshape(...): a value when its shape is derivable
+            if self._shape_of(base, env, line, 0) is not None:
+                return ("method", base, call.args)
+            return (None, None, None)
+        root = d.split(".")[0]
+        state = env.name_state(root, line)
+        if state == "value":
+            return ("method", base, call.args)
+        if state == "opaque":
+            return (None, None, None)
+        if root in _MODULE_ROOTS:
+            if call.args:
+                return ("module", call.args[0], call.args[1:])
+            return (None, None, None)
+        if self.index is not None:
+            canon = self.index.canonical_name(self.path,
+                                              d + "." + f.attr)
+            if canon.split(".")[0] in ("jax", "numpy"):
+                if call.args:
+                    return ("module", call.args[0], call.args[1:])
+        return (None, None, None)
+
+    # -- shape transfer ----------------------------------------------------
+
+    def _shape_of(self, node: ast.expr, env: ScopeEnv, line: int,
+                  depth: int):
+        if isinstance(node, ast.Name):
+            got = env.lookup(node.id, line)
+            if got is not None and got[0] == _ARRAY:
+                return got[1]
+            return None
+        if isinstance(node, ast.Attribute):
+            if node.attr == "T":
+                s = self._shape_of(node.value, env, line, depth)
+                return tuple(reversed(s)) if s is not None else None
+            return None
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.MatMult):
+                a = self._shape_of(node.left, env, line, depth)
+                b = self._shape_of(node.right, env, line, depth)
+                return self._check_dot(a, b, node, None, None)
+            a = self._shape_of(node.left, env, line, depth)
+            b = self._shape_of(node.right, env, line, depth)
+            return _broadcast(a, b)
+        if isinstance(node, ast.Subscript):
+            # x[i]: a literal integer index drops the leading dim
+            s = self._shape_of(node.value, env, line, depth)
+            if s is not None and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, int) and len(s):
+                return s[1:]
+            return None
+        if isinstance(node, ast.Call):
+            return self._call_shape(node, env, line, depth)
+        return None
+
+    def _call_shape(self, call: ast.Call, env: ScopeEnv, line: int,
+                    depth: int):
+        f = call.func
+        leaf = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if leaf is None:
+            return None
+        # module-form-only constructors
+        if leaf in _SHAPE_CTOR or leaf == "full":
+            arg = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "shape":
+                    arg = kw.value
+            if arg is None:
+                return None
+            tup = self._tuple_of(arg, env, line)
+            if tup is not None and all(x is not None for x in tup):
+                return tuple(tup)
+            d0 = self._dim_of(arg, env, line, 0)
+            return (d0,) if d0 is not None else None
+        if leaf in _SHAPE_LIKE:
+            if call.args:
+                return self._shape_of(call.args[0], env, line, depth)
+            return None
+        if leaf in ("concatenate", "stack"):
+            return self._concat_shape(call, env, line, depth, leaf,
+                                      None, None)
+        if leaf in ("dot", "matmul"):
+            return self._dot_shape(call, env, line, depth, None, None)
+        if leaf == "where":
+            return self._where_shape(call, env, line, depth, None, None)
+        if leaf == "einsum":
+            return self._einsum_shape(call, env, line, depth, None, None)
+        if leaf == "broadcast_to":
+            form, base, rest = self._recv(call, env, line)
+            if form is None or not rest:
+                return None
+            tup = self._tuple_of(rest[0], env, line)
+            if tup is not None and all(x is not None for x in tup):
+                return tuple(tup)
+            return None
+        if leaf == "reshape":
+            form, base, rest = self._recv(call, env, line)
+            if form is None:
+                return None
+            return self._reshape_dims(rest, call, env, line)
+        if leaf in ("transpose", "swapaxes"):
+            form, base, rest = self._recv(call, env, line)
+            if form is None:
+                return None
+            return self._transpose_shape(base, rest, env, line, depth,
+                                         leaf)
+        if leaf in _ELEMENTWISE:
+            if leaf == "astype" and isinstance(f, ast.Attribute):
+                return self._shape_of(f.value, env, line, depth)
+            form, base, rest = self._recv(call, env, line)
+            if form is None or base is None:
+                return None
+            return self._shape_of(base, env, line, depth)
+        if leaf in _REDUCERS:
+            form, base, rest = self._recv(call, env, line)
+            if form is None or base is None:
+                return None
+            return self._reduce_shape(base, rest, call, env, line, depth)
+        if leaf in ("expand_dims", "squeeze"):
+            form, base, rest = self._recv(call, env, line)
+            if form is None or base is None:
+                return None
+            return self._axis_shape(base, rest, call, env, line, depth,
+                                    leaf)
+        # in-tree call: summarize the callee's return shape
+        if depth > 0 and self.index is not None:
+            return self._summary_shape(call, env, line, depth)
+        return None
+
+    def _reshape_dims(self, rest, call, env: ScopeEnv, line: int):
+        if len(rest) == 1 and not (
+                isinstance(rest[0], ast.Constant)
+                or (isinstance(rest[0], ast.UnaryOp))):
+            tup = self._tuple_of(rest[0], env, line)
+            if tup is None:
+                d0 = self._dim_of(rest[0], env, line, 0)
+                tup = (d0,) if d0 is not None else None
+            dims = list(tup) if tup is not None else None
+        else:
+            dims = [self._dim_of(a, env, line, 0) for a in rest]
+        if not dims:
+            return None
+        out = []
+        for i, d in enumerate(dims):
+            if d is None or (d.concrete and d.coeff == -1):
+                d = sym(f"{env.key}:reshape@{call.lineno}.{i}")
+            out.append(d)
+        return tuple(out)
+
+    def _transpose_shape(self, base, rest, env, line, depth, leaf):
+        if base is None:
+            return None
+        s = self._shape_of(base, env, line, depth)
+        if s is None:
+            return None
+        if leaf == "swapaxes":
+            if len(rest) == 2 and all(
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, int) for a in rest):
+                i, j = rest[0].value, rest[1].value
+                if -len(s) <= i < len(s) and -len(s) <= j < len(s):
+                    out = list(s)
+                    out[i], out[j] = out[j], out[i]
+                    return tuple(out)
+            return None
+        if not rest:
+            return tuple(reversed(s))
+        elts = (rest[0].elts if len(rest) == 1
+                and isinstance(rest[0], (ast.Tuple, ast.List)) else rest)
+        perm = [e.value if isinstance(e, ast.Constant)
+                and isinstance(e.value, int) else None for e in elts]
+        if len(perm) != len(s) or any(p is None for p in perm) \
+                or sorted(perm) != list(range(len(s))):
+            return None
+        return tuple(s[p] for p in perm)
+
+    def _reduce_shape(self, base, rest, call, env, line, depth):
+        s = self._shape_of(base, env, line, depth)
+        if s is None:
+            return None
+        axis = rest[0] if rest else None
+        keep = False
+        for kw in call.keywords:
+            if kw.arg == "axis":
+                axis = kw.value
+            elif kw.arg == "keepdims":
+                keep = isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True
+        if axis is None:
+            return tuple(con(1) for _ in s) if keep else ()
+        if not (isinstance(axis, ast.Constant)
+                and isinstance(axis.value, int)):
+            return None
+        i = axis.value
+        if not (-len(s) <= i < len(s)):
+            return None
+        i %= len(s)
+        if keep:
+            return s[:i] + (con(1),) + s[i + 1:]
+        return s[:i] + s[i + 1:]
+
+    def _axis_shape(self, base, rest, call, env, line, depth, leaf):
+        s = self._shape_of(base, env, line, depth)
+        if s is None:
+            return None
+        axis = rest[0] if rest else None
+        for kw in call.keywords:
+            if kw.arg == "axis":
+                axis = kw.value
+        if not (isinstance(axis, ast.Constant)
+                and isinstance(axis.value, int)):
+            return None
+        i = axis.value
+        if leaf == "expand_dims":
+            if not (-len(s) - 1 <= i <= len(s)):
+                return None
+            i %= (len(s) + 1)
+            return s[:i] + (con(1),) + s[i:]
+        if not (-len(s) <= i < len(s)):
+            return None
+        i %= len(s)
+        if s[i].concrete and s[i].coeff != 1:
+            return None  # squeezing a non-1 dim fails at runtime anyway
+        return s[:i] + s[i + 1:]
+
+    # -- checked sites (shape transfer + ATP901) --------------------------
+
+    def _concat_shape(self, call, env, line, depth, leaf, path,
+                      findings):
+        seq = call.args[0] if call.args else None
+        if not isinstance(seq, (ast.Tuple, ast.List)):
+            return None
+        shapes = [self._shape_of(e, env, line, depth) for e in seq.elts]
+        axis = 0
+        if len(call.args) > 1:
+            a = call.args[1]
+            if isinstance(a, ast.Constant) and isinstance(a.value, int):
+                axis = a.value
+            else:
+                return None
+        for kw in call.keywords:
+            if kw.arg == "axis":
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    axis = kw.value.value
+                else:
+                    return None
+        known = [s for s in shapes if s is not None]
+        if len(known) < 2:
+            return None
+        rank = len(known[0])
+        if any(len(s) != rank for s in known):
+            if findings is not None:
+                findings.append(Finding(
+                    ATP901,
+                    f"{leaf} operands provably have different ranks "
+                    f"({', '.join(str(len(s)) for s in known)})",
+                    path, call.lineno, call.col_offset))
+            return None
+        if leaf == "stack":
+            cmp_axes = list(range(rank))
+        else:
+            if not (-rank <= axis < rank):
+                return None
+            axis %= rank
+            cmp_axes = [i for i in range(rank) if i != axis]
+        for i in cmp_axes:
+            vals = {s[i].coeff for s in known if s[i].concrete}
+            if len(vals) > 1:
+                if findings is not None:
+                    findings.append(Finding(
+                        ATP901,
+                        f"{leaf} operands provably disagree on axis "
+                        f"{i}: sizes {sorted(vals)}",
+                        path, call.lineno, call.col_offset))
+                return None
+        if len(known) != len(shapes):
+            return None
+        if leaf == "stack":
+            if not (-rank - 1 <= axis <= rank):
+                return None
+            axis %= (rank + 1)
+            return known[0][:axis] + (con(len(shapes)),) \
+                + known[0][axis:]
+        out = list(known[0])
+        total = 0
+        for s in known:
+            if not s[axis].concrete:
+                total = None
+                break
+            total += s[axis].coeff
+        out[axis] = (con(total) if total is not None
+                     else sym(f"{env.key}:concat@{call.lineno}"))
+        return tuple(out)
+
+    def _dot_shape(self, call, env, line, depth, path, findings):
+        if len(call.args) < 2:
+            return None
+        a = self._shape_of(call.args[0], env, line, depth)
+        b = self._shape_of(call.args[1], env, line, depth)
+        return self._check_dot(a, b, call, path, findings)
+
+    def _check_dot(self, a, b, node, path, findings):
+        if a is None or b is None or not a or not b:
+            return None
+        inner_a = a[-1]
+        inner_b = b[-2] if len(b) >= 2 else b[0]
+        if inner_a.concrete and inner_b.concrete \
+                and inner_a.coeff != inner_b.coeff:
+            if findings is not None:
+                findings.append(Finding(
+                    ATP901,
+                    "dot/matmul contraction dims provably disagree: "
+                    f"lhs last dim {inner_a.coeff} vs rhs "
+                    f"{inner_b.coeff}",
+                    path, node.lineno, node.col_offset))
+            return None
+        if len(a) == 2 and len(b) == 2:
+            return (a[0], b[1])
+        if len(a) == 1 and len(b) == 1:
+            return ()
+        if len(a) == len(b) and len(a) > 2:
+            return a[:-1] + (b[-1],)
+        return None
+
+    def _where_shape(self, call, env, line, depth, path, findings):
+        if len(call.args) < 3:
+            return None
+        shapes = [self._shape_of(a, env, line, depth)
+                  for a in call.args[:3]]
+        out = None
+        for s in shapes:
+            if s is None:
+                continue
+            if out is None:
+                out = s
+                continue
+            if findings is not None and _broadcast_conflict(out, s):
+                findings.append(Finding(
+                    ATP901,
+                    "where operands are provably broadcast-"
+                    f"incompatible ({_fmt(out)} vs {_fmt(s)})",
+                    path, call.lineno, call.col_offset))
+                return None
+            out = _broadcast(out, s)
+            if out is None:
+                return None
+        return out if all(s is not None for s in shapes) else None
+
+    def _einsum_shape(self, call, env, line, depth, path, findings):
+        if not call.args or not (
+                isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return None
+        spec = call.args[0].value.replace(" ", "")
+        if "..." in spec or "->" not in spec:
+            return None
+        lhs, rhs = spec.split("->", 1)
+        subs = lhs.split(",")
+        operands = call.args[1:]
+        if len(subs) != len(operands):
+            return None
+        letter_dims: dict[str, Dim] = {}
+        for sub, op in zip(subs, operands):
+            s = self._shape_of(op, env, line, depth)
+            if s is None:
+                continue
+            if len(s) != len(sub):
+                if findings is not None:
+                    findings.append(Finding(
+                        ATP901,
+                        f"einsum subscript {sub!r} has {len(sub)} "
+                        "indices but the operand provably has rank "
+                        f"{len(s)}",
+                        path, call.lineno, call.col_offset))
+                return None
+            for ch, d in zip(sub, s):
+                prev = letter_dims.get(ch)
+                if prev is None:
+                    letter_dims[ch] = d
+                elif prev.concrete and d.concrete \
+                        and prev.coeff != d.coeff:
+                    if findings is not None:
+                        findings.append(Finding(
+                            ATP901,
+                            f"einsum index {ch!r} is provably bound "
+                            f"to two different sizes ({prev.coeff} "
+                            f"vs {d.coeff})",
+                            path, call.lineno, call.col_offset))
+                    return None
+        if any(ch not in letter_dims for ch in rhs):
+            return None
+        return tuple(letter_dims[ch] for ch in rhs)
+
+    def _dot_general_check(self, call, env, line, depth, path,
+                           findings):
+        if len(call.args) < 2:
+            return
+        dn = call.args[2] if len(call.args) > 2 else None
+        for kw in call.keywords:
+            if kw.arg == "dimension_numbers":
+                dn = kw.value
+        pairs = _dn_contract_pairs(dn)
+        if pairs is None:
+            return
+        a = self._shape_of(call.args[0], env, line, depth)
+        b = self._shape_of(call.args[1], env, line, depth)
+        if a is None or b is None:
+            return
+        for (la, rb) in pairs:
+            if not (-len(a) <= la < len(a) and -len(b) <= rb < len(b)):
+                continue
+            da, db = a[la], b[rb]
+            if da.concrete and db.concrete and da.coeff != db.coeff:
+                findings.append(Finding(
+                    ATP901,
+                    f"dot_general contracts lhs dim {la} ({da.coeff}) "
+                    f"against rhs dim {rb} ({db.coeff}) — provably "
+                    "unequal",
+                    path, call.lineno, call.col_offset))
+                return
+
+    # -- interprocedural return-shape summaries ---------------------------
+
+    def _summary_shape(self, call: ast.Call, env: ScopeEnv, line: int,
+                       depth: int):
+        callee, _ = self.index.resolve_call(self.path, None, call)
+        if callee is None:
+            return None
+        arg_shapes = [self._shape_of(a, env, line, depth - 1)
+                      for a in call.args]
+        key = (callee, tuple(s if s is None else tuple(s)
+                             for s in arg_shapes))
+        if key in self._summaries:
+            return self._summaries[key]
+        self._summaries[key] = None  # cycle guard
+        info = self.index.functions.get(callee)
+        if info is None or info.cls is not None:
+            return None
+        got = self._return_shape(info, arg_shapes, depth - 1)
+        self._summaries[key] = got
+        return got
+
+    def _return_shape(self, info, arg_shapes, depth):
+        """Interpret the callee with positional params bound to the
+        caller's shapes; a unique known return shape is the summary."""
+        if info.path == self.path:
+            sub = self
+        else:
+            mod = self.index.modules.get(info.path)
+            if mod is None:
+                return None
+            sub = interp_for(info.path, mod.tree, self.index)
+        env = sub.env(info.node)
+        a = info.node.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        overlay = ScopeEnv(info.node, env.key, env.parent)
+        overlay.bindings = {k: list(v) for k, v in env.bindings.items()}
+        overlay.params = env.params
+        overlay.facts = env.facts
+        for name, s in zip(names, arg_shapes):
+            if s is not None and name not in overlay.bindings:
+                overlay.bindings[name] = [
+                    (info.node.lineno, _ARRAY, tuple(s))]
+        out = None
+        for r in scope_list(info.node):
+            if not isinstance(r, ast.Return) or r.value is None:
+                continue
+            s = sub._shape_of(r.value, overlay, r.lineno + 1, depth)
+            if s is None:
+                return None
+            if out is None:
+                out = s
+            elif out != s:
+                return None
+        return out
+
+    # -- the check walk ----------------------------------------------------
+
+    def check_scope(self, scope: ast.AST,
+                    findings: list[Finding]) -> None:
+        env = self.env(scope)
+        for node in _scope_nodes(scope):
+            line = getattr(node, "lineno", 0) + 1
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.MatMult):
+                a = self._shape_of(node.left, env, line, _SUMMARY_DEPTH)
+                b = self._shape_of(node.right, env, line,
+                                   _SUMMARY_DEPTH)
+                self._check_dot(a, b, node, self.path, findings)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                leaf = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if leaf in ("dot", "matmul"):
+                    self._dot_shape(node, env, line, _SUMMARY_DEPTH,
+                                    self.path, findings)
+                elif leaf == "dot_general":
+                    self._dot_general_check(node, env, line,
+                                            _SUMMARY_DEPTH, self.path,
+                                            findings)
+                elif leaf == "einsum":
+                    self._einsum_shape(node, env, line, _SUMMARY_DEPTH,
+                                       self.path, findings)
+                elif leaf in ("concatenate", "stack"):
+                    self._concat_shape(node, env, line, _SUMMARY_DEPTH,
+                                       leaf, self.path, findings)
+                elif leaf == "where":
+                    self._where_shape(node, env, line, _SUMMARY_DEPTH,
+                                      self.path, findings)
+
+
+def _scope_nodes(scope: ast.AST) -> list[ast.AST]:
+    """The nodes belonging to one scope (module scope stops at defs)."""
+    if not isinstance(scope, ast.Module):
+        return scope_list(scope)
+    out: list[ast.AST] = []
+    stack = list(scope.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _broadcast(a, b):
+    if a is None or b is None:
+        return None
+    if len(a) < len(b):
+        a, b = b, a
+    out = list(a)
+    for i in range(1, len(b) + 1):
+        da, db = a[-i], b[-i]
+        if da.concrete and da.coeff == 1:
+            out[-i] = db
+        elif (db.concrete and db.coeff == 1) or da == db:
+            out[-i] = da
+        elif da.concrete and db.concrete and da.coeff != db.coeff:
+            return None
+        else:
+            out[-i] = da if da.concrete else db
+    return tuple(out)
+
+
+def _broadcast_conflict(a, b) -> bool:
+    """Provably incompatible: some aligned pair is concrete, unequal,
+    and neither side is 1."""
+    for i in range(1, min(len(a), len(b)) + 1):
+        da, db = a[-i], b[-i]
+        if da.concrete and db.concrete and da.coeff != db.coeff \
+                and da.coeff != 1 and db.coeff != 1:
+            return True
+    return False
+
+
+def _fmt(shape) -> str:
+    return "(" + ", ".join(repr(d) for d in shape) + ")"
+
+
+def _dn_contract_pairs(dn):
+    """Literal ``((lhs_contract, rhs_contract), ...)`` index pairs."""
+    if not isinstance(dn, ast.Tuple) or not dn.elts:
+        return None
+    c = dn.elts[0]
+    if not isinstance(c, ast.Tuple) or len(c.elts) != 2:
+        return None
+    sides = []
+    for side in c.elts:
+        if not isinstance(side, (ast.Tuple, ast.List)):
+            return None
+        vals = [e.value if isinstance(e, ast.Constant)
+                and isinstance(e.value, int) else None
+                for e in side.elts]
+        if any(v is None for v in vals):
+            return None
+        sides.append(vals)
+    if len(sides[0]) != len(sides[1]):
+        return None
+    return list(zip(sides[0], sides[1]))
+
+
+# -- shared entry points ---------------------------------------------------
+
+#: id(tree) -> (tree, ShapeInterp); shared across the shapes, pallas
+#: and sharding passes within one analyze() run
+_INTERP_CACHE: dict[int, tuple[ast.Module, ShapeInterp]] = {}
+_INTERP_CACHE_MAX = 512
+
+
+def interp_for(path: str, tree: ast.Module, index=None) -> ShapeInterp:
+    hit = _INTERP_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    interp = ShapeInterp(path, tree, index)
+    if len(_INTERP_CACHE) >= _INTERP_CACHE_MAX:
+        _INTERP_CACHE.clear()
+    _INTERP_CACHE[id(tree)] = (tree, interp)
+    return interp
+
+
+def _is_trigger(node: ast.AST) -> bool:
+    if isinstance(node, ast.BinOp):
+        return isinstance(node.op, ast.MatMult)
+    if isinstance(node, ast.Call):
+        f = node.func
+        leaf = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        return leaf in TRIGGER_LEAVES
+    return False
+
+
+def _scope_has_trigger(scope: ast.AST) -> bool:
+    for node in _scope_nodes(scope):
+        if _is_trigger(node):
+            return True
+    return False
+
+
+def _by_line(entry) -> int:
+    return entry[0]
+
+
+@file_pass("shapes", [ATP901], needs_index=True)
+def check_shapes(path: str, tree: ast.Module, src: str, index=None):
+    """Provable dot/concat/where shape mismatches (symbolic domain)."""
+    # cheap prefilter on the shared walk cache: most files have no
+    # dot/einsum/concat/where/@ site at all
+    if not any(_is_trigger(n) for n in walk_list(tree)):
+        return []
+    findings: list[Finding] = []
+    interp = interp_for(path, tree, index)
+    for scope in interp.scopes():
+        if _scope_has_trigger(scope):
+            interp.check_scope(scope, findings)
+    return findings

@@ -1,0 +1,710 @@
+"""shard_map/PartitionSpec geometry passes (ATP903-906).
+
+The runtime already polices mesh geometry — ``MeshConfigError`` at
+call time, chaos campaigns after that.  These passes move the
+provable part of that contract to lint time, on top of the
+``shapes.py`` symbolic domain:
+
+- **ATP903** — a ``PartitionSpec`` longer than the operand's provable
+  rank, or a literal axis name that is not among the lexically
+  resolvable mesh axes.
+- **ATP904** — a dim that a spec provably shards carries no
+  ``dim % shards == 0`` fact (the static twin of ``MeshConfigError``:
+  the ``if hkv % n_dev: raise`` guard IS the fact; any divisor with a
+  matching dividend accepts, because the mesh size is almost never
+  statically known).
+- **ATP905** — a contraction (``dot``/``einsum``/``sum(axis=...)``)
+  over a dimension the in_specs shard, inside a shard_map body that
+  provably contains no collective: each shard silently computes a
+  partial result.  Silence here is a proof too — it statically pins
+  ``parallel/serving.py``'s "zero collectives per-head math" claim.
+- **ATP906** — ``out_specs`` structure vs the returned value: a
+  literal out_specs tuple whose length differs from a literal returned
+  tuple, a spec longer than the provable return rank, or a literal
+  axis name unknown to the mesh.  (A single spec against a tuple
+  return is a legal pytree prefix — silent.)
+
+Never-guess discipline throughout: specs are only trusted when they
+resolve through single-assignment names to literal ``P(...)`` calls;
+only *literal string* axis entries count as provably sharded (a
+variable entry could be None); mesh axes are only compared when the
+mesh expression resolves to ``Mesh(..., (literal, ...))``,
+``default_mesh(<literal>)`` or ``hybrid_mesh(<literals>)``; a body is
+only "collective-free" when every call in it resolves to something
+provably not a collective.  Anything else stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from attention_tpu.analysis.core import (
+    Finding,
+    Severity,
+    dotted_name,
+    file_pass,
+    register_code,
+    scope_list,
+)
+from attention_tpu.analysis import shapes as _shapes
+from attention_tpu.analysis.shapes import (
+    ShapeInterp,
+    _scope_nodes,
+    interp_for,
+)
+
+ATP903 = register_code(
+    "ATP903", "partition-spec-geometry", Severity.ERROR,
+    "PartitionSpec rank exceeds the operand's provable rank, or a "
+    "literal spec axis is not a lexically visible mesh axis")
+ATP904 = register_code(
+    "ATP904", "sharded-dim-no-divisibility-fact", Severity.WARNING,
+    "a dim a spec provably shards carries no `dim % shards == 0` "
+    "guard/assert fact — the static twin of MeshConfigError")
+ATP905 = register_code(
+    "ATP905", "cross-shard-reduction-no-collective", Severity.ERROR,
+    "contraction over a spec-sharded dim inside a shard_map body with "
+    "provably no collective — each shard computes a silent partial")
+ATP906 = register_code(
+    "ATP906", "out-specs-return-mismatch", Severity.ERROR,
+    "shard_map out_specs structure provably disagrees with the "
+    "returned value")
+
+#: cross-shard communication primitives: any of these in a body (or a
+#: resolvable callee) makes ATP905 unprovable -> silent
+_COLLECTIVES = {
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "pbroadcast", "pgather",
+}
+#: module roots whose non-collective calls provably do no cross-shard
+#: communication (collective leaves are checked first)
+_SAFE_ROOTS = {"jnp", "np", "numpy", "math", "functools"}
+_SAFE_BUILTINS = {
+    "int", "float", "bool", "str", "len", "range", "min", "max",
+    "abs", "round", "sum", "sorted", "tuple", "list", "dict", "set",
+    "zip", "enumerate", "isinstance", "getattr", "hasattr", "print",
+    "divmod", "slice", "type", "id", "repr", "any", "all",
+}
+_REDUCE_LEAVES = {"sum", "mean", "prod", "max", "min", "amax", "amin"}
+_COLLECTIVE_DEPTH = 3
+
+#: spec entry markers
+_VAR = "?"  # a non-literal entry: could be an axis or None
+
+
+# -- spec / mesh resolution -----------------------------------------------
+
+def _call_leaf(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _single_assigns(scope: ast.AST) -> dict[str, ast.expr]:
+    """name -> value for names assigned exactly once in ``scope`` (any
+    second write, aug-assign, loop target or walrus disqualifies)."""
+    counts: dict[str, int] = {}
+    values: dict[str, ast.expr] = {}
+    for n in _scope_nodes(scope):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        counts[sub.id] = counts.get(sub.id, 0) + (
+                            1 if t is sub and len(n.targets) == 1
+                            else 99)
+                        if t is sub and len(n.targets) == 1:
+                            values[sub.id] = n.value
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            t = n.target
+            if isinstance(t, ast.Name):
+                counts[t.id] = counts.get(t.id, 0) + 99
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    counts[sub.id] = counts.get(sub.id, 0) + 99
+        elif isinstance(n, ast.NamedExpr):
+            if isinstance(n.target, ast.Name):
+                counts[n.target.id] = counts.get(n.target.id, 0) + 99
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    for sub in ast.walk(item.optional_vars):
+                        if isinstance(sub, ast.Name):
+                            counts[sub.id] = counts.get(sub.id, 0) + 99
+    return {k: v for k, v in values.items() if counts.get(k) == 1}
+
+
+class _Resolver:
+    """Single-assignment name dereferencing along a scope chain."""
+
+    def __init__(self, interp: ShapeInterp, scope: ast.AST):
+        self.maps: list[dict[str, ast.expr]] = []
+        node = scope
+        seen = 0
+        while node is not None and seen < 8:
+            self.maps.append(_single_assigns(node))
+            if isinstance(node, ast.Module):
+                break
+            node = interp._parents.get(id(node))
+            seen += 1
+
+    def deref(self, expr: ast.expr, depth: int = 3) -> ast.expr:
+        while depth > 0 and isinstance(expr, ast.Name):
+            for m in self.maps:
+                got = m.get(expr.id)
+                if got is not None:
+                    expr = got
+                    break
+            else:
+                return expr
+            depth -= 1
+        return expr
+
+
+def _spec_entries(expr: ast.expr,
+                  res: _Resolver) -> "tuple | None":
+    """A ``P(...)`` call -> tuple of entries: None (replicated), a
+    literal axis string, or ``_VAR``.  None when not provably a spec or
+    when a star makes positions unreliable past it (the tuple is then
+    truncated and flagged open-ended via a trailing ``...``)."""
+    expr = res.deref(expr)
+    if not (isinstance(expr, ast.Call)
+            and _call_leaf(expr) in ("P", "PartitionSpec")):
+        return None
+    out: list = []
+    for a in expr.args:
+        if isinstance(a, ast.Starred):
+            out.append(Ellipsis)
+            break
+        if isinstance(a, ast.Constant):
+            if a.value is None:
+                out.append(None)
+            elif isinstance(a.value, str):
+                out.append(a.value)
+            else:
+                out.append(_VAR)
+        else:
+            out.append(_VAR)
+    return tuple(out)
+
+
+def _specs_list(expr: ast.expr, res: _Resolver) -> "list | None":
+    """in_specs/out_specs -> per-operand spec entry tuples (None for an
+    operand whose spec is not provable); list truncated at a star."""
+    expr = res.deref(expr)
+    if isinstance(expr, ast.Call) \
+            and _call_leaf(expr) in ("P", "PartitionSpec"):
+        return [_spec_entries(expr, res)]
+    if not isinstance(expr, (ast.Tuple, ast.List)):
+        return None
+    out: list = []
+    for e in expr.elts:
+        if isinstance(e, ast.Starred):
+            break  # positions past a star are unknowable
+        out.append(_spec_entries(e, res))
+    return out
+
+
+def _mesh_axes(expr: ast.expr, res: _Resolver) -> "tuple | None":
+    """The literal axis-name tuple of a mesh expression, or None."""
+    expr = res.deref(expr)
+    if not isinstance(expr, ast.Call):
+        return None
+    leaf = _call_leaf(expr)
+    if leaf == "Mesh":
+        axes = expr.args[1] if len(expr.args) > 1 else None
+        for kw in expr.keywords:
+            if kw.arg == "axis_names":
+                axes = kw.value
+        if isinstance(axes, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, str) for e in axes.elts):
+            return tuple(e.value for e in axes.elts)
+        if isinstance(axes, ast.Constant) \
+                and isinstance(axes.value, str):
+            return (axes.value,)
+        return None
+    if leaf == "default_mesh":
+        arg = expr.args[0] if expr.args else None
+        for kw in expr.keywords:
+            if kw.arg == "axis_name":
+                arg = kw.value
+        if arg is None:
+            return ("kv",)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return (arg.value,)
+        return None
+    if leaf == "hybrid_mesh":
+        inner, outer = "kv", "dp"
+        args = list(expr.args)
+        if args:
+            if not (isinstance(args[0], ast.Constant)
+                    and isinstance(args[0].value, str)):
+                return None
+            inner = args[0].value
+        if len(args) > 1:
+            if not (isinstance(args[1], ast.Constant)
+                    and isinstance(args[1].value, str)):
+                return None
+            outer = args[1].value
+        for kw in expr.keywords:
+            if not (isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                return None
+            if kw.arg == "inner_axis":
+                inner = kw.value.value
+            elif kw.arg == "outer_axis":
+                outer = kw.value.value
+        return (outer, inner)
+    return None
+
+
+# -- shard_map site discovery ---------------------------------------------
+
+class _Site:
+    """One shard_map application: the wrapped def, its spec kwargs, the
+    scope holding the shard_map expression, and the wrapped callable's
+    visible call sites in that scope."""
+
+    def __init__(self, fn, kwargs, scope, calls):
+        self.fn = fn              # ast.FunctionDef being wrapped
+        self.kwargs = kwargs      # {mesh, in_specs, out_specs}: exprs
+        self.scope = scope        # enclosing scope of the shard_map
+        self.calls = calls        # list[ast.Call] invoking the wrapper
+
+
+def _shard_map_kwargs(call: ast.Call) -> "dict | None":
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    if "in_specs" not in kw and "out_specs" not in kw:
+        return None
+    return kw
+
+
+def _partial_shard_map(dec: ast.expr) -> "ast.Call | None":
+    """``functools.partial(shard_map, mesh=..., ...)`` decorators."""
+    if not isinstance(dec, ast.Call):
+        return None
+    d = dotted_name(dec.func) or ""
+    if d.split(".")[-1] != "partial" or not dec.args:
+        return None
+    first = dec.args[0]
+    if (dotted_name(first) or "").split(".")[-1] != "shard_map":
+        return None
+    return dec
+
+
+def _find_sites(interp: ShapeInterp) -> list[_Site]:
+    sites: list[_Site] = []
+    for scope in interp.scopes():
+        if isinstance(scope, ast.Module):
+            continue
+        for dec in scope.decorator_list:
+            pc = _partial_shard_map(dec)
+            if pc is None:
+                continue
+            kwargs = _shard_map_kwargs(pc)
+            if kwargs is None:
+                continue
+            parent = interp._parents.get(id(scope), interp.tree)
+            calls = [n for n in _scope_nodes(parent)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Name)
+                     and n.func.id == scope.name]
+            sites.append(_Site(scope, kwargs, parent, calls))
+    # direct form: shard_map(f, mesh=..., in_specs=..., out_specs=...)
+    for scope in interp.scopes():
+        nodes = _scope_nodes(scope)
+        local_defs = {n.name: n for n in nodes
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        if isinstance(scope, ast.Module):
+            local_defs.update(
+                {n.name: n for n in scope.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))})
+        for n in nodes:
+            # the compat shim itself defines shard_map; only CALLS with
+            # a function first-arg + spec kwargs are applications
+            if not (isinstance(n, ast.Call)
+                    and _call_leaf(n) == "shard_map" and n.args):
+                continue
+            fn_arg = n.args[0]
+            if not isinstance(fn_arg, ast.Name):
+                continue
+            fn = local_defs.get(fn_arg.id)
+            if fn is None:
+                continue
+            kwargs = _shard_map_kwargs(n)
+            if kwargs is None:
+                continue
+            calls = [m for m in nodes
+                     if isinstance(m, ast.Call) and m.func is n]
+            # wrapper bound to a single-assignment name -> its calls
+            for m in nodes:
+                if isinstance(m, ast.Assign) and len(m.targets) == 1 \
+                        and isinstance(m.targets[0], ast.Name) \
+                        and m.value is n:
+                    wname = m.targets[0].id
+                    calls += [c for c in nodes
+                              if isinstance(c, ast.Call)
+                              and isinstance(c.func, ast.Name)
+                              and c.func.id == wname]
+            sites.append(_Site(fn, kwargs, scope, calls))
+    return sites
+
+
+# -- collective-freedom proof ----------------------------------------------
+
+def _body_nodes(fn) -> list[ast.AST]:
+    """The def's *body* nodes only — decorators and default-arg
+    expressions execute outside the shard_map and must not poison (or
+    satisfy) the body's collective analysis."""
+    out: list[ast.AST] = []
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _collective_free(fn, index, path: str, memo: dict,
+                     depth: int = _COLLECTIVE_DEPTH) -> bool:
+    """True only when every call reachable from ``fn``'s body (through
+    in-tree callees, depth-capped) is provably not a collective."""
+    key = id(fn)
+    if key in memo:
+        return memo[key]
+    memo[key] = False  # cycle guard: unresolved recursion stays unproven
+    if depth <= 0:
+        return False
+    ok = True
+    for n in _body_nodes(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        leaf = _call_leaf(n)
+        if leaf is None:
+            ok = False
+            break
+        if leaf in _COLLECTIVES:
+            ok = False
+            break
+        d = dotted_name(n.func) or leaf
+        root = d.split(".")[0]
+        if root in _SAFE_ROOTS or root in ("jax", "lax"):
+            continue
+        if "." not in d and leaf in _SAFE_BUILTINS:
+            continue
+        if index is None:
+            ok = False
+            break
+        callee, canonical = index.resolve_call(path, None, n)
+        if callee is None:
+            # external but canonically resolvable (P, Mesh, jnp
+            # aliases): safe unless it is a collective leaf (already
+            # excluded above)
+            if canonical and canonical.split(".")[0] in ("jax",
+                                                         "numpy"):
+                continue
+            ok = False
+            break
+        info = index.functions.get(callee)
+        if info is None:
+            ok = False
+            break
+        if not _collective_free(info.node, index, info.path, memo,
+                                depth - 1):
+            ok = False
+            break
+    memo[key] = ok
+    return ok
+
+
+# -- per-site checks -------------------------------------------------------
+
+def _sharded_positions(spec) -> list[tuple[int, str]]:
+    """(dim index, literal axis) pairs a spec provably shards."""
+    if spec is None:
+        return []
+    return [(i, e) for i, e in enumerate(spec)
+            if isinstance(e, str) and e != _VAR]
+
+
+def _spec_rank(spec) -> int | None:
+    """Declared rank of a spec — only when star-free and non-empty
+    (an empty ``P()`` legally prefixes any rank)."""
+    if spec is None or not spec or Ellipsis in spec:
+        return None
+    return len(spec)
+
+
+def _check_in_specs(site: _Site, interp: ShapeInterp, res: _Resolver,
+                    path: str, findings: list[Finding]) -> None:
+    specs = _specs_list(site.kwargs["in_specs"], res) \
+        if "in_specs" in site.kwargs else None
+    if not specs:
+        return
+    mesh = _mesh_axes(site.kwargs["mesh"], res) \
+        if "mesh" in site.kwargs else None
+    env = interp.env(site.scope)
+    # axis-name validity is call-site independent
+    for i, spec in enumerate(specs):
+        if mesh is None:
+            break
+        for (_, axis) in _sharded_positions(spec):
+            if axis not in mesh:
+                findings.append(Finding(
+                    ATP903,
+                    f"in_specs[{i}] names axis {axis!r} but the mesh "
+                    f"only has axes {mesh}",
+                    path, site.fn.lineno, site.fn.col_offset))
+    for call in site.calls:
+        args = []
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                break  # positions past a star are unknowable
+            args.append(a)
+        line = call.lineno + 1
+        for i, (arg, spec) in enumerate(zip(args, specs)):
+            if spec is None:
+                continue
+            shape = interp._shape_of(arg, env, line,
+                                     _shapes._SUMMARY_DEPTH)
+            rank = _spec_rank(spec)
+            if shape is not None and rank is not None \
+                    and rank > len(shape):
+                findings.append(Finding(
+                    ATP903,
+                    f"in_specs[{i}] has {rank} entries but the operand "
+                    f"provably has rank {len(shape)}",
+                    path, call.lineno, call.col_offset))
+                continue
+            for (j, axis) in _sharded_positions(spec):
+                if shape is None or j >= len(shape):
+                    continue
+                dim = shape[j]
+                if dim is None:
+                    continue
+                if env.facts.divisor_facts(dim):
+                    continue  # any guard with this dividend certifies
+                findings.append(Finding(
+                    ATP904,
+                    f"operand dim {j} ({dim!r}) is split on axis "
+                    f"{axis!r} with no `% shards == 0` guard or "
+                    "assert in scope — an uneven split mis-slices "
+                    "silently (MeshConfigError's static twin)",
+                    path, call.lineno, call.col_offset))
+
+
+def _check_body_reductions(site: _Site, res: _Resolver, index,
+                           path: str, memo: dict,
+                           findings: list[Finding]) -> None:
+    specs = _specs_list(site.kwargs["in_specs"], res) \
+        if "in_specs" in site.kwargs else None
+    if not specs:
+        return
+    a = site.fn.args
+    params = [p.arg for p in a.posonlyargs + a.args]
+    shard_of: dict[str, dict[int, str]] = {}
+    rank_of: dict[str, int] = {}
+    for name, spec in zip(params, specs):
+        pos = _sharded_positions(spec)
+        if pos:
+            shard_of[name] = dict(pos)
+        r = _spec_rank(spec)
+        if r is not None:
+            rank_of[name] = r
+    if not shard_of:
+        return
+    hits = list(_body_reduction_hits(site.fn, shard_of, rank_of))
+    if not hits:
+        return
+    if not _collective_free(site.fn, index, path, memo):
+        return  # a collective (or anything unprovable) may fix it up
+    for (node, pname, j, axis, what) in hits:
+        findings.append(Finding(
+            ATP905,
+            f"{what} contracts dim {j} of {pname!r}, which in_specs "
+            f"shards on {axis!r}, and the shard_map body provably "
+            "contains no collective — each shard computes a silent "
+            "partial result",
+            path, node.lineno, node.col_offset))
+
+
+def _param_name(expr: ast.expr, params) -> str | None:
+    if isinstance(expr, ast.Name) and expr.id in params:
+        return expr.id
+    return None
+
+
+def _body_reduction_hits(fn, shard_of, rank_of):
+    """Yield (node, param, dim, axis, what) for provable contractions
+    over sharded dims of shard_map body params."""
+    for n in _body_nodes(fn):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.MatMult):
+            lhs = _param_name(n.left, shard_of)
+            if lhs is not None and lhs in rank_of:
+                j = rank_of[lhs] - 1
+                if j in shard_of[lhs]:
+                    yield (n, lhs, j, shard_of[lhs][j], "@ (matmul)")
+            rhs = _param_name(n.right, shard_of)
+            if rhs is not None and rhs in rank_of:
+                j = rank_of[rhs] - 2 if rank_of[rhs] >= 2 else 0
+                if j in shard_of[rhs]:
+                    yield (n, rhs, j, shard_of[rhs][j], "@ (matmul)")
+            continue
+        if not isinstance(n, ast.Call):
+            continue
+        leaf = _call_leaf(n)
+        if leaf in _REDUCE_LEAVES:
+            base = None
+            if isinstance(n.func, ast.Attribute):
+                base = _param_name(n.func.value, shard_of)
+                pos_args = n.args
+            if base is None and n.args:
+                base = _param_name(n.args[0], shard_of)
+                pos_args = n.args[1:]
+            if base is None:
+                continue
+            axis_arg = pos_args[0] if pos_args else None
+            for kw in n.keywords:
+                if kw.arg == "axis":
+                    axis_arg = kw.value
+            if axis_arg is None:
+                # full reduction: every sharded dim is contracted
+                j, axis = next(iter(shard_of[base].items()))
+                yield (n, base, j, axis, f"{leaf}() over all axes")
+                continue
+            if not (isinstance(axis_arg, ast.Constant)
+                    and isinstance(axis_arg.value, int)):
+                continue
+            j = axis_arg.value
+            if j < 0:
+                if base not in rank_of:
+                    continue
+                j += rank_of[base]
+            if j in shard_of[base]:
+                yield (n, base, j, shard_of[base][j],
+                       f"{leaf}(axis={axis_arg.value})")
+        elif leaf in ("dot", "matmul"):
+            if len(n.args) < 2:
+                continue
+            lhs = _param_name(n.args[0], shard_of)
+            if lhs is not None and lhs in rank_of:
+                j = rank_of[lhs] - 1
+                if j in shard_of[lhs]:
+                    yield (n, lhs, j, shard_of[lhs][j], f"{leaf}()")
+            rhs = _param_name(n.args[1], shard_of)
+            if rhs is not None and rhs in rank_of:
+                j = rank_of[rhs] - 2 if rank_of[rhs] >= 2 else 0
+                if j in shard_of[rhs]:
+                    yield (n, rhs, j, shard_of[rhs][j], f"{leaf}()")
+        elif leaf == "einsum":
+            if not (n.args and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, str)):
+                continue
+            spec = n.args[0].value.replace(" ", "")
+            if "..." in spec or "->" not in spec:
+                continue
+            lhs_s, rhs_s = spec.split("->", 1)
+            subs = lhs_s.split(",")
+            if len(subs) != len(n.args) - 1:
+                continue
+            contracted = {c for s in subs for c in s if c not in rhs_s}
+            for sub, op in zip(subs, n.args[1:]):
+                pname = _param_name(op, shard_of)
+                if pname is None:
+                    continue
+                for j, ch in enumerate(sub):
+                    if ch in contracted and j in shard_of[pname]:
+                        yield (n, pname, j, shard_of[pname][j],
+                               f"einsum({spec!r})")
+
+
+def _check_out_specs(site: _Site, interp: ShapeInterp, res: _Resolver,
+                     path: str, findings: list[Finding]) -> None:
+    expr = site.kwargs.get("out_specs")
+    if expr is None:
+        return
+    mesh = _mesh_axes(site.kwargs["mesh"], res) \
+        if "mesh" in site.kwargs else None
+    deref = res.deref(expr)
+    returns = [n for n in scope_list(site.fn)
+               if isinstance(n, ast.Return) and n.value is not None]
+    if isinstance(deref, (ast.Tuple, ast.List)) \
+            and not any(isinstance(e, ast.Starred) for e in deref.elts):
+        want = len(deref.elts)
+        for r in returns:
+            if isinstance(r.value, ast.Tuple) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in r.value.elts) \
+                    and len(r.value.elts) != want:
+                findings.append(Finding(
+                    ATP906,
+                    f"out_specs is a {want}-tuple but the shard_map "
+                    f"body returns a {len(r.value.elts)}-tuple",
+                    path, r.lineno, r.col_offset))
+        specs = _specs_list(expr, res) or []
+        for spec in specs:
+            if mesh is None:
+                break
+            for (_, axis) in _sharded_positions(spec):
+                if axis not in mesh:
+                    findings.append(Finding(
+                        ATP906,
+                        f"out_specs names axis {axis!r} but the mesh "
+                        f"only has axes {mesh}",
+                        path, site.fn.lineno, site.fn.col_offset))
+        return
+    spec = _spec_entries(expr, res)
+    if spec is None:
+        return
+    if mesh is not None:
+        for (_, axis) in _sharded_positions(spec):
+            if axis not in mesh:
+                findings.append(Finding(
+                    ATP906,
+                    f"out_specs names axis {axis!r} but the mesh only "
+                    f"has axes {mesh}",
+                    path, site.fn.lineno, site.fn.col_offset))
+    rank = _spec_rank(spec)
+    if rank is None:
+        return
+    env = interp.env(site.fn)
+    for r in returns:
+        if isinstance(r.value, ast.Tuple):
+            continue  # single spec against a pytree: legal prefix
+        shape = interp._shape_of(r.value, env, r.lineno + 1,
+                                 _shapes._SUMMARY_DEPTH)
+        if shape is not None and rank > len(shape):
+            findings.append(Finding(
+                ATP906,
+                f"out_specs has {rank} entries but the returned value "
+                f"provably has rank {len(shape)}",
+                path, r.lineno, r.col_offset))
+
+
+@file_pass("sharding", [ATP903, ATP904, ATP905, ATP906],
+           needs_index=True)
+def check_sharding(path: str, tree: ast.Module, src: str, index=None):
+    """shard_map spec geometry, shard divisibility, silent partials."""
+    if "shard_map" not in src:
+        return []
+    findings: list[Finding] = []
+    interp = interp_for(path, tree, index)
+    sites = _find_sites(interp)
+    if not sites:
+        return findings
+    memo: dict = {}
+    for site in sites:
+        res = _Resolver(interp, site.scope)
+        _check_in_specs(site, interp, res, path, findings)
+        _check_body_reductions(site, res, index, path, memo, findings)
+        _check_out_specs(site, interp, res, path, findings)
+    return findings

@@ -1031,9 +1031,17 @@ def _cmd_analyze(args: argparse.Namespace) -> int:
         return 0
 
     rel_paths = None
+    analyzer_changed = False
     if args.changed:
         rel_paths = _changed_files(root, args.base)
-    if args.paths:
+        # an edit under analysis/ changes what every pass would say
+        # about every file — the call-graph closure below can't model
+        # that (passes aren't callees), so escalate to a full run
+        if any(p.startswith("attention_tpu/analysis/")
+               for p in rel_paths):
+            rel_paths = None
+            analyzer_changed = True
+    if args.paths and not analyzer_changed:
         rel_paths = (rel_paths or []) + [
             os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
             for p in args.paths
@@ -1080,7 +1088,8 @@ def _cmd_analyze(args: argparse.Namespace) -> int:
             return 2
 
     render = {"text": areport.render_text, "json": areport.render_json,
-              "sarif": areport.render_sarif}[args.format]
+              "sarif": areport.render_sarif,
+              "github": areport.render_github}[args.format]
     text = render(findings, problems)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
@@ -1532,14 +1541,19 @@ def main(argv: list[str] | None = None) -> int:
                          "`git merge-base HEAD --base` (plus "
                          "staged/unstaged/untracked changes, plus the "
                          "call-graph reverse closure: files whose "
-                         "callers changed)")
+                         "callers changed); an edit under "
+                         "attention_tpu/analysis/ escalates to a "
+                         "full tree run")
     an.add_argument("--timings", action="store_true",
                     help="print per-pass wall time to stderr (the "
                          "tree-wide budget is <= 5 s)")
     an.add_argument("--base", default="main",
                     help="merge-base ref for --changed (default: main)")
-    an.add_argument("--format", choices=["text", "json", "sarif"],
-                    default="text")
+    an.add_argument("--format",
+                    choices=["text", "json", "sarif", "github"],
+                    default="text",
+                    help="report renderer; 'github' emits workflow-"
+                         "command annotations (::error file=...)")
     an.add_argument("--baseline", default=None,
                     help="baseline file (default: "
                          "attention_tpu/analysis/baseline.json)")

@@ -3,10 +3,10 @@
 One entry point for CI and the tier-1 suite: runs the full
 ``attention_tpu.analysis`` registry (trace purity, Pallas contracts,
 precision, error taxonomy, the determinism lints, the absorbed
-check_* lints, the source-only guard) over the whole scanned tree —
-interprocedural passes get the project index built once — and applies
-the committed baseline: exactly ``cli analyze`` with no arguments, so
-the two can never disagree.
+check_* lints, the source-only guard, the symbolic shape/sharding
+passes) over the whole scanned tree — interprocedural passes get the
+project index built once — and applies the committed baseline: exactly
+``cli analyze`` with no arguments, so the two can never disagree.
 
 Exit 0 iff the tree is clean modulo analysis/baseline.json.
 Run: python scripts/check_all.py [cli-analyze flags, e.g. --format json]
@@ -14,6 +14,9 @@ Run: python scripts/check_all.py [cli-analyze flags, e.g. --format json]
                                              # stderr; the tree-wide
                                              # budget (<= 5 s) is
                                              # asserted by a tier-1 test
+     python scripts/check_all.py --github    # shorthand for
+                                             # --format github (CI
+                                             # annotation lines)
 """
 
 from __future__ import annotations
@@ -25,5 +28,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from attention_tpu.cli import main  # noqa: E402
 
+
+def _argv(raw: list[str]) -> list[str]:
+    """Expand the ``--github`` shorthand into ``--format github``."""
+    out = []
+    for a in raw:
+        if a == "--github":
+            out.extend(["--format", "github"])
+        else:
+            out.append(a)
+    return out
+
+
 if __name__ == "__main__":
-    raise SystemExit(main(["analyze", *sys.argv[1:]]))
+    raise SystemExit(main(["analyze", *_argv(sys.argv[1:])]))

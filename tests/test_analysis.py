@@ -233,6 +233,9 @@ def test_pallas_out_shape_dtype_vs_store():
 
 
 def test_pallas_tile_alignment():
+    """A block shape breaking BOTH tiling rules reports once — the
+    strictest (lane, %128) finding, not one per rule (regression: this
+    used to double-report on one line)."""
     fs = run_pass(
         """
         from jax.experimental import pallas as pl
@@ -245,7 +248,29 @@ def test_pallas_tile_alignment():
             )(x)
         """,
         "pallas")
-    assert codes(fs).count("ATP204") == 2  # 100 % 128 and 7 % 8
+    assert codes(fs) == ["ATP204"]  # deduped: 100 % 128 wins over 7 % 8
+    assert "last dim" in fs[0].message and "128" in fs[0].message
+
+
+def test_pallas_tile_sublane_still_fires_alone():
+    """Dedupe only collapses the double hit: a lane-clean spec with a
+    bad second-minor dim still reports the sublane finding, and the
+    rendered report is byte-stable across runs."""
+    src = """
+        from jax.experimental import pallas as pl
+
+        def f(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((7, 128), lambda i: (0, i))],
+            )(x)
+        """
+    fs = run_pass(src, "pallas")
+    assert codes(fs) == ["ATP204"]
+    assert "second-minor" in fs[0].message
+    assert report.render_text(fs) == report.render_text(
+        run_pass(src, "pallas"))
 
 
 def test_pallas_variable_shapes_are_skipped():
@@ -596,6 +621,33 @@ def test_bench_trend_flags_unparsable_round(tmp_path):
     assert len(problems) == 1 and "unparsable" in problems[0]
 
 
+def test_bench_trend_refuses_round_without_provenance(tmp_path):
+    """From r11 on, a round must record max_mode + mesh_shards in
+    parsed.detail; earlier rounds are grandfathered (r01/r02 predate
+    max_mode entirely)."""
+    from attention_tpu.analysis import benchtrend
+
+    root = str(tmp_path)
+    _write_bench(root, 10, 3.0)  # pre-cutoff: no provenance demanded
+    _write_bench(root, 11, 3.0)
+    problems = benchtrend.trend_problems(root)
+    assert len(problems) == 1
+    assert "BENCH_r11.json" in problems[0]
+    assert "max_mode" in problems[0] and "mesh_shards" in problems[0]
+    # complete provenance: clean
+    with open(os.path.join(root, "BENCH_r11.json"), "w") as f:
+        json.dump({"parsed": {"value": 1.0, "detail": {
+            "tpu_kernel_ms": 3.0, "max_mode": "flash-d",
+            "mesh_shards": [1, 4]}}}, f)
+    assert benchtrend.trend_problems(root) == []
+    # one field missing still refuses
+    with open(os.path.join(root, "BENCH_r12.json"), "w") as f:
+        json.dump({"parsed": {"value": 1.0, "detail": {
+            "tpu_kernel_ms": 3.0, "max_mode": "flash-d"}}}, f)
+    problems = benchtrend.trend_problems(root)
+    assert len(problems) == 1 and "mesh_shards" in problems[0]
+
+
 # ---------------------- determinism (ATP8xx) ----------------------
 
 def test_atp801_wall_clock_into_artifact_sink():
@@ -932,6 +984,38 @@ def test_text_render_clean_and_dirty():
     assert "ATP402" in text and "1 finding(s)" in text
 
 
+def test_github_render_round_trips_the_finding():
+    """The workflow-command line carries back every field of the
+    finding — file, line, 1-based col, code title, message — and a
+    clean run emits nothing (no noise annotations in CI)."""
+    f = _finding()
+    line = report.render_github([f]).rstrip("\n")
+    kind, rest = line[2:].split(" ", 1)
+    props_s, message = rest.split("::", 1)
+    props = dict(kv.split("=", 1) for kv in props_s.split(","))
+    assert kind == ("error" if f.severity is core.Severity.ERROR
+                    else "warning")
+    assert props["file"] == f.path
+    assert int(props["line"]) == f.line
+    assert int(props["col"]) == f.col + 1
+    assert props["title"] == f.code
+    assert message == f.message
+    # data escaping: %, newlines, and property commas can't break the
+    # command syntax
+    weird = core.Finding("ATP402", "50% worse,\nreally", "a,b.py", 3, 0)
+    line = report.render_github([weird]).rstrip("\n")
+    assert "\n" not in line
+    assert "file=a%2Cb.py" in line
+    assert line.endswith("::50%25 worse,%0Areally")
+    # whole-file findings (line == 0) carry only file=
+    wf = core.Finding("ATP402", "m", "x.py")
+    assert "line=" not in report.render_github([wf])
+    # clean tree: empty output, and baseline problems still annotate
+    assert report.render_github([]) == ""
+    assert report.render_github([], ["stale entry"]).startswith(
+        "::error file=attention_tpu/analysis/baseline.json")
+
+
 # ---------------------- registry ----------------------
 
 def test_every_registered_pass_has_codes_and_stable_ids():
@@ -939,7 +1023,8 @@ def test_every_registered_pass_has_codes_and_stable_ids():
                                 "errors", "obs-naming", "shipped-table",
                                 "tolerance-ledger", "source-only-tree",
                                 "durability", "determinism",
-                                "frozen-series", "bench-trend"}
+                                "frozen-series", "bench-trend",
+                                "shapes", "sharding"}
     for p in core.PASSES.values():
         assert p.codes, p.name
         assert p.scope in ("file", "project")
@@ -947,13 +1032,19 @@ def test_every_registered_pass_has_codes_and_stable_ids():
     assert core.PASSES["determinism"].needs_index
     assert core.PASSES["purity"].needs_index
     assert core.PASSES["precision"].needs_index
+    assert core.PASSES["shapes"].needs_index
+    assert core.PASSES["sharding"].needs_index
+    assert core.PASSES["pallas"].needs_index  # ATP902 symbolic upgrade
     assert not core.PASSES["errors"].needs_index
+    # the symbolic upgrade lives in the pallas pass, not a new one
+    assert "ATP902" in core.PASSES["pallas"].codes
     # stable public ids: retiring/renumbering any of these is a break
     assert {"ATP001", "ATP101", "ATP102", "ATP103", "ATP201", "ATP202",
             "ATP203", "ATP204", "ATP301", "ATP302", "ATP401", "ATP402",
             "ATP501", "ATP502", "ATP503", "ATP504", "ATP505",
             "ATP506", "ATP601",
-            "ATP701", "ATP801", "ATP802", "ATP803", "ATP804"
+            "ATP701", "ATP801", "ATP802", "ATP803", "ATP804",
+            "ATP901", "ATP902", "ATP903", "ATP904", "ATP905", "ATP906"
             } <= set(core.CODES)
 
 
@@ -989,7 +1080,8 @@ def test_tree_wide_analysis_is_clean_modulo_baseline():
 
 def test_tree_wide_run_fits_the_time_budget():
     """ISSUE 13's perf contract: the whole tree — index build plus
-    every pass, interprocedural ones included — analyzes in <= 5 s."""
+    every pass, the symbolic shapes/sharding interpreters included —
+    analyzes in <= 5 s."""
     r = _run(["scripts/check_all.py", "--timings"])
     assert r.returncode == 0, r.stdout + r.stderr
     total_lines = [ln for ln in r.stderr.splitlines()
@@ -999,6 +1091,8 @@ def test_tree_wide_run_fits_the_time_budget():
     assert total_ms <= 5000.0, f"tree-wide analysis took {total_ms} ms"
     # the interprocedural machinery is itemized, not hidden
     assert "<index>" in r.stderr and "determinism" in r.stderr
+    # ... and so are the two symbolic passes under the same pin
+    assert "shapes" in r.stderr and "sharding" in r.stderr
 
 
 def test_cli_analyze_changed_exits_clean():
@@ -1006,6 +1100,40 @@ def test_cli_analyze_changed_exits_clean():
     current tree: whatever is dirty must be clean modulo baseline."""
     r = _run(["-m", "attention_tpu.cli", "analyze", "--changed"])
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_analyze_changed_analysis_edit_escalates(monkeypatch):
+    """Regression: editing a file under analysis/ changes what every
+    pass would say about every file, so --changed must escalate to a
+    tree-wide run (rel_paths=None) — the call-graph closure can't model
+    an analyzer edit.  A non-analyzer edit keeps the partial run."""
+    import attention_tpu.cli as cli
+    from attention_tpu import analysis
+    from attention_tpu.analysis import core as acore
+
+    captured = {}
+
+    def spy(root, rel_paths=None, timings=None, index=None):
+        captured["rel_paths"] = rel_paths
+        return []
+
+    class _IdxStub:
+        def files_calling(self, paths):
+            return set()
+
+    monkeypatch.setattr(analysis, "analyze", spy)
+    monkeypatch.setattr(acore, "build_index", lambda root: _IdxStub())
+    monkeypatch.setattr(
+        cli, "_changed_files",
+        lambda root, base: ["attention_tpu/analysis/shapes.py"])
+    assert cli.main(["analyze", "--changed", "--no-baseline"]) == 0
+    assert captured["rel_paths"] is None  # escalated: full tree
+
+    monkeypatch.setattr(
+        cli, "_changed_files",
+        lambda root, base: ["attention_tpu/ops/flash.py"])
+    assert cli.main(["analyze", "--changed", "--no-baseline"]) == 0
+    assert captured["rel_paths"] == ["attention_tpu/ops/flash.py"]
 
 
 def test_cli_analyze_json_on_fixture_file(tmp_path):
@@ -1026,3 +1154,24 @@ def test_cli_analyze_json_on_fixture_file(tmp_path):
     payload = json.loads(rc.stdout)
     assert payload["counts"].get("ATP101") == 1
     assert main(["analyze", "--list-codes"]) == 0
+
+
+def test_check_all_github_shorthand_annotates(tmp_path):
+    """scripts/check_all.py --github == cli analyze --format github:
+    findings come back as ::error workflow-command lines CI can pin to
+    the diff."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(
+        """
+        import time, jax
+
+        @jax.jit
+        def step(x):
+            return x + time.time()
+        """))
+    r = _run(["scripts/check_all.py", str(bad), "--github"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    hits = [ln for ln in r.stdout.splitlines() if "title=ATP101" in ln]
+    assert hits, r.stdout
+    assert hits[0].startswith("::error file=")
+    assert ",line=" in hits[0] and ",col=" in hits[0]
